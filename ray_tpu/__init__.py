@@ -1,0 +1,209 @@
+"""ray_tpu: a TPU-native distributed ML framework.
+
+Public core API mirrors the reference's `ray` package
+(python/ray/__init__.py): init/shutdown, remote, get/put/wait, actors,
+placement groups, state queries — implemented on a single-host (or virtual
+multi-node) head with subprocess workers and a shared-memory object store.
+The ML stack (train/tune/data/rllib/serve) and the TPU mesh layer
+(parallel/, ops/, models/) build on this core.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.ids import JobID, NodeID, ObjectID, WorkerID
+from ray_tpu.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+
+__version__ = "0.1.0"
+
+_head = None
+_head_lock = threading.RLock()
+
+
+def _global_head():
+    return _head
+
+
+def _default_num_cpus() -> float:
+    env = os.environ.get("RAY_TPU_NUM_CPUS")
+    if env:
+        return float(env)
+    # On tiny dev machines a detected count of 1 starves multi-actor
+    # workloads; logical CPUs are a scheduling token here, not a cgroup.
+    return float(max(os.cpu_count() or 1, 8))
+
+
+def _detect_num_tpus() -> float:
+    env = os.environ.get("RAY_TPU_NUM_TPUS")
+    if env:
+        return float(env)
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return float(len([d for d in jax.local_devices()
+                              if d.platform != "cpu"]))
+        except Exception:
+            return 0.0
+    return 0.0
+
+
+def _boot_head(resources: Dict[str, float], labels=None,
+               store_capacity: int = 2 * 1024**3) -> NodeID:
+    """Start the in-process head with one node; driver connects separately."""
+    global _head
+    from ray_tpu._private.head import Head
+
+    with _head_lock:
+        if _head is not None:
+            raise RuntimeError("already initialized")
+        _head = Head()
+        return _head.add_node(resources, labels, store_capacity=store_capacity)
+
+
+def _connect_driver(job_config: Optional[dict] = None):
+    from ray_tpu._private.worker import CoreWorker, DirectTransport, set_global_worker
+
+    with _head_lock:
+        job_id = JobID.from_random()
+        worker_id = WorkerID.from_random()
+        node_id = next(iter(_head.raylets))
+        transport = DirectTransport(_head, worker_id)
+        worker = CoreWorker(worker_id, node_id, job_id, transport, mode="driver")
+        set_global_worker(worker)
+        _head.gcs.add_job(job_id, job_config or {})
+    return worker
+
+
+def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: int = 2 * 1024**3,
+         labels: Optional[dict] = None,
+         ignore_reinit_error: bool = False, **kwargs):
+    """Start a local cluster head + connect this process as the driver.
+
+    Reference: ray.init (python/ray/_private/worker.py:1043)."""
+    global _head
+    with _head_lock:
+        if _head is not None:
+            if ignore_reinit_error:
+                return
+            raise RuntimeError("ray_tpu.init() called twice "
+                               "(pass ignore_reinit_error=True to allow)")
+        res = dict(resources or {})
+        res["CPU"] = float(num_cpus) if num_cpus is not None else _default_num_cpus()
+        ntpu = float(num_tpus) if num_tpus is not None else _detect_num_tpus()
+        if ntpu:
+            res["TPU"] = ntpu
+        res.setdefault("memory", float(object_store_memory))
+        _boot_head(res, labels, store_capacity=object_store_memory)
+        return _connect_driver(kwargs.get("job_config"))
+
+
+def is_initialized() -> bool:
+    return _head is not None
+
+
+def shutdown():
+    global _head
+    from ray_tpu._private.worker import global_worker, set_global_worker
+
+    with _head_lock:
+        if global_worker is not None:
+            try:
+                global_worker._closed = True
+            except Exception:
+                pass
+            set_global_worker(None)
+        if _head is not None:
+            _head.shutdown()
+            _head = None
+
+
+def remote(*args, **kwargs):
+    """@remote decorator for functions and classes (reference:
+    python/ray/_private/worker.py remote())."""
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, dict(kwargs))
+        return RemoteFunction(target, dict(kwargs))
+
+    return decorator
+
+
+def _worker():
+    from ray_tpu._private.worker import global_worker
+
+    if global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return global_worker
+
+
+def put(value: Any) -> ObjectRef:
+    return _worker().put(value)
+
+
+def get(refs, timeout: Optional[float] = None):
+    return _worker().get(refs, timeout)
+
+
+def wait(refs: Sequence[ObjectRef], num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    return _worker().wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, no_restart: bool = True):
+    _worker().transport.request(
+        "kill_actor", {"actor_id": actor._actor_id, "no_restart": no_restart})
+
+
+def cancel(ref: ObjectRef, force: bool = False):
+    _worker().transport.request("cancel", {"task_id": ref.id.task_id()})
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    info = _worker().transport.request(
+        "get_actor", {"name": name, "namespace": namespace})
+    spec = info["creation_spec"]
+    return ActorHandle(info["actor_id"], spec.actor_method_names,
+                       spec.name.replace(".__init__", ""))
+
+
+def cluster_resources() -> Dict[str, float]:
+    return _worker().transport.request("cluster_resources", {})
+
+
+def available_resources() -> Dict[str, float]:
+    return _worker().transport.request("cluster_resources", {"available": True})
+
+
+def nodes() -> List[dict]:
+    return _worker().transport.request("state", {"what": "nodes"})
+
+
+def timeline() -> List[dict]:
+    return _worker().transport.request("state", {"what": "tasks"})
+
+
+# Submodules re-exported lazily to keep `import ray_tpu` light (jax-free).
+def __getattr__(name):
+    import importlib
+
+    if name in ("util", "air", "train", "tune", "data", "serve", "rllib",
+                "parallel", "ops", "models", "workflow", "dag",
+                "cluster_utils", "state", "internal_kv"):
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

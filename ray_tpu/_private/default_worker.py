@@ -1,0 +1,84 @@
+"""Worker process entry point (reference: python/ray/_private/workers/
+default_worker.py + CoreWorkerProcess::RunTaskExecutionLoop,
+src/ray/core_worker/core_worker_process.cc:63).
+
+A reader thread receives messages from the head and routes request-replies to
+futures and task specs to an execution queue; the main thread (plus a thread
+pool for max_concurrency>1 actors) executes tasks.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing.connection import Client
+
+from ray_tpu._private.ids import JobID, NodeID, WorkerID
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+from ray_tpu._private.worker import ConnTransport, CoreWorker, set_global_worker
+
+
+def main():
+    socket_path = os.environ["RAY_TPU_HEAD_SOCKET"]
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+
+    conn = Client(socket_path, family="AF_UNIX", authkey=authkey)
+    transport = ConnTransport(conn)
+    worker = CoreWorker(worker_id, node_id, JobID.nil(), transport, mode="worker")
+    set_global_worker(worker)
+
+    task_queue: "queue.Queue" = queue.Queue()
+    stop = threading.Event()
+
+    def reader():
+        try:
+            while True:
+                msg = conn.recv()
+                t = msg.get("type")
+                if t == "reply":
+                    transport.on_reply(msg)
+                elif t == "execute":
+                    task_queue.put(msg["spec"])
+                elif t == "shutdown":
+                    stop.set()
+                    task_queue.put(None)
+                    return
+        except (EOFError, OSError):
+            stop.set()
+            task_queue.put(None)
+
+    threading.Thread(target=reader, name="rtpu-reader", daemon=True).start()
+    transport.send({"type": "register", "worker_id": worker_id.binary(),
+                    "node_id": node_id.binary(), "pid": os.getpid()})
+
+    pool: ThreadPoolExecutor | None = None
+
+    def run_one(spec: TaskSpec):
+        msg = worker.execute_task(spec)
+        transport.send(msg)
+
+    while not stop.is_set():
+        spec = task_queue.get()
+        if spec is None:
+            break
+        if spec.task_type == TaskType.ACTOR_CREATION and spec.max_concurrency > 1:
+            pool = ThreadPoolExecutor(max_workers=spec.max_concurrency,
+                                      thread_name_prefix="rtpu-actor")
+        if pool is not None and spec.task_type == TaskType.ACTOR_TASK:
+            pool.submit(run_one, spec)
+        else:
+            run_one(spec)
+
+    try:
+        conn.close()
+    except Exception:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

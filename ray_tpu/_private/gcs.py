@@ -1,0 +1,431 @@
+"""Global Control Service: cluster metadata, actor directory, KV, pubsub,
+object directory + distributed reference counting, placement groups, jobs.
+
+TPU-native re-architecture of the reference's GCS server
+(src/ray/gcs/gcs_server/gcs_server.h:77) and of the owner-side reference
+counter (src/ray/core_worker/reference_count.h:61).  Two deliberate
+divergences, both motivated by the target topology (one controller host plus
+gang-scheduled TPU-host worker processes, not a 250-node heterogeneous
+cluster):
+
+1. The GCS runs *in the head process* behind thread-safe method calls rather
+   than as a separate gRPC server.  The interface is kept message-shaped so it
+   can be moved out-of-process (or to C++) without touching callers.
+2. Reference counting is owner-centralized: every process keeps local
+   refcounts and reports add/remove of its *root* references to the GCS,
+   which holds the authoritative holder-set per object.  This trades the
+   reference's fully distributed borrowing protocol for a much smaller state
+   machine; lineage release and store eviction key off the same holder-set.
+
+Storage is pluggable like the reference's StoreClient
+(src/ray/gcs/store_client/store_client.h:33): in-memory default, with a
+file-backed snapshot for GCS restart (redis equivalent) later.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.task_spec import TaskSpec, TaskStatus
+
+
+class ActorState:
+    DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+    PENDING_CREATION = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class ActorInfo:
+    """Actor lifecycle record (reference FSM: gcs_actor_manager.h:280)."""
+
+    __slots__ = (
+        "actor_id", "name", "namespace", "state", "creation_spec", "node_id",
+        "worker_id", "num_restarts", "max_restarts", "death_cause", "lifetime",
+        "class_name", "pending_calls", "resources_held",
+    )
+
+    def __init__(self, actor_id: ActorID, creation_spec: TaskSpec):
+        self.actor_id = actor_id
+        self.name = creation_spec.actor_name
+        self.namespace = creation_spec.namespace or "default"
+        self.state = ActorState.PENDING_CREATION
+        self.creation_spec = creation_spec
+        self.node_id: Optional[NodeID] = None
+        self.worker_id: Optional[WorkerID] = None
+        self.num_restarts = 0
+        self.max_restarts = creation_spec.max_restarts
+        self.death_cause: Optional[str] = None
+        self.lifetime = creation_spec.lifetime
+        self.class_name = creation_spec.name.replace(".__init__", "")
+        self.pending_calls: List[TaskSpec] = []
+        # True while the creation-task resources are allocated on a node;
+        # guards against double-release on kill + worker-death paths.
+        self.resources_held = False
+
+
+class NodeInfo:
+    __slots__ = ("node_id", "resources", "alive", "labels", "address", "last_heartbeat")
+
+    def __init__(self, node_id: NodeID, resources: Dict[str, float], labels=None):
+        self.node_id = node_id
+        self.resources = dict(resources)
+        self.alive = True
+        self.labels = labels or {}
+        self.address = None
+        self.last_heartbeat = time.monotonic()
+
+
+class ObjectEntry:
+    """Object directory + refcount record (owner-side state)."""
+
+    __slots__ = (
+        "object_id", "locations", "inline", "holders", "lineage_task",
+        "size", "spilled_path", "lost",
+    )
+
+    def __init__(self, object_id: ObjectID):
+        self.object_id = object_id
+        self.locations: Set[NodeID] = set()
+        self.inline: Optional[Tuple[bytes, bytes]] = None  # (meta, data) small objects
+        self.holders: Set[bytes] = set()  # worker ids holding a root reference
+        self.lineage_task: Optional[TaskID] = None
+        self.size = 0
+        self.spilled_path: Optional[str] = None
+        self.lost = False
+
+
+class TaskEvent:
+    __slots__ = ("task_id", "name", "status", "node_id", "worker_id", "start", "end",
+                 "attempt", "error", "type", "parent_task_id")
+
+    def __init__(self, task_id, name, status, **kw):
+        self.task_id = task_id
+        self.name = name
+        self.status = status
+        self.node_id = kw.get("node_id")
+        self.worker_id = kw.get("worker_id")
+        self.start = kw.get("start")
+        self.end = kw.get("end")
+        self.attempt = kw.get("attempt", 0)
+        self.error = kw.get("error")
+        self.type = kw.get("type", "NORMAL")
+        self.parent_task_id = kw.get("parent_task_id")
+
+
+class GCS:
+    """The cluster brain. All methods are thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # Tables (reference: gcs_table_storage.h typed tables)
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.jobs: Dict[JobID, dict] = {}
+        self.objects: Dict[ObjectID, ObjectEntry] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
+        self.task_events: Dict[TaskID, TaskEvent] = {}
+        # Lineage: task specs kept while their outputs may need reconstruction
+        # (reference: lineage in task_manager.h:90, max_lineage_bytes).
+        self.lineage: Dict[TaskID, TaskSpec] = {}
+        self.lineage_refcount: Dict[TaskID, int] = defaultdict(int)
+        # Pubsub (reference: src/ray/pubsub) — in-process callback channels.
+        self._subscribers: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
+
+    # ---------------- pubsub ----------------
+    def subscribe(self, channel: str, callback: Callable[[Any], None]):
+        with self._lock:
+            self._subscribers[channel].append(callback)
+
+    def publish(self, channel: str, message: Any):
+        with self._lock:
+            subs = list(self._subscribers.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+    # ---------------- nodes ----------------
+    def register_node(self, info: NodeInfo):
+        with self._lock:
+            self.nodes[info.node_id] = info
+        self.publish("NODE", ("ALIVE", info.node_id))
+
+    def remove_node(self, node_id: NodeID):
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info:
+                info.alive = False
+        self.publish("NODE", ("DEAD", node_id))
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    # ---------------- jobs ----------------
+    def add_job(self, job_id: JobID, config: dict):
+        with self._lock:
+            self.jobs[job_id] = {"job_id": job_id, "config": config,
+                                 "start_time": time.time(), "status": "RUNNING"}
+
+    def finish_job(self, job_id: JobID):
+        with self._lock:
+            if job_id in self.jobs:
+                self.jobs[job_id]["status"] = "FINISHED"
+                self.jobs[job_id]["end_time"] = time.time()
+
+    # ---------------- KV (internal_kv) ----------------
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self.kv[namespace]
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self.kv[namespace].get(key)
+
+    def kv_del(self, key: bytes, namespace: str = "default"):
+        with self._lock:
+            self.kv[namespace].pop(key, None)
+
+    def kv_keys(self, prefix: bytes, namespace: str = "default") -> List[bytes]:
+        with self._lock:
+            return [k for k in self.kv[namespace] if k.startswith(prefix)]
+
+    # ---------------- actors ----------------
+    def register_actor(self, spec: TaskSpec) -> ActorInfo:
+        with self._lock:
+            info = ActorInfo(spec.actor_id, spec)
+            self.actors[spec.actor_id] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self.named_actors:
+                    raise ValueError(f"actor name {info.name!r} already taken")
+                self.named_actors[key] = spec.actor_id
+            return info
+
+    def actor_started(self, actor_id: ActorID, node_id: NodeID, worker_id: WorkerID):
+        with self._lock:
+            info = self.actors[actor_id]
+            info.state = ActorState.ALIVE
+            info.node_id = node_id
+            info.worker_id = worker_id
+        self.publish("ACTOR", ("ALIVE", actor_id))
+
+    def actor_failed(self, actor_id: ActorID, cause: str) -> str:
+        """Returns the new state: RESTARTING (caller should reschedule) or DEAD."""
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None or info.state == ActorState.DEAD:
+                return ActorState.DEAD
+            restartable = (info.max_restarts == -1
+                           or info.num_restarts < info.max_restarts)
+            if restartable:
+                info.num_restarts += 1
+                info.state = ActorState.RESTARTING
+                info.node_id = info.worker_id = None
+            else:
+                info.state = ActorState.DEAD
+                info.death_cause = cause
+                if info.name:
+                    self.named_actors.pop((info.namespace, info.name), None)
+            state = info.state
+        self.publish("ACTOR", (state, actor_id))
+        return state
+
+    def kill_actor(self, actor_id: ActorID):
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            info.state = ActorState.DEAD
+            info.death_cause = "killed via kill()"
+            if info.name:
+                self.named_actors.pop((info.namespace, info.name), None)
+        self.publish("ACTOR", (ActorState.DEAD, actor_id))
+
+    def get_actor_info(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> Optional[ActorID]:
+        with self._lock:
+            return self.named_actors.get((namespace, name))
+
+    def list_named_actors(self, all_namespaces: bool = False) -> List[dict]:
+        with self._lock:
+            return [{"namespace": ns, "name": n} for (ns, n) in self.named_actors]
+
+    # ---------------- object directory + refcounting ----------------
+    def _entry(self, oid: ObjectID) -> ObjectEntry:
+        e = self.objects.get(oid)
+        if e is None:
+            e = self.objects[oid] = ObjectEntry(oid)
+        return e
+
+    def object_sealed(self, oid: ObjectID, node_id: NodeID, size: int,
+                      lineage_task: Optional[TaskID] = None):
+        with self._lock:
+            e = self._entry(oid)
+            e.locations.add(node_id)
+            e.size = size
+            e.lost = False
+            if lineage_task is not None:
+                e.lineage_task = lineage_task
+
+    def object_inline(self, oid: ObjectID, meta: bytes, data: bytes,
+                      lineage_task: Optional[TaskID] = None):
+        with self._lock:
+            e = self._entry(oid)
+            e.inline = (meta, data)
+            e.size = len(data)
+            e.lost = False
+            if lineage_task is not None:
+                e.lineage_task = lineage_task
+
+    def object_lookup(self, oid: ObjectID) -> Optional[ObjectEntry]:
+        with self._lock:
+            return self.objects.get(oid)
+
+    def add_reference(self, oid: ObjectID, holder: bytes):
+        with self._lock:
+            self._entry(oid).holders.add(holder)
+
+    def remove_reference(self, oid: ObjectID, holder: bytes) -> bool:
+        """Returns True when the object has no more holders (safe to free)."""
+        with self._lock:
+            e = self.objects.get(oid)
+            if e is None:
+                return True
+            e.holders.discard(holder)
+            return not e.holders
+
+    def remove_all_references(self, holder: bytes) -> List[ObjectID]:
+        """Worker/driver died: drop all its references. Returns freed ids."""
+        with self._lock:
+            freed = []
+            for oid, e in self.objects.items():
+                if holder in e.holders:
+                    e.holders.discard(holder)
+                    if not e.holders:
+                        freed.append(oid)
+            return freed
+
+    def free_object(self, oid: ObjectID):
+        with self._lock:
+            e = self.objects.pop(oid, None)
+            if e is not None and e.lineage_task is not None:
+                self._release_lineage(e.lineage_task)
+
+    # ---------------- lineage ----------------
+    def record_lineage(self, spec: TaskSpec):
+        with self._lock:
+            self.lineage[spec.task_id] = spec
+            self.lineage_refcount[spec.task_id] = spec.num_returns
+
+    def get_lineage(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            return self.lineage.get(task_id)
+
+    def _release_lineage(self, task_id: TaskID):
+        n = self.lineage_refcount.get(task_id)
+        if n is None:
+            return
+        n -= 1
+        if n <= 0:
+            self.lineage.pop(task_id, None)
+            self.lineage_refcount.pop(task_id, None)
+        else:
+            self.lineage_refcount[task_id] = n
+
+    # ---------------- task events (observability) ----------------
+    def record_task_event(self, ev: TaskEvent):
+        with self._lock:
+            self.task_events[ev.task_id] = ev
+
+    def update_task_status(self, task_id: TaskID, status: TaskStatus, **kw):
+        with self._lock:
+            ev = self.task_events.get(task_id)
+            if ev is not None:
+                ev.status = status
+                for k, v in kw.items():
+                    setattr(ev, k, v)
+
+    # ---------------- state API backing ----------------
+    def list_actors(self) -> List[dict]:
+        with self._lock:
+            return [
+                {
+                    "actor_id": a.actor_id.hex(),
+                    "class_name": a.class_name,
+                    "state": a.state,
+                    "name": a.name,
+                    "num_restarts": a.num_restarts,
+                    "node_id": a.node_id.hex() if a.node_id else None,
+                }
+                for a in self.actors.values()
+            ]
+
+    def list_nodes(self) -> List[dict]:
+        with self._lock:
+            return [
+                {
+                    "node_id": n.node_id.hex(),
+                    "alive": n.alive,
+                    "resources": dict(n.resources),
+                    "labels": dict(n.labels),
+                }
+                for n in self.nodes.values()
+            ]
+
+    def list_tasks(self) -> List[dict]:
+        with self._lock:
+            return [
+                {
+                    "task_id": t.task_id.hex(),
+                    "name": t.name,
+                    "status": t.status.name if hasattr(t.status, "name") else str(t.status),
+                    "attempt": t.attempt,
+                    "type": t.type,
+                    "error": t.error,
+                }
+                for t in self.task_events.values()
+            ]
+
+    def list_objects(self) -> List[dict]:
+        with self._lock:
+            return [
+                {
+                    "object_id": o.object_id.hex(),
+                    "size": o.size,
+                    "locations": [n.hex() for n in o.locations],
+                    "inline": o.inline is not None,
+                    "num_holders": len(o.holders),
+                }
+                for o in self.objects.values()
+            ]
+
+    def list_jobs(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"job_id": j["job_id"].hex(), "status": j["status"]}
+                for j in self.jobs.values()
+            ]

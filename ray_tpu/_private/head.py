@@ -1,0 +1,914 @@
+"""Head: wires GCS + ClusterScheduler + Raylets + worker connections together.
+
+This is the control-plane hub of a single-host (or virtual multi-node)
+cluster: the reference's gcs_server + raylet processes collapsed into one
+threaded component (see gcs.py for why).  Every mutation happens under one
+lock; blocking waits (get/wait) are deferred-reply callbacks so connection
+reader threads never block.
+
+Responsibilities (reference equivalents in parentheses):
+  - task manager: pending queue, retries, lineage reconstruction
+    (src/ray/core_worker/task_manager.h:90, object_recovery_manager.h:41)
+  - actor manager: creation leasing + restart FSM routing
+    (src/ray/gcs/gcs_server/gcs_actor_manager.h:280)
+  - object waits (src/ray/raylet/wait_manager.h)
+  - worker connection routing (src/ray/rpc + direct transports)
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import traceback
+from collections import defaultdict, deque
+from multiprocessing.connection import Listener
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.gcs import GCS, ActorState, NodeInfo, TaskEvent
+from ray_tpu._private.ids import (
+    ActorID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.raylet import Raylet, WorkerHandle
+from ray_tpu._private.scheduler import (
+    ClusterScheduler,
+    Infeasible,
+    PlacementGroupInfo,
+)
+from ray_tpu._private.task_spec import (
+    TaskResult,
+    TaskSpec,
+    TaskStatus,
+    TaskType,
+)
+
+ERROR_META = b"__rtpu_error__"
+
+
+class Head:
+    def __init__(self, session_dir: Optional[str] = None):
+        self.session_dir = session_dir or tempfile.mkdtemp(prefix="ray_tpu_")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.socket_path = os.path.join(self.session_dir, "head.sock")
+        self.authkey = os.urandom(16)
+        self.gcs = GCS()
+        self.scheduler = ClusterScheduler()
+        self.raylets: Dict[NodeID, Raylet] = {}
+        self._lock = threading.RLock()
+        # task_id -> spec for everything in flight (pending or running)
+        self.pending: deque = deque()  # specs with no feasible placement yet
+        self.running: Dict[TaskID, Tuple[TaskSpec, WorkerID]] = {}
+        # Deferred replies: task_id -> list of callbacks fired on completion
+        self._object_waiters: Dict[ObjectID, List[Callable[[dict], None]]] = defaultdict(list)
+        self._actor_waiters: Dict[ActorID, List[Callable[[dict], None]]] = defaultdict(list)
+        self._pg_waiters: Dict[PlacementGroupID, List[Callable[[dict], None]]] = defaultdict(list)
+        self._conns: Dict[WorkerID, Any] = {}
+        self._conn_worker: Dict[int, WorkerID] = {}
+        self._pending_pgs: List[PlacementGroupInfo] = []
+        self._cancelled: set = set()  # task ids cancelled while running
+        self._shutdown = False
+        self._listener = Listener(self.socket_path, family="AF_UNIX",
+                                  authkey=self.authkey)
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               name="rtpu-accept", daemon=True)
+        self._accept_thread.start()
+        # Health monitor: catches worker processes that die before/without
+        # closing their connection (e.g. failed to start at all) — the
+        # equivalent of the reference's GCS health checks
+        # (gcs_health_check_manager.h:39).
+        self._monitor_thread = threading.Thread(target=self._monitor_loop,
+                                                name="rtpu-monitor", daemon=True)
+        self._monitor_thread.start()
+
+    def _monitor_loop(self):
+        import time as _time
+
+        while not self._shutdown:
+            _time.sleep(0.5)
+            with self._lock:
+                for raylet in list(self.raylets.values()):
+                    for h in list(raylet.workers.values()):
+                        if h.proc is not None and h.proc.poll() is not None:
+                            if h.conn is None:
+                                raylet.num_starting = max(0, raylet.num_starting - 1)
+                                raylet.consecutive_start_failures += 1
+                            self._handle_worker_death(
+                                h, f"worker process exited with code "
+                                   f"{h.proc.returncode}")
+                            raylet.on_worker_lost(h.worker_id)
+                            self._conns.pop(h.worker_id, None)
+                            if raylet.consecutive_start_failures >= 3:
+                                # Workers can't start at all (e.g. broken env):
+                                # fail queued work instead of spawn-looping.
+                                while raylet.queued:
+                                    spec = raylet.queued.popleft()
+                                    self.scheduler.return_resources(
+                                        raylet.node_id, spec)
+                                    self._fail_task(spec, exc.WorkerCrashedError(
+                                        "worker processes repeatedly failed "
+                                        "to start on this node"))
+                            else:
+                                raylet.try_dispatch()
+
+    # ================= cluster membership =================
+    def add_node(self, resources: Dict[str, float], labels: Optional[dict] = None,
+                 store_capacity: int = 2 * 1024**3, max_workers: int = 64) -> NodeID:
+        node_id = NodeID.from_random()
+        with self._lock:
+            raylet = Raylet(node_id, self, store_capacity, labels, max_workers)
+            raylet.store.evict_callback = (
+                lambda oid, nid=node_id: self._on_object_evicted(oid, nid))
+            self.raylets[node_id] = raylet
+            self.scheduler.add_node(node_id, resources, labels)
+            self.gcs.register_node(NodeInfo(node_id, resources, labels))
+            self._drain_pending()
+            self._drive_pending_pgs()
+        return node_id
+
+    def remove_node(self, node_id: NodeID):
+        """Simulated node failure (test fixture / chaos hook)."""
+        with self._lock:
+            raylet = self.raylets.pop(node_id, None)
+            self.scheduler.remove_node(node_id)
+            self.gcs.remove_node(node_id)
+            if raylet is None:
+                return
+            # All workers on the node die.
+            for h in list(raylet.workers.values()):
+                self._handle_worker_death(h, f"node {node_id} removed")
+            # Objects on the node are lost.
+            for oid, entry in list(self.gcs.objects.items()):
+                if node_id in entry.locations:
+                    entry.locations.discard(node_id)
+                    if not entry.locations and entry.inline is None:
+                        self._try_reconstruct(oid, entry)
+            raylet.shutdown()
+
+    # ================= worker connections =================
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                return
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 name="rtpu-conn", daemon=True)
+            t.start()
+
+    def _conn_loop(self, conn):
+        worker_id: Optional[WorkerID] = None
+        try:
+            while True:
+                msg = conn.recv()
+                mtype = msg.get("type")
+                if mtype == "register":
+                    worker_id = WorkerID(msg["worker_id"])
+                    self._on_register(worker_id, NodeID(msg["node_id"]), conn)
+                elif mtype == "task_done":
+                    self.on_task_done(msg)
+                elif mtype == "seal":
+                    self.on_seal(msg)
+                elif mtype == "put_inline":
+                    self.on_put_inline(msg)
+                elif mtype == "request":
+                    self._handle_request(msg, conn, worker_id)
+        except (EOFError, OSError, BrokenPipeError):
+            pass
+        except Exception:
+            traceback.print_exc()
+        finally:
+            if worker_id is not None:
+                self.on_conn_closed(worker_id)
+
+    def _on_register(self, worker_id: WorkerID, node_id: NodeID, conn):
+        with self._lock:
+            self._conns[worker_id] = conn
+            raylet = self.raylets.get(node_id)
+            if raylet is not None:
+                raylet.on_worker_registered(worker_id, conn)
+                raylet.try_dispatch()
+
+    def on_conn_closed(self, worker_id: WorkerID):
+        with self._lock:
+            self._conns.pop(worker_id, None)
+            for raylet in self.raylets.values():
+                h = raylet.workers.get(worker_id)
+                if h is not None:
+                    self._handle_worker_death(h, "worker process died")
+                    raylet.on_worker_lost(worker_id)
+                    raylet.try_dispatch()
+                    break
+            freed = self.gcs.remove_all_references(worker_id.binary())
+            for oid in freed:
+                self._free_object(oid)
+
+    def send_to_worker(self, worker: WorkerHandle, msg: dict):
+        try:
+            worker.conn.send(msg)
+        except Exception:
+            self.on_conn_closed(worker.worker_id)
+
+    # ================= request router =================
+    def _handle_request(self, msg: dict, conn, worker_id: Optional[WorkerID]):
+        msg_id = msg["msg_id"]
+
+        def reply(value=None, error: Optional[BaseException] = None):
+            try:
+                conn.send({"type": "reply", "msg_id": msg_id,
+                           "ok": error is None, "value": value,
+                           "error": error})
+            except Exception:
+                pass
+
+        try:
+            self.handle_request(msg["op"], msg.get("payload") or {}, reply,
+                                worker_id)
+        except BaseException as e:  # noqa: BLE001 — errors go to the caller
+            reply(error=e)
+
+    def handle_request(self, op: str, payload: dict,
+                       reply: Callable[..., None],
+                       caller: Optional[WorkerID] = None):
+        """Single entry point for worker requests AND direct driver calls."""
+        fn = getattr(self, "req_" + op, None)
+        if fn is None:
+            reply(error=ValueError(f"unknown op {op!r}"))
+            return
+        fn(payload, reply, caller)
+
+    # ----- ops -----
+    def req_submit(self, payload, reply, caller):
+        self.submit_task(payload["spec"])
+        reply(True)
+
+    def req_get_locations(self, payload, reply, caller):
+        """Resolve an object: reply immediately if available, else defer."""
+        oid: ObjectID = payload["oid"]
+        timeout = payload.get("timeout")
+        with self._lock:
+            resolved = self._resolve_object(oid)
+            if resolved is not None:
+                reply(resolved)
+                return
+            entry = self.gcs.object_lookup(oid)
+            if entry is not None and entry.lost:
+                if not self._try_reconstruct(oid, entry):
+                    reply(error=exc.ObjectLostError(f"{oid} lost and not reconstructable"))
+                    return
+            cb_list = self._object_waiters[oid]
+            record = {"done": False}
+
+            def cb(resolved_msg):
+                if not record["done"]:
+                    record["done"] = True
+                    reply(resolved_msg)
+
+            cb_list.append(cb)
+        if timeout is not None:
+            def on_timeout():
+                with self._lock:
+                    if not record["done"]:
+                        record["done"] = True
+                        reply(error=exc.GetTimeoutError(f"get({oid}) timed out"))
+            t = threading.Timer(timeout, on_timeout)
+            t.daemon = True
+            t.start()
+
+    def req_wait_ready(self, payload, reply, caller):
+        """ray.wait: reply once num_returns of the refs are ready (or timeout).
+        Reply value is the set of ready oids at that moment."""
+        oids: List[ObjectID] = payload["oids"]
+        num_returns = payload["num_returns"]
+        timeout = payload.get("timeout")
+        state = {"done": False}
+
+        def check_and_reply(locked: bool):
+            ready = [o for o in oids if self._resolve_object(o, peek=True) is not None]
+            if len(ready) >= num_returns and not state["done"]:
+                state["done"] = True
+                reply([o.binary() for o in ready])
+                return True
+            return False
+
+        with self._lock:
+            if check_and_reply(True):
+                return
+            for o in oids:
+                if self._resolve_object(o, peek=True) is None:
+                    def cb(_msg, _o=o):
+                        with self._lock:
+                            check_and_reply(True)
+                    self._object_waiters[o].append(cb)
+        if timeout is not None:
+            def on_timeout():
+                with self._lock:
+                    if not state["done"]:
+                        state["done"] = True
+                        ready = [o.binary() for o in oids
+                                 if self._resolve_object(o, peek=True) is not None]
+                        reply(ready)
+            t = threading.Timer(timeout, on_timeout)
+            t.daemon = True
+            t.start()
+
+    def req_add_ref(self, payload, reply, caller):
+        holder = payload.get("holder") or (caller.binary() if caller else b"driver")
+        self.gcs.add_reference(payload["oid"], holder)
+        reply(True)
+
+    def req_remove_ref(self, payload, reply, caller):
+        holder = payload.get("holder") or (caller.binary() if caller else b"driver")
+        oid = payload["oid"]
+        with self._lock:
+            if self.gcs.remove_reference(oid, holder):
+                self._free_object(oid)
+        reply(True)
+
+    def req_kv(self, payload, reply, caller):
+        verb = payload["verb"]
+        ns = payload.get("namespace", "default")
+        if verb == "put":
+            reply(self.gcs.kv_put(payload["key"], payload["value"], ns,
+                                  payload.get("overwrite", True)))
+        elif verb == "get":
+            reply(self.gcs.kv_get(payload["key"], ns))
+        elif verb == "del":
+            self.gcs.kv_del(payload["key"], ns)
+            reply(True)
+        elif verb == "keys":
+            reply(self.gcs.kv_keys(payload.get("prefix", b""), ns))
+        else:
+            reply(error=ValueError(f"bad kv verb {verb}"))
+
+    def req_create_actor(self, payload, reply, caller):
+        spec: TaskSpec = payload["spec"]
+        with self._lock:
+            self.gcs.register_actor(spec)
+            self.submit_task(spec)
+        reply(True)
+
+    def req_actor_call(self, payload, reply, caller):
+        spec: TaskSpec = payload["spec"]
+        self.submit_actor_task(spec)
+        reply(True)
+
+    def req_wait_actor_alive(self, payload, reply, caller):
+        actor_id: ActorID = payload["actor_id"]
+        with self._lock:
+            info = self.gcs.get_actor_info(actor_id)
+            if info is None:
+                reply(error=ValueError(f"unknown actor {actor_id}"))
+                return
+            if info.state == ActorState.ALIVE:
+                reply(True)
+                return
+            if info.state == ActorState.DEAD:
+                reply(error=exc.ActorDiedError(info.death_cause or "actor dead"))
+                return
+            self._actor_waiters[actor_id].append(reply)
+
+    def req_get_actor(self, payload, reply, caller):
+        actor_id = self.gcs.get_named_actor(payload["name"],
+                                            payload.get("namespace", "default"))
+        if actor_id is None:
+            reply(error=ValueError(f"no actor named {payload['name']!r}"))
+            return
+        info = self.gcs.get_actor_info(actor_id)
+        reply({"actor_id": actor_id, "creation_spec": info.creation_spec})
+
+    def req_kill_actor(self, payload, reply, caller):
+        self.kill_actor(payload["actor_id"],
+                        no_restart=payload.get("no_restart", True))
+        reply(True)
+
+    def req_create_pg(self, payload, reply, caller):
+        pg = PlacementGroupInfo(payload["pg_id"], payload["bundles"],
+                                payload["strategy"], payload.get("name", ""))
+        with self._lock:
+            if not self.scheduler.pg_feasible(pg):
+                pg.state = "INFEASIBLE"
+                self.scheduler.placement_groups[pg.pg_id] = pg
+                self.gcs.publish("PG", ("INFEASIBLE", pg.pg_id))
+                reply(error=exc.PlacementGroupSchedulingError(
+                    f"placement group infeasible: {payload['bundles']}"))
+                return
+            if self.scheduler.create_placement_group(pg):
+                self.gcs.publish("PG", ("CREATED", pg.pg_id))
+                reply("CREATED")
+            else:
+                self._pending_pgs.append(pg)
+                self._pg_waiters[pg.pg_id].append(reply)
+
+    def req_pg_ready(self, payload, reply, caller):
+        pg_id = payload["pg_id"]
+        timeout = payload.get("timeout")
+        with self._lock:
+            pg = self.scheduler.placement_groups.get(pg_id)
+            if pg is not None and pg.state == "CREATED":
+                reply("CREATED")
+                return
+            if pg is not None and pg.state == "INFEASIBLE":
+                reply(error=exc.PlacementGroupSchedulingError(
+                    "placement group is infeasible on this cluster"))
+                return
+            state = {"done": False}
+
+            def cb(value=None, error=None):
+                if not state["done"]:
+                    state["done"] = True
+                    reply(value, error=error)
+
+            self._pg_waiters[pg_id].append(cb)
+        if timeout is not None:
+            def on_timeout():
+                with self._lock:
+                    if not state["done"]:
+                        state["done"] = True
+                        reply(error=exc.GetTimeoutError("placement group not ready"))
+            t = threading.Timer(timeout, on_timeout)
+            t.daemon = True
+            t.start()
+
+    def req_remove_pg(self, payload, reply, caller):
+        with self._lock:
+            self.scheduler.remove_placement_group(payload["pg_id"])
+            self._pending_pgs = [p for p in self._pending_pgs
+                                 if p.pg_id != payload["pg_id"]]
+            self._drain_pending()
+        reply(True)
+
+    def req_state(self, payload, reply, caller):
+        what = payload["what"]
+        fn = {
+            "actors": self.gcs.list_actors,
+            "nodes": self.gcs.list_nodes,
+            "tasks": self.gcs.list_tasks,
+            "objects": self.gcs.list_objects,
+            "jobs": self.gcs.list_jobs,
+            "named_actors": self.gcs.list_named_actors,
+        }.get(what)
+        if fn is None:
+            reply(error=ValueError(f"cannot list {what!r}"))
+        else:
+            reply(fn())
+
+    def req_cluster_resources(self, payload, reply, caller):
+        if payload.get("available"):
+            reply(self.scheduler.available_resources())
+        else:
+            reply(self.scheduler.total_resources())
+
+    def req_cancel(self, payload, reply, caller):
+        self.cancel_task(payload["task_id"])
+        reply(True)
+
+    # ================= task manager =================
+    def submit_task(self, spec: TaskSpec):
+        with self._lock:
+            self.gcs.record_task_event(TaskEvent(
+                spec.task_id, spec.name, TaskStatus.PENDING,
+                attempt=spec.attempt, type=spec.task_type.name,
+                parent_task_id=spec.parent_task_id))
+            if spec.task_type != TaskType.ACTOR_CREATION:
+                self.gcs.record_lineage(spec)
+            # Pin arg refs for the task's lifetime (owner-side arg pinning,
+            # reference: dependency_manager.h).
+            for arg in list(spec.args) + list(spec.kwargs.values()):
+                for oid in ([arg.ref] if arg.ref is not None else []) + arg.contained:
+                    self.gcs.add_reference(oid, b"task:" + spec.task_id.binary())
+            self._schedule(spec)
+
+    def _schedule(self, spec: TaskSpec):
+        try:
+            node_id = self.scheduler.pick_node(spec)
+        except Infeasible as e:
+            self._fail_task(spec, exc.PlacementGroupSchedulingError(str(e))
+                            if spec.scheduling_strategy.kind == "PLACEMENT_GROUP"
+                            else exc.RayTpuError(str(e)))
+            return
+        if node_id is None:
+            self.pending.append(spec)
+            return
+        raylet = self.raylets[node_id]
+        self.gcs.update_task_status(spec.task_id, TaskStatus.SCHEDULED,
+                                    node_id=node_id)
+        raylet.queue_task(spec)
+
+    def submit_actor_task(self, spec: TaskSpec):
+        """Route an actor task to the actor's dedicated worker, or queue it
+        while the actor is pending/restarting (reference: direct actor task
+        submitter's per-actor ordered queue,
+        transport/direct_actor_task_submitter.h:67)."""
+        with self._lock:
+            info = self.gcs.get_actor_info(spec.actor_id)
+            if info is None:
+                self._fail_task(spec, exc.ActorDiedError("unknown actor"))
+                return
+            if info.state == ActorState.DEAD:
+                self._fail_task(spec, exc.ActorDiedError(
+                    info.death_cause or "actor is dead"))
+                return
+            self.gcs.record_task_event(TaskEvent(
+                spec.task_id, spec.name, TaskStatus.PENDING,
+                type="ACTOR_TASK", parent_task_id=spec.parent_task_id))
+            if info.state != ActorState.ALIVE or info.worker_id is None:
+                info.pending_calls.append(spec)
+                return
+            self._push_actor_task(info, spec)
+
+    def _push_actor_task(self, info, spec: TaskSpec):
+        conn = self._conns.get(info.worker_id)
+        if conn is None:
+            info.pending_calls.append(spec)
+            return
+        self.running[spec.task_id] = (spec, info.worker_id)
+        self.gcs.update_task_status(spec.task_id, TaskStatus.RUNNING,
+                                    worker_id=info.worker_id)
+        try:
+            conn.send({"type": "execute", "spec": spec})
+        except Exception:
+            info.pending_calls.append(spec)
+
+    def on_task_done(self, msg: dict):
+        task_id = TaskID(msg["task_id"])
+        with self._lock:
+            spec_worker = self.running.pop(task_id, None)
+            worker_id = WorkerID(msg["worker_id"])
+            raylet, handle = self._find_worker(worker_id)
+            spec: Optional[TaskSpec] = msg.get("spec") or (
+                spec_worker[0] if spec_worker else None)
+            if handle is not None and spec is not None \
+                    and spec.task_type == TaskType.NORMAL:
+                self.scheduler.return_resources(handle.node_id, spec)
+            error = msg.get("error")  # (meta, data) serialized exception or None
+            results: List[TaskResult] = msg.get("results") or []
+            if spec is not None:
+                if error is not None and self._maybe_retry(spec, msg):
+                    if handle is not None:
+                        raylet.release_worker(handle)
+                    self._drain_pending()
+                    return
+                status = TaskStatus.FAILED if error else TaskStatus.FINISHED
+                self.gcs.update_task_status(task_id, status,
+                                            error=msg.get("error_str"))
+                # Unpin arg refs (direct and nested).
+                for arg in list(spec.args) + list(spec.kwargs.values()):
+                    for oid in ([arg.ref] if arg.ref is not None else []) \
+                            + arg.contained:
+                        if self.gcs.remove_reference(
+                                oid, b"task:" + spec.task_id.binary()):
+                            self._free_object(oid)
+            node_id = handle.node_id if handle else None
+            for res in results:
+                self._record_result(res, node_id, task_id, error)
+            if error is not None and spec is not None:
+                for oid in spec.return_ids():
+                    if not any(r.object_id == oid for r in results):
+                        self._record_error_result(oid, error)
+            # Actor lifecycle notifications.
+            if spec is not None and spec.task_type == TaskType.ACTOR_CREATION:
+                self._on_actor_creation_done(spec, worker_id, error, msg)
+            if handle is not None:
+                if spec is not None and spec.task_type == TaskType.ACTOR_TASK:
+                    handle.busy = False  # actor workers aren't pooled
+                else:
+                    raylet.release_worker(handle)
+            self._drain_pending()
+            self._drive_pending_pgs()
+
+    def _record_result(self, res: TaskResult, node_id, task_id: TaskID,
+                       error):
+        if res.inline is not None:
+            self.gcs.object_inline(res.object_id, res.inline[0], res.inline[1],
+                                   lineage_task=task_id)
+        elif res.in_store and node_id is not None:
+            self.gcs.object_sealed(res.object_id, node_id, res.size,
+                                   lineage_task=task_id)
+        self._notify_object(res.object_id)
+
+    def _record_error_result(self, oid: ObjectID, error):
+        self.gcs.object_inline(oid, ERROR_META + error[0], error[1])
+        self._notify_object(oid)
+
+    def _maybe_retry(self, spec: TaskSpec, msg: dict) -> bool:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            return False
+        crashed = msg.get("crashed", False)
+        if not crashed and not spec.retry_exceptions:
+            return False
+        if spec.attempt >= spec.max_retries:
+            return False
+        spec.attempt += 1
+        self._schedule(spec)
+        return True
+
+    def _fail_task(self, spec: TaskSpec, error: BaseException):
+        meta, data = _serialize_error(error)
+        for oid in spec.return_ids():
+            self._record_error_result(oid, (meta, data))
+        self.gcs.update_task_status(spec.task_id, TaskStatus.FAILED,
+                                    error=str(error))
+        if spec.task_type == TaskType.ACTOR_CREATION:
+            info = self.gcs.get_actor_info(spec.actor_id)
+            if info is not None:
+                self.gcs.kill_actor(spec.actor_id)
+                info.death_cause = str(error)
+                self._notify_actor_waiters(spec.actor_id, error=error)
+                self._fail_pending_actor_calls(info, error)
+
+    def cancel_task(self, task_id: TaskID):
+        with self._lock:
+            for q in [self.pending] + [r.queued for r in self.raylets.values()]:
+                for spec in list(q):
+                    if spec.task_id == task_id:
+                        q.remove(spec)
+                        self._fail_task(spec, exc.RayTpuError("task cancelled"))
+                        return
+            # Running normal tasks: find the worker currently executing it.
+            for raylet in self.raylets.values():
+                for handle in raylet.workers.values():
+                    t = handle.current_task
+                    if t is not None and t.task_id == task_id \
+                            and handle.actor_id is None:
+                        self._cancelled.add(task_id)
+                        # Coarse cancel (like force=True in the reference):
+                        # kill the worker; death handler fails the task.
+                        try:
+                            handle.proc.kill()
+                        except Exception:
+                            pass
+                        return
+
+    def _drain_pending(self):
+        if not self.pending:
+            return
+        still: deque = deque()
+        while self.pending:
+            spec = self.pending.popleft()
+            try:
+                node_id = self.scheduler.pick_node(spec)
+            except Infeasible as e:
+                self._fail_task(spec, exc.RayTpuError(str(e)))
+                continue
+            if node_id is None:
+                still.append(spec)
+            else:
+                self.gcs.update_task_status(spec.task_id, TaskStatus.SCHEDULED,
+                                            node_id=node_id)
+                self.raylets[node_id].queue_task(spec)
+        self.pending = still
+
+    def _drive_pending_pgs(self):
+        if not self._pending_pgs:
+            return
+        still = []
+        for pg in self._pending_pgs:
+            if self.scheduler.create_placement_group(pg):
+                self.gcs.publish("PG", ("CREATED", pg.pg_id))
+                for cb in self._pg_waiters.pop(pg.pg_id, []):
+                    cb("CREATED")
+            else:
+                still.append(pg)
+        self._pending_pgs = still
+
+    # ================= workers: running-task bookkeeping =================
+    def on_task_started(self, task_id, worker_id):
+        # Dispatch marks running implicitly; normal tasks record here via raylet.
+        pass
+
+    def _find_worker(self, worker_id: WorkerID):
+        for raylet in self.raylets.values():
+            h = raylet.workers.get(worker_id)
+            if h is not None:
+                return raylet, h
+        return None, None
+
+    def _handle_worker_death(self, handle: WorkerHandle, cause: str):
+        spec = handle.current_task
+        if spec is not None and spec.task_type == TaskType.ACTOR_CREATION:
+            # Died mid-creation: release and let the actor FSM below decide
+            # whether to retry (max_restarts) or die.
+            self.scheduler.return_resources(handle.node_id, spec)
+            self.running.pop(spec.task_id, None)
+        elif spec is not None and spec.task_type == TaskType.NORMAL:
+            self.scheduler.return_resources(handle.node_id, spec)
+            self.running.pop(spec.task_id, None)
+            cancelled = spec.task_id in self._cancelled
+            if cancelled:
+                self._cancelled.discard(spec.task_id)
+                self._fail_task(spec, exc.RayTpuError("task cancelled"))
+            elif spec.attempt < spec.max_retries:
+                spec.attempt += 1
+                self._schedule(spec)
+            else:
+                self._fail_task(spec, exc.WorkerCrashedError(cause))
+        # Drop any running actor-task entries bound to this worker.
+        for task_id, (tspec, wid) in list(self.running.items()):
+            if wid == handle.worker_id:
+                self.running.pop(task_id, None)
+                meta, data = _serialize_error(exc.ActorDiedError(cause))
+                for oid in tspec.return_ids():
+                    self._record_error_result(oid, (meta, data))
+        if handle.actor_id is not None:
+            self._on_actor_worker_death(handle.actor_id, cause)
+
+    # ================= actors =================
+    def _on_actor_creation_done(self, spec: TaskSpec, worker_id: WorkerID,
+                                error, msg):
+        info = self.gcs.get_actor_info(spec.actor_id)
+        if info is None:
+            return
+        if error is None:
+            _, handle = self._find_worker(worker_id)
+            node_id = handle.node_id if handle else None
+            info.resources_held = True  # live actor keeps its creation resources
+            self.gcs.actor_started(spec.actor_id, node_id, worker_id)
+            self._notify_actor_waiters(spec.actor_id)
+            calls, info.pending_calls = info.pending_calls, []
+            for call in calls:
+                self._push_actor_task(info, call)
+        else:
+            raylet, handle = self._find_worker(worker_id)
+            if handle is not None:
+                self.scheduler.return_resources(handle.node_id, spec)
+                handle.actor_id = None
+                # The worker process holds a half-constructed actor; recycle it.
+                try:
+                    handle.proc.kill()
+                except Exception:
+                    pass
+            self.gcs.kill_actor(spec.actor_id)
+            info.death_cause = msg.get("error_str") or "actor __init__ failed"
+            err = exc.ActorDiedError(info.death_cause)
+            self._notify_actor_waiters(spec.actor_id, error=err)
+            self._fail_pending_actor_calls(info, err)
+
+    def _on_actor_worker_death(self, actor_id: ActorID, cause: str):
+        info = self.gcs.get_actor_info(actor_id)
+        if info is None:
+            return
+        creation_spec = info.creation_spec
+        if info.resources_held and info.node_id is not None:
+            info.resources_held = False
+            self.scheduler.return_resources(info.node_id, creation_spec)
+        state = self.gcs.actor_failed(actor_id, cause)
+        if state == ActorState.RESTARTING:
+            new_spec = creation_spec
+            new_spec.attempt += 1
+            self._schedule(new_spec)
+        else:
+            err = exc.ActorDiedError(cause)
+            self._notify_actor_waiters(actor_id, error=err)
+            self._fail_pending_actor_calls(info, err)
+
+    def _fail_pending_actor_calls(self, info, error: BaseException):
+        calls, info.pending_calls = info.pending_calls, []
+        meta, data = _serialize_error(error)
+        for call in calls:
+            for oid in call.return_ids():
+                self._record_error_result(oid, (meta, data))
+
+    def _notify_actor_waiters(self, actor_id: ActorID,
+                              error: Optional[BaseException] = None):
+        for cb in self._actor_waiters.pop(actor_id, []):
+            try:
+                if error is None:
+                    cb(True)
+                else:
+                    cb(None, error=error)
+            except TypeError:
+                cb(True)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        with self._lock:
+            info = self.gcs.get_actor_info(actor_id)
+            if info is None:
+                return
+            if no_restart:
+                info.max_restarts = 0
+            worker_id = info.worker_id
+            if info.resources_held and info.node_id is not None:
+                info.resources_held = False
+                self.scheduler.return_resources(info.node_id, info.creation_spec)
+            self.gcs.kill_actor(actor_id)
+            err = exc.ActorDiedError("actor killed")
+            self._fail_pending_actor_calls(info, err)
+            if worker_id is not None:
+                _, handle = self._find_worker(worker_id)
+                if handle is not None:
+                    try:
+                        handle.proc.kill()
+                    except Exception:
+                        pass
+            self._drain_pending()
+
+    # ================= objects =================
+    def on_seal(self, msg: dict):
+        """A worker sealed a large object directly into shm; adopt it."""
+        oid: ObjectID = ObjectID(msg["oid"])
+        node_id = NodeID(msg["node_id"])
+        with self._lock:
+            raylet = self.raylets.get(node_id)
+            if raylet is not None:
+                try:
+                    raylet.store.adopt(oid, msg["size"], msg["meta"])
+                except Exception:
+                    traceback.print_exc()
+                    return
+            self.gcs.object_sealed(oid, node_id, msg["size"],
+                                   lineage_task=msg.get("lineage_task"))
+            self._notify_object(oid)
+
+    def on_put_inline(self, msg: dict):
+        oid = ObjectID(msg["oid"])
+        with self._lock:
+            self.gcs.object_inline(oid, msg["meta"], msg["data"],
+                                   lineage_task=msg.get("lineage_task"))
+            self._notify_object(oid)
+
+    def _resolve_object(self, oid: ObjectID, peek: bool = False) -> Optional[dict]:
+        """Returns a resolution message or None if not yet available."""
+        entry = self.gcs.object_lookup(oid)
+        if entry is None:
+            return None
+        if entry.inline is not None:
+            meta, data = entry.inline
+            if meta.startswith(ERROR_META):
+                return {"kind": "error", "meta": meta[len(ERROR_META):], "data": data}
+            return {"kind": "inline", "meta": meta, "data": data}
+        if entry.locations:
+            # Single-host: every process can attach the segment directly.
+            for node_id in entry.locations:
+                raylet = self.raylets.get(node_id)
+                if raylet is not None:
+                    meta = raylet.store.meta(oid)
+                    if meta is not None:
+                        return {"kind": "store", "oid": oid, "meta": meta}
+            entry.locations.clear()
+            entry.lost = True
+            return None
+        return None
+
+    def _notify_object(self, oid: ObjectID):
+        resolved = self._resolve_object(oid)
+        if resolved is None:
+            return
+        for cb in self._object_waiters.pop(oid, []):
+            try:
+                cb(resolved)
+            except Exception:
+                pass
+
+    def _on_object_evicted(self, oid: ObjectID, node_id: NodeID):
+        entry = self.gcs.object_lookup(oid)
+        if entry is not None:
+            entry.locations.discard(node_id)
+            if not entry.locations and entry.inline is None:
+                entry.lost = True
+
+    def _try_reconstruct(self, oid: ObjectID, entry) -> bool:
+        """Lineage reconstruction: resubmit the creating task
+        (reference: object_recovery_manager.h:41)."""
+        task = self.gcs.get_lineage(oid.task_id())
+        if task is None or oid.is_put():
+            return False
+        task.attempt += 1
+        entry.lost = False
+        self._schedule(task)
+        return True
+
+    def _free_object(self, oid: ObjectID):
+        entry = self.gcs.object_lookup(oid)
+        if entry is None:
+            return
+        if b"task:" in {h[:5] for h in entry.holders}:
+            return
+        for node_id in list(entry.locations):
+            raylet = self.raylets.get(node_id)
+            if raylet is not None:
+                raylet.store.delete(oid)
+        self.gcs.free_object(oid)
+
+    # ================= shutdown =================
+    def shutdown(self):
+        with self._lock:
+            self._shutdown = True
+            for raylet in self.raylets.values():
+                raylet.shutdown()
+            self.raylets.clear()
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+def _serialize_error(error: BaseException) -> Tuple[bytes, bytes]:
+    s = ser.serialize(error)
+    meta, data = ser.pack(s)
+    return meta, data

@@ -1,0 +1,243 @@
+"""Plasma-equivalent shared-memory object store.
+
+The reference implements this as a dlmalloc arena over one big mmap inside the
+raylet (src/ray/object_manager/plasma/store.h:55, dlmalloc.cc) with fd-passing
+to clients.  Our TPU-native design keeps the same *contract* — named,
+immutable, sealed, zero-copy-readable shared-memory objects with create/seal/
+get/delete and eviction accounting — but maps each object to its own POSIX
+shm segment (``multiprocessing.shared_memory``), which any worker process on
+the node can attach by name.  A C++ arena allocator (ray_tpu/_native) can be
+slotted under the same interface later for allocation-rate-bound workloads;
+for ML workloads the store holds few, large, numpy-backed objects
+(SampleBatches, checkpoints, dataset blocks) where per-object segments are
+ideal: the kernel does the zero-copy, and there is no fragmentation.
+
+Small objects never come here — they live in the in-process memory store
+(memory_store.py), exactly like the reference's CoreWorkerMemoryStore
+(src/ray/core_worker/store_provider/memory_store/memory_store.h:43).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from multiprocessing import resource_tracker, shared_memory
+from typing import Dict, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+# Objects <= this many bytes are inlined in task replies / the memory store.
+INLINE_OBJECT_THRESHOLD = 100 * 1024
+
+_PREFIX = "rtpu_"
+
+
+def _segment_name(object_id: ObjectID) -> str:
+    return _PREFIX + object_id.hex()
+
+
+# Names this process has already told the resource tracker to forget; a
+# second unregister makes the tracker process log KeyErrors at exit.
+_untracked: set = set()
+
+
+def untrack(shm: shared_memory.SharedMemory):
+    """Tell the resource tracker this process does NOT own the segment.
+
+    Python 3.12 registers every SharedMemory (even attaches) with the
+    tracker, which would unlink live objects when this process exits."""
+    name = shm._name  # type: ignore[attr-defined]
+    if name in _untracked:
+        return
+    try:
+        resource_tracker.unregister(name, "shared_memory")
+        _untracked.add(name)
+    except Exception:
+        pass
+
+
+def attach(object_id: ObjectID) -> shared_memory.SharedMemory:
+    """Attach to an existing sealed object's segment (any process on node)."""
+    shm = shared_memory.SharedMemory(name=_segment_name(object_id))
+    untrack(shm)
+    return shm
+
+
+class PlasmaObject:
+    __slots__ = ("shm", "metadata", "data_size", "sealed")
+
+    def __init__(self, shm: shared_memory.SharedMemory, data_size: int):
+        self.shm = shm
+        self.metadata: bytes = b""
+        self.data_size = data_size
+        self.sealed = False
+
+
+class SharedMemoryStore:
+    """Node-local store (owner side). Lives in the node's raylet.
+
+    Accounting and LRU-style eviction of *unreferenced* sealed objects mirror
+    plasma's ObjectLifecycleManager + EvictionPolicy
+    (src/ray/object_manager/plasma/object_lifecycle_manager.h,
+    eviction_policy.h).  Spill-to-disk hooks on eviction of referenced
+    objects are the round-2 extension point (local_object_manager.h:41).
+    """
+
+    def __init__(self, capacity_bytes: int = 2 * 1024**3):
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._objects: "OrderedDict[ObjectID, PlasmaObject]" = OrderedDict()
+        self._pinned: Dict[ObjectID, int] = {}
+        self._lock = threading.RLock()
+        # Called with the ObjectID when LRU eviction frees an object, so the
+        # object directory can mark it lost / trigger lineage reconstruction.
+        self.evict_callback = None
+
+    # -- create/seal ------------------------------------------------------
+    def create(self, object_id: ObjectID, data_size: int) -> memoryview:
+        with self._lock:
+            if object_id in self._objects:
+                raise ObjectExistsError(object_id)
+            if data_size > self.capacity:
+                raise OutOfMemoryError(
+                    f"object of {data_size} bytes exceeds store capacity {self.capacity}"
+                )
+            self._evict_until(data_size)
+            if self.used + data_size > self.capacity:
+                raise OutOfMemoryError(
+                    f"store full: need {data_size}, "
+                    f"free {self.capacity - self.used} of {self.capacity}"
+                )
+            shm = shared_memory.SharedMemory(
+                name=_segment_name(object_id), create=True, size=max(1, data_size)
+            )
+            self._objects[object_id] = PlasmaObject(shm, data_size)
+            self.used += data_size
+            return shm.buf[:data_size] if data_size else memoryview(b"")
+
+    def seal(self, object_id: ObjectID, metadata: bytes = b""):
+        with self._lock:
+            obj = self._objects[object_id]
+            obj.metadata = metadata
+            obj.sealed = True
+            self._objects.move_to_end(object_id)
+
+    def put(self, object_id: ObjectID, metadata: bytes, data: bytes) -> None:
+        buf = self.create(object_id, len(data))
+        if len(data):
+            buf[:] = data
+        self.seal(object_id, metadata)
+
+    # -- read -------------------------------------------------------------
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            o = self._objects.get(object_id)
+            return o is not None and o.sealed
+
+    def get(self, object_id: ObjectID) -> Optional[Tuple[bytes, memoryview]]:
+        """Returns (metadata, data) or None. Zero-copy: data is a view over shm."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None or not obj.sealed:
+                return None
+            self._objects.move_to_end(object_id)  # LRU touch
+            return obj.metadata, obj.shm.buf[: obj.data_size]
+
+    def meta(self, object_id: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            obj = self._objects.get(object_id)
+            return obj.metadata if obj and obj.sealed else None
+
+    # -- pin/delete/evict -------------------------------------------------
+    def pin(self, object_id: ObjectID):
+        with self._lock:
+            self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            n = self._pinned.get(object_id, 0) - 1
+            if n <= 0:
+                self._pinned.pop(object_id, None)
+            else:
+                self._pinned[object_id] = n
+
+    def adopt(self, object_id: ObjectID, data_size: int, metadata: bytes):
+        """Adopt a segment created (and already written) by a worker process.
+
+        Workers create+write the segment directly — zero round-trips, like
+        plasma's mmap'd create — then notify their raylet, which takes over
+        ownership/accounting here."""
+        with self._lock:
+            if object_id in self._objects:
+                return
+            self._evict_until(data_size)
+            if self.used + data_size > self.capacity:
+                # The segment already exists (worker wrote it); adopting keeps
+                # the data reachable but flags the overflow — the reference
+                # instead backpressures at create time
+                # (plasma create_request_queue.h); that needs a create RPC,
+                # which trades away the zero-round-trip write path.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "object store over capacity: %d + %d > %d",
+                    self.used, data_size, self.capacity)
+            shm = attach(object_id)
+            obj = PlasmaObject(shm, data_size)
+            obj.metadata = metadata
+            obj.sealed = True
+            self._objects[object_id] = obj
+            self.used += data_size
+
+    def delete(self, object_id: ObjectID, evicted: bool = False):
+        with self._lock:
+            obj = self._objects.pop(object_id, None)
+            self._pinned.pop(object_id, None)
+            if obj is not None:
+                self.used -= obj.data_size
+                try:
+                    obj.shm.unlink()
+                except Exception:
+                    pass
+                try:
+                    obj.shm.close()
+                except Exception:
+                    pass  # exported zero-copy views keep the mapping alive
+                if evicted and self.evict_callback is not None:
+                    try:
+                        self.evict_callback(object_id)
+                    except Exception:
+                        pass
+
+    def _evict_until(self, needed: int):
+        # Evict unpinned sealed objects, least recently used first.
+        if self.used + needed <= self.capacity:
+            return
+        for oid in list(self._objects.keys()):
+            if self.used + needed <= self.capacity:
+                break
+            if oid in self._pinned:
+                continue
+            if self._objects[oid].sealed:
+                self.delete(oid, evicted=True)
+
+    def shutdown(self):
+        with self._lock:
+            for oid in list(self._objects.keys()):
+                self.delete(oid)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "used_bytes": self.used,
+                "capacity_bytes": self.capacity,
+                "num_pinned": len(self._pinned),
+            }
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+class OutOfMemoryError(Exception):
+    pass

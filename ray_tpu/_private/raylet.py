@@ -1,0 +1,198 @@
+"""Raylet: per-node manager — worker pool, local dispatch, node object store.
+
+Equivalent of the reference's NodeManager + WorkerPool + LocalTaskManager
+(src/ray/raylet/node_manager.h:115, worker_pool.h:156,
+local_task_manager.h:58).  One Raylet instance per (possibly virtual) node;
+all raylets of a local cluster live in the head process, workers are real
+subprocesses.  Virtual multi-node is the test fixture the reference builds
+with ray.cluster_utils.Cluster (python/ray/cluster_utils.py:99).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.object_store import SharedMemoryStore
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+
+DEFAULT_MAX_WORKERS = 64
+IDLE_WORKER_TTL_S = 300.0
+
+
+class WorkerHandle:
+    __slots__ = ("worker_id", "proc", "conn", "busy", "actor_id", "node_id",
+                 "current_task", "idle_since", "tpu_visible")
+
+    def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
+        self.worker_id = worker_id
+        self.proc = proc  # subprocess.Popen (None until registered? no: set at spawn)
+        self.conn = None  # set on register
+        self.busy = False
+        self.actor_id = None
+        self.node_id = node_id
+        self.current_task: Optional[TaskSpec] = None
+        self.idle_since = time.monotonic()
+        self.tpu_visible = False
+
+
+class Raylet:
+    """Node-local state. Thread-safety provided by the Head's single dispatch
+    lock (all mutation happens under head._lock)."""
+
+    def __init__(self, node_id: NodeID, head, store_capacity: int,
+                 labels: Optional[dict] = None, max_workers: int = DEFAULT_MAX_WORKERS):
+        self.node_id = node_id
+        self.head = head
+        self.store = SharedMemoryStore(store_capacity)
+        self.labels = labels or {}
+        self.max_workers = max_workers
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.idle: deque = deque()  # WorkerIDs of registered idle workers
+        self.queued: deque = deque()  # TaskSpecs waiting for a free worker
+        self.num_starting = 0
+        self.consecutive_start_failures = 0
+        self.dead = False
+
+    # ---- worker pool ----
+    def ensure_worker(self, spec: Optional[TaskSpec] = None):
+        """Spawn a new worker process if needed for `spec` (or any task)."""
+        needs_tpu = spec is not None and spec.resources.get("TPU", 0) > 0
+        if needs_tpu:
+            # TPU tasks need a TPU-visible worker; spawn one if none exists
+            # (idle or busy) and none is starting.
+            if any(w.tpu_visible for w in self.workers.values()):
+                return
+            if len(self.workers) < self.max_workers:
+                self.spawn_worker(tpu_visible=True)
+            return
+        if self.idle or self.num_starting > 0:
+            return
+        if len(self.workers) + self.num_starting >= self.max_workers:
+            return
+        self.spawn_worker()
+
+    def spawn_worker(self, tpu_visible: bool = False) -> WorkerID:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        # Ensure workers can import ray_tpu even when the driver added it to
+        # sys.path manually rather than installing the package.
+        import ray_tpu as _pkg
+
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__)))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_HEAD_SOCKET"] = self.head.socket_path
+        env["RAY_TPU_AUTHKEY"] = self.head.authkey.hex()
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.head.session_dir
+        if not tpu_visible:
+            # Workers default to CPU so they never contend for the (exclusive)
+            # TPU chips; mesh workers are spawned with tpu_visible=True.
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.default_worker"],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        h = WorkerHandle(worker_id, proc, self.node_id)
+        h.tpu_visible = tpu_visible
+        self.workers[worker_id] = h
+        self.num_starting += 1
+        return worker_id
+
+    def on_worker_registered(self, worker_id: WorkerID, conn) -> Optional[WorkerHandle]:
+        h = self.workers.get(worker_id)
+        if h is None:
+            return None
+        h.conn = conn
+        self.num_starting = max(0, self.num_starting - 1)
+        self.consecutive_start_failures = 0
+        self.idle.append(worker_id)
+        h.idle_since = time.monotonic()
+        return h
+
+    def on_worker_lost(self, worker_id: WorkerID) -> Optional[WorkerHandle]:
+        h = self.workers.pop(worker_id, None)
+        if h is None:
+            return None
+        try:
+            self.idle.remove(worker_id)
+        except ValueError:
+            pass
+        return h
+
+    # ---- dispatch ----
+    def try_dispatch(self):
+        """Hand queued task specs to idle workers; spawn workers as needed.
+        Scans the whole queue so one spec waiting for a special worker
+        (e.g. TPU-visible) doesn't block runnable work behind it.
+        Called under the head lock whenever state changes."""
+        progress = True
+        while progress and self.queued:
+            progress = False
+            for spec in list(self.queued):
+                worker = self._pop_idle(spec)
+                if worker is None:
+                    self.ensure_worker(spec)
+                    continue
+                self.queued.remove(spec)
+                progress = True
+                worker.busy = True
+                worker.current_task = spec
+                if spec.task_type == TaskType.ACTOR_CREATION:
+                    worker.actor_id = spec.actor_id
+                self.head.send_to_worker(worker, {"type": "execute", "spec": spec})
+
+    def _pop_idle(self, spec: TaskSpec) -> Optional[WorkerHandle]:
+        needs_tpu = spec.resources.get("TPU", 0) > 0
+        for _ in range(len(self.idle)):
+            wid = self.idle.popleft()
+            h = self.workers.get(wid)
+            if h is None or h.conn is None:
+                continue
+            if needs_tpu and not h.tpu_visible:
+                self.idle.append(wid)
+                continue
+            return h
+        return None
+
+    def queue_task(self, spec: TaskSpec):
+        self.queued.append(spec)
+        self.try_dispatch()
+
+    def release_worker(self, worker: WorkerHandle):
+        """Task finished: return worker to the idle pool (actors stay pinned)."""
+        worker.busy = False
+        worker.current_task = None
+        if worker.actor_id is None:
+            self.idle.append(worker.worker_id)
+            worker.idle_since = time.monotonic()
+        self.try_dispatch()
+
+    def shutdown(self):
+        self.dead = True
+        for h in list(self.workers.values()):
+            try:
+                if h.conn is not None:
+                    h.conn.send({"type": "shutdown"})
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for h in list(self.workers.values()):
+            if h.proc is None:
+                continue
+            try:
+                h.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    h.proc.kill()
+                except Exception:
+                    pass
+        self.store.shutdown()

@@ -1,0 +1,182 @@
+"""Serialization: cloudpickle + out-of-band zero-copy buffers for arrays.
+
+Equivalent of the reference's SerializationContext
+(python/ray/_private/serialization.py:92), redesigned for a JAX-first stack:
+
+- cloudpickle (pickle protocol 5) for arbitrary Python objects,
+- numpy arrays >= INLINE_THRESHOLD are carried as out-of-band
+  ``PickleBuffer``s so the object store can place them contiguously and the
+  reader can reconstruct a zero-copy view over shared memory,
+- ``jax.Array``s are device_get'ed to numpy on write (host transfer is
+  explicit and happens exactly once at the put-boundary; on-device data never
+  travels through the object store — cross-mesh device data rides ICI/DCN via
+  in-graph collectives, see ray_tpu/parallel/).
+- ObjectRefs serialize by ID with an ownership record so the borrowing
+  protocol can register them (see object_store.py / gcs.py).
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Any, List, Tuple
+
+import cloudpickle
+import numpy as np
+
+# Arrays below this size are pickled in-band.
+INLINE_ARRAY_THRESHOLD = 1024
+
+
+class _RefSerializationContext(threading.local):
+    """Collects ObjectRefs seen while (de)serializing a value, so the caller
+    can register borrows / contained-ids (reference: contained object ids in
+    src/ray/core_worker/reference_count.h)."""
+
+    def __init__(self):
+        self.refs: List[Any] = []
+        self.active = False
+
+    def start(self):
+        self.refs = []
+        self.active = True
+
+    def stop(self) -> List[Any]:
+        self.active = False
+        refs, self.refs = self.refs, []
+        return refs
+
+
+ref_context = _RefSerializationContext()
+
+
+def _is_jax_array(value) -> bool:
+    # Avoid importing jax unless the process already did.
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    return isinstance(value, jax.Array)
+
+
+class SerializedObject:
+    """A serialized value: a pickle blob + out-of-band raw buffers.
+
+    Layout written to the object store:
+        [8B pickle-len][pickle blob][buffer 0][buffer 1]...
+    with an index of (offset, length) pairs carried in the metadata, so
+    readers can rebuild zero-copy memoryviews.
+    """
+
+    __slots__ = ("inband", "buffers", "contained_refs")
+
+    def __init__(self, inband: bytes, buffers: List[Any], contained_refs: List[Any]):
+        self.inband = inband
+        self.buffers = buffers  # list of objects supporting the buffer protocol
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.inband) + sum(len(memoryview(b).cast("B")) for b in self.buffers)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_callback(buf: pickle.PickleBuffer) -> bool:
+        raw = buf.raw()
+        if raw.nbytes >= INLINE_ARRAY_THRESHOLD:
+            buffers.append(buf)
+            return False  # out-of-band
+        return True  # serialize in-band
+
+    if _is_jax_array(value):
+        import jax
+
+        value = np.asarray(jax.device_get(value))
+
+    ref_context.start()
+    try:
+        inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    finally:
+        contained = ref_context.stop()
+    return SerializedObject(inband, [b.raw() for b in buffers], contained)
+
+
+def deserialize(inband: bytes, buffers: List[memoryview]) -> Tuple[Any, List[Any]]:
+    """Returns (value, contained_object_refs)."""
+    ref_context.start()
+    try:
+        value = pickle.loads(inband, buffers=buffers)
+    finally:
+        contained = ref_context.stop()
+    return value, contained
+
+
+def pack(serialized: SerializedObject) -> Tuple[bytes, bytes]:
+    """Pack into (metadata, data) byte strings for the object store.
+
+    metadata is a small pickle of the buffer index; data is the concatenation
+    of the in-band pickle and all raw buffers, 64-byte aligned so numpy views
+    over shared memory are cache-line aligned (reference aligns to 64 in
+    plasma: src/ray/object_manager/plasma/ allocation alignment).
+    """
+    offsets = []
+    pos = _align(len(serialized.inband))
+    for b in serialized.buffers:
+        n = memoryview(b).cast("B").nbytes
+        offsets.append((pos, n))
+        pos = _align(pos + n)
+    meta = pickle.dumps({"inband_len": len(serialized.inband), "buffers": offsets})
+    out = io.BytesIO()
+    out.write(serialized.inband)
+    _pad(out, _align(len(serialized.inband)) - len(serialized.inband))
+    for b, (off, n) in zip(serialized.buffers, offsets):
+        assert out.tell() == off
+        out.write(memoryview(b).cast("B"))
+        _pad(out, _align(off + n) - (off + n))
+    return meta, out.getvalue()
+
+
+def packed_size(serialized: SerializedObject) -> int:
+    pos = _align(len(serialized.inband))
+    for b in serialized.buffers:
+        n = memoryview(b).cast("B").nbytes
+        pos = _align(pos + n)
+    return pos
+
+
+def pack_into(serialized: SerializedObject, dest: memoryview) -> bytes:
+    """Zero-intermediate-copy pack directly into a writable memoryview
+    (a shared-memory segment). Returns metadata."""
+    offsets = []
+    pos = _align(len(serialized.inband))
+    for b in serialized.buffers:
+        n = memoryview(b).cast("B").nbytes
+        offsets.append((pos, n))
+        pos = _align(pos + n)
+    meta = pickle.dumps({"inband_len": len(serialized.inband), "buffers": offsets})
+    dest[: len(serialized.inband)] = serialized.inband
+    for b, (off, n) in zip(serialized.buffers, offsets):
+        dest[off : off + n] = memoryview(b).cast("B")
+    return meta
+
+
+def unpack(meta: bytes, data: memoryview) -> Tuple[Any, List[Any]]:
+    """Inverse of pack/pack_into over a (possibly shared-memory) buffer.
+
+    numpy arrays come back as zero-copy views over ``data``."""
+    index = pickle.loads(meta)
+    inband = bytes(data[: index["inband_len"]])
+    buffers = [data[off : off + n] for off, n in index["buffers"]]
+    return deserialize(inband, buffers)
+
+
+def _align(n: int, a: int = 64) -> int:
+    return (n + a - 1) & ~(a - 1)
+
+
+def _pad(out: io.BytesIO, n: int):
+    if n:
+        out.write(b"\x00" * n)

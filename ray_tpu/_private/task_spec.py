@@ -1,0 +1,117 @@
+"""Task/actor specifications and common enums.
+
+Equivalent of the reference's TaskSpecification (src/ray/common/task/
+task_spec.h, protobuf common.proto TaskSpec) — a plain dataclass here since
+the wire is in-cluster pickle; a protobuf schema can replace it when the
+head moves out of process.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+    WorkerID,
+)
+
+
+class TaskType(enum.Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+    DRIVER = 3
+
+
+class ArgKind(enum.Enum):
+    VALUE = 0  # serialized inline value
+    REF = 1  # ObjectID to resolve before execution
+
+
+@dataclass
+class TaskArg:
+    kind: ArgKind
+    value: Any = None  # (metadata, data) bytes for VALUE
+    ref: Optional[ObjectID] = None
+    # ObjectIDs nested inside a VALUE arg (e.g. a list of refs): pinned for
+    # the task's lifetime like direct ref args (borrow protocol,
+    # reference: contained_ids in src/ray/core_worker/reference_count.h).
+    contained: List[ObjectID] = field(default_factory=list)
+
+
+@dataclass
+class SchedulingStrategy:
+    """Union of DEFAULT / SPREAD / node-affinity / placement-group strategies
+    (reference: python/ray/util/scheduling_strategies.py)."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    name: str
+    # Function payload: cloudpickle blob + stable hash for caching, or for
+    # actor tasks the method name resolved against the actor instance.
+    func_blob: Optional[bytes] = None
+    func_hash: Optional[bytes] = None
+    method_name: Optional[str] = None
+    args: List[TaskArg] = field(default_factory=list)
+    kwargs: Dict[str, TaskArg] = field(default_factory=dict)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    # Actor fields
+    actor_id: Optional[ActorID] = None
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    actor_method_names: List[str] = field(default_factory=list)
+    namespace: Optional[str] = None
+    lifetime: Optional[str] = None  # None | "detached"
+    runtime_env: Optional[dict] = None
+    # Ownership / lineage
+    owner_worker_id: Optional[WorkerID] = None
+    parent_task_id: Optional[TaskID] = None
+    # Bookkeeping filled in by the scheduler
+    attempt: int = 0
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def scheduling_class(self) -> Tuple:
+        """Key for lease reuse: same-shaped tasks share leased workers
+        (reference: SchedulingClass in src/ray/common/task/task_spec.h)."""
+        return (tuple(sorted(self.resources.items())), self.runtime_env is None)
+
+
+@dataclass
+class TaskResult:
+    object_id: ObjectID
+    inline: Optional[Tuple[bytes, bytes]] = None  # (metadata, data) for small objects
+    in_store: bool = False
+    size: int = 0
+    meta: bytes = b""
+
+
+class TaskStatus(enum.Enum):
+    PENDING = 0
+    SCHEDULED = 1
+    RUNNING = 2
+    FINISHED = 3
+    FAILED = 4

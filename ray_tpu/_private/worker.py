@@ -1,0 +1,463 @@
+"""CoreWorker: per-process runtime — object put/get/wait, task submission,
+task execution.  Used by the driver (direct in-process transport to the Head)
+and by subprocess workers (socket transport).
+
+Reference equivalents: CoreWorker (src/ray/core_worker/core_worker.h:278),
+the in-process memory store (store_provider/memory_store/memory_store.h:43),
+the plasma provider (store_provider/plasma_store_provider.h:88) and the
+Python-side execute_task loop (python/ray/_raylet.pyx:701).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import traceback
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu import object_ref as object_ref_mod
+from ray_tpu._private import object_store as store_mod
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import INLINE_OBJECT_THRESHOLD
+from ray_tpu._private.task_spec import (
+    ArgKind,
+    TaskArg,
+    TaskResult,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu.object_ref import ObjectRef
+
+
+# ---------------------------------------------------------------------------
+# Transports
+# ---------------------------------------------------------------------------
+class DirectTransport:
+    """Driver-side transport: function calls straight into the Head."""
+
+    def __init__(self, head, worker_id: WorkerID):
+        self.head = head
+        self.worker_id = worker_id
+
+    def request(self, op: str, payload: dict, timeout: Optional[float] = None):
+        fut: Future = Future()
+
+        def reply(value=None, error=None):
+            if error is not None:
+                if not fut.done():
+                    fut.set_exception(error)
+            elif not fut.done():
+                fut.set_result(value)
+
+        self.head.handle_request(op, payload, reply, self.worker_id)
+        return fut.result(timeout=None)  # head enforces timeouts itself
+
+    def notify(self, msg: dict):
+        t = msg["type"]
+        if t == "seal":
+            self.head.on_seal(msg)
+        elif t == "put_inline":
+            self.head.on_put_inline(msg)
+        elif t == "task_done":
+            self.head.on_task_done(msg)
+
+    def close(self):
+        pass
+
+
+class ConnTransport:
+    """Subprocess-worker transport over a multiprocessing Connection.
+
+    A reader thread (owned by default_worker) routes replies into
+    self._futures; sends are serialized by a lock."""
+
+    def __init__(self, conn):
+        self.conn = conn
+        self._send_lock = threading.Lock()
+        self._futures: Dict[int, Future] = {}
+        self._msg_counter = 0
+        self._futures_lock = threading.Lock()
+
+    def request(self, op: str, payload: dict, timeout: Optional[float] = None):
+        with self._futures_lock:
+            self._msg_counter += 1
+            msg_id = self._msg_counter
+            fut: Future = Future()
+            self._futures[msg_id] = fut
+        self.send({"type": "request", "msg_id": msg_id, "op": op,
+                   "payload": payload})
+        return fut.result()
+
+    def on_reply(self, msg: dict):
+        with self._futures_lock:
+            fut = self._futures.pop(msg["msg_id"], None)
+        if fut is None:
+            return
+        if msg["ok"]:
+            fut.set_result(msg["value"])
+        else:
+            fut.set_exception(msg["error"])
+
+    def notify(self, msg: dict):
+        self.send(msg)
+
+    def send(self, msg: dict):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def close(self):
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        with self._futures_lock:
+            for fut in self._futures.values():
+                if not fut.done():
+                    fut.set_exception(exc.RayTpuError("connection closed"))
+            self._futures.clear()
+
+
+# ---------------------------------------------------------------------------
+# CoreWorker
+# ---------------------------------------------------------------------------
+class TaskContext(threading.local):
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.put_counter = 0
+        self.task_name = ""
+
+
+class CoreWorker:
+    def __init__(self, worker_id: WorkerID, node_id: NodeID, job_id: JobID,
+                 transport, mode: str):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.job_id = job_id
+        self.transport = transport
+        self.mode = mode  # "driver" | "worker" | "local"
+        self.ctx = TaskContext()
+        self.driver_task_id = TaskID.for_driver(job_id)
+        self._local_refs: Dict[ObjectID, int] = {}
+        self._refs_lock = threading.Lock()
+        # In-process caches (memory store): resolved values + attached
+        # segments.  Bounded LRU — long-lived pooled workers would otherwise
+        # retain every object they ever resolved.
+        from collections import OrderedDict
+
+        self._value_cache: "OrderedDict[ObjectID, Any]" = OrderedDict()
+        self._value_cache_cap = 256
+        self._shm_registry: Dict[ObjectID, Any] = {}
+        self._func_cache: Dict[bytes, Callable] = {}
+        self.actors: Dict[ActorID, Any] = {}
+        self._closed = False
+
+    # ---- reference counting ----
+    def add_local_ref(self, oid: ObjectID):
+        if self._closed:
+            return
+        with self._refs_lock:
+            n = self._local_refs.get(oid, 0)
+            self._local_refs[oid] = n + 1
+            first = n == 0
+        if first:
+            try:
+                self.transport.request("add_ref",
+                                       {"oid": oid, "holder": self.worker_id.binary()})
+            except Exception:
+                pass
+
+    def remove_local_ref(self, oid: ObjectID):
+        if self._closed:
+            return
+        with self._refs_lock:
+            n = self._local_refs.get(oid, 0) - 1
+            if n <= 0:
+                self._local_refs.pop(oid, None)
+            else:
+                self._local_refs[oid] = n
+            last = n <= 0
+        if last:
+            self._value_cache.pop(oid, None)
+            self._shm_registry.pop(oid, None)
+            try:
+                self.transport.request("remove_ref",
+                                       {"oid": oid, "holder": self.worker_id.binary()})
+            except Exception:
+                pass
+
+    # ---- put ----
+    def current_task_id(self) -> TaskID:
+        return self.ctx.task_id or self.driver_task_id
+
+    def put(self, value: Any) -> ObjectRef:
+        self.ctx.put_counter += 1
+        oid = ObjectID.for_put(self.current_task_id(), self.ctx.put_counter)
+        self.put_object(oid, value)
+        return ObjectRef(oid)
+
+    def put_object(self, oid: ObjectID, value: Any,
+                   lineage_task: Optional[TaskID] = None):
+        s = ser.serialize(value)
+        size = ser.packed_size(s)
+        if size <= INLINE_OBJECT_THRESHOLD:
+            meta, data = ser.pack(s)
+            self.transport.notify({"type": "put_inline", "oid": oid.binary(),
+                                   "meta": meta, "data": data,
+                                   "lineage_task": lineage_task})
+        else:
+            meta = self._write_to_store(oid, s, size)
+            self.transport.notify({"type": "seal", "oid": oid.binary(),
+                                   "node_id": self.node_id.binary(),
+                                   "size": size, "meta": meta,
+                                   "lineage_task": lineage_task})
+        self._cache_value(oid, value)
+
+    def _write_to_store(self, oid: ObjectID, s: ser.SerializedObject,
+                        size: int) -> bytes:
+        """Create the shared-memory segment directly (zero round trips) and
+        hand ownership to the raylet via the seal notification."""
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(
+            name=store_mod._segment_name(oid), create=True, size=max(1, size))
+        store_mod.untrack(shm)
+        view = shm.buf[:size]
+        try:
+            meta = ser.pack_into(s, view)
+        finally:
+            view.release()
+        shm.close()
+        return meta
+
+    # ---- get ----
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if not single and not isinstance(refs, (list, tuple)):
+            raise TypeError(
+                f"get() expects an ObjectRef or a list of ObjectRefs, "
+                f"got {type(refs).__name__}")
+        ref_list = [refs] if single else list(refs)
+        out = []
+        for r in ref_list:
+            if not isinstance(r, ObjectRef):
+                raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+            out.append(self._get_one(r.id, timeout))
+        return out[0] if single else out
+
+    def _cache_value(self, oid: ObjectID, value):
+        self._value_cache[oid] = value
+        self._value_cache.move_to_end(oid)
+        while len(self._value_cache) > self._value_cache_cap:
+            old, _ = self._value_cache.popitem(last=False)
+            self._shm_registry.pop(old, None)
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float]):
+        if oid in self._value_cache:
+            self._value_cache.move_to_end(oid)
+            return self._value_cache[oid]
+        msg = self.transport.request("get_locations",
+                                     {"oid": oid, "timeout": timeout})
+        return self._materialize(oid, msg)
+
+    def _materialize(self, oid: ObjectID, msg: dict):
+        kind = msg["kind"]
+        if kind == "inline":
+            value, _ = ser.unpack(msg["meta"], memoryview(msg["data"]))
+            self._cache_value(oid, value)
+            return value
+        if kind == "store":
+            try:
+                shm = store_mod.attach(oid)
+            except FileNotFoundError:
+                raise exc.ObjectLostError(f"object {oid} vanished from the store")
+            value, _ = ser.unpack(msg["meta"], shm.buf)
+            self._cache_value(oid, value)
+            self._shm_registry[oid] = shm  # keep mapping alive for zero-copy views
+            return value
+        if kind == "error":
+            err, _ = ser.unpack(msg["meta"], memoryview(msg["data"]))
+            if isinstance(err, BaseException):
+                raise err
+            raise exc.RayTpuError(str(err))
+        raise exc.RayTpuError(f"bad resolution kind {kind}")
+
+    def get_async(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self._get_one(ref.id, None))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    # ---- wait ----
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        ready_bins = self.transport.request(
+            "wait_ready",
+            {"oids": [r.id for r in refs], "num_returns": num_returns,
+             "timeout": timeout})
+        ready_set = set(ready_bins)
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.id.binary() in ready_set and len(ready) < num_returns
+             else not_ready).append(r)
+        return ready, not_ready
+
+    # ---- task submission ----
+    def make_args(self, args: Sequence[Any], kwargs: Dict[str, Any]
+                  ) -> Tuple[List[TaskArg], Dict[str, TaskArg]]:
+        def conv(v) -> TaskArg:
+            if isinstance(v, ObjectRef):
+                return TaskArg(ArgKind.REF, ref=v.id)
+            s = ser.serialize(v)
+            if ser.packed_size(s) > INLINE_OBJECT_THRESHOLD:
+                # Large literal arg: promote to a put object, pass by ref
+                # (reference inlines <100KB, else plasma: dependency_resolver).
+                ref = self.put(v)
+                return TaskArg(ArgKind.REF, ref=ref.id)
+            return TaskArg(ArgKind.VALUE, value=ser.pack(s),
+                           contained=list(s.contained_refs))
+        return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        spec.owner_worker_id = self.worker_id
+        spec.parent_task_id = self.current_task_id()
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        self.transport.request("submit", {"spec": spec})
+        return refs
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        spec.owner_worker_id = self.worker_id
+        spec.parent_task_id = self.current_task_id()
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        self.transport.request("actor_call", {"spec": spec})
+        return refs
+
+    # ---- function resolution ----
+    def load_function(self, blob: bytes, func_hash: Optional[bytes]) -> Callable:
+        key = func_hash or hashlib.sha256(blob).digest()
+        fn = self._func_cache.get(key)
+        if fn is None:
+            fn = cloudpickle.loads(blob)
+            self._func_cache[key] = fn
+        return fn
+
+    # ---- task execution ----
+    def execute_task(self, spec: TaskSpec) -> dict:
+        """Run a task and build the task_done message (does not send it)."""
+        self.ctx.task_id = spec.task_id
+        self.ctx.task_name = spec.name
+        self.ctx.put_counter = 0
+        error = None
+        error_str = None
+        results: List[TaskResult] = []
+        try:
+            args = [self._resolve_arg(a) for a in spec.args]
+            kwargs = {k: self._resolve_arg(a) for k, a in spec.kwargs.items()}
+            if spec.task_type == TaskType.NORMAL:
+                fn = self.load_function(spec.func_blob, spec.func_hash)
+                out = fn(*args, **kwargs)
+            elif spec.task_type == TaskType.ACTOR_CREATION:
+                cls = self.load_function(spec.func_blob, spec.func_hash)
+                self.actors[spec.actor_id] = cls(*args, **kwargs)
+                out = None
+            elif spec.task_type == TaskType.ACTOR_TASK:
+                instance = self.actors.get(spec.actor_id)
+                if instance is None:
+                    raise exc.ActorDiedError("actor instance not found on worker")
+                method = getattr(instance, spec.method_name)
+                out = method(*args, **kwargs)
+                if _is_coroutine(out):
+                    out = _run_coroutine(out)
+            else:
+                raise exc.RayTpuError(f"bad task type {spec.task_type}")
+            results = self._store_returns(spec, out)
+        except BaseException as e:  # noqa: BLE001 — errors are task results
+            error_str = traceback.format_exc()
+            terr = exc.TaskError(type(e).__name__, None, error_str, spec.name)
+            s = ser.serialize(terr)
+            error = ser.pack(s)
+        finally:
+            self.ctx.task_id = None
+        return {
+            "type": "task_done",
+            "task_id": spec.task_id.binary(),
+            "worker_id": self.worker_id.binary(),
+            "spec": spec,
+            "results": results,
+            "error": error,
+            "error_str": error_str,
+            "crashed": False,
+        }
+
+    def _resolve_arg(self, arg: TaskArg):
+        if arg.kind == ArgKind.REF:
+            return self._get_one(arg.ref, None)
+        meta, data = arg.value
+        value, _ = ser.unpack(meta, memoryview(data))
+        return value
+
+    def _store_returns(self, spec: TaskSpec, out) -> List[TaskResult]:
+        if spec.num_returns == 0:
+            return []
+        values = [out] if spec.num_returns == 1 else list(out)
+        if len(values) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={spec.num_returns} "
+                f"but returned {len(values)} values")
+        results = []
+        for i, value in enumerate(values):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            s = ser.serialize(value)
+            size = ser.packed_size(s)
+            if size <= INLINE_OBJECT_THRESHOLD:
+                results.append(TaskResult(oid, inline=ser.pack(s)))
+            else:
+                meta = self._write_to_store(oid, s, size)
+                self.transport.notify({
+                    "type": "seal", "oid": oid.binary(),
+                    "node_id": self.node_id.binary(), "size": size,
+                    "meta": meta, "lineage_task": spec.task_id})
+                results.append(TaskResult(oid, in_store=True, size=size, meta=meta))
+        return results
+
+    def shutdown(self):
+        self._closed = True
+        self.transport.close()
+
+
+def _is_coroutine(obj) -> bool:
+    import inspect
+
+    return inspect.iscoroutine(obj)
+
+
+def _run_coroutine(coro):
+    import asyncio
+
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# Global worker plumbing
+# ---------------------------------------------------------------------------
+global_worker: Optional[CoreWorker] = None
+
+
+def set_global_worker(w: Optional[CoreWorker]):
+    global global_worker
+    global_worker = w
+
+
+object_ref_mod._get_global_worker = lambda: global_worker

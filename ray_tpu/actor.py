@@ -1,0 +1,157 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Reference: python/ray/actor.py (ActorClass :377, ActorHandle :1021,
+ActorMethod :92).  Creation is routed through the GCS actor manager
+(head.req_create_actor), method calls go directly to the actor's dedicated
+worker process through the head's connection router.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private.ids import ActorID, TaskID
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+from ray_tpu.remote_function import _resources_from_options, _strategy_from_options
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 options: Optional[Dict[str, Any]] = None):
+        self._handle = handle
+        self._name = name
+        self._options = options or {}
+
+    def options(self, **kw) -> "ActorMethod":
+        merged = dict(self._options)
+        merged.update(kw)
+        return ActorMethod(self._handle, self._name, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs, self._options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._name} cannot be called directly; "
+            f"use .{self._name}.remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names: List[str],
+                 class_name: str = "Actor"):
+        self._actor_id = actor_id
+        self._method_names = list(method_names)
+        self._class_name = class_name
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def _invoke(self, method_name: str, args, kwargs, options: Dict[str, Any]):
+        from ray_tpu._private.worker import global_worker
+
+        if global_worker is None:
+            raise RuntimeError("ray_tpu.init() has not been called")
+        task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=global_worker.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            name=f"{self._class_name}.{method_name}",
+            method_name=method_name,
+            args=task_args,
+            kwargs=task_kwargs,
+            num_returns=options.get("num_returns", 1),
+            actor_id=self._actor_id,
+        )
+        refs = global_worker.submit_actor_task(spec)
+        if spec.num_returns == 0:
+            return None
+        return refs[0] if spec.num_returns == 1 else refs
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name} has no method {name!r}")
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_names, self._class_name))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = options or {}
+        self._blob = cloudpickle.dumps(cls)
+        self._hash = hashlib.sha256(self._blob).digest()
+        self._method_names = [
+            n for n in dir(cls)
+            if callable(getattr(cls, n, None)) and not n.startswith("__")
+        ]
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def options(self, **kw) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(kw)
+        ac = ActorClass.__new__(ActorClass)
+        ac._cls = self._cls
+        ac._options = merged
+        ac._blob = self._blob
+        ac._hash = self._hash
+        ac._method_names = self._method_names
+        ac.__name__ = self.__name__
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu._private.worker import global_worker
+
+        if global_worker is None:
+            raise RuntimeError("ray_tpu.init() has not been called")
+        opts = self._options
+        task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        actor_id = ActorID.of(global_worker.job_id)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=global_worker.job_id,
+            task_type=TaskType.ACTOR_CREATION,
+            name=self.__name__ + ".__init__",
+            func_blob=self._blob,
+            func_hash=self._hash,
+            args=task_args,
+            kwargs=task_kwargs,
+            num_returns=0,
+            resources=_resources_from_options(opts),
+            scheduling_strategy=_strategy_from_options(opts),
+            max_retries=0,
+            actor_id=actor_id,
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            actor_name=opts.get("name"),
+            actor_method_names=self._method_names,
+            namespace=opts.get("namespace"),
+            lifetime=opts.get("lifetime"),
+            runtime_env=opts.get("runtime_env"),
+        )
+        spec.owner_worker_id = global_worker.worker_id
+        spec.parent_task_id = global_worker.current_task_id()
+        global_worker.transport.request("create_actor", {"spec": spec})
+        return ActorHandle(actor_id, self._method_names, self.__name__)
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()")

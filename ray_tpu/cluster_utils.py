@@ -1,0 +1,50 @@
+"""Virtual multi-node cluster fixture (reference:
+python/ray/cluster_utils.py:99 — the canonical pattern for scheduler and
+fault-tolerance tests: several raylets with faked resources on one machine)."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import ray_tpu
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None,
+                 connect: bool = False):
+        self.nodes = []
+        self.head_node = None
+        if initialize_head:
+            args = dict(head_node_args or {})
+            self.head_node = self.add_node(**args)
+            if connect:
+                self.connect()
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: int = 512 * 1024**2,
+                 labels: Optional[dict] = None, **kw):
+        res = dict(resources or {})
+        if num_cpus:
+            res["CPU"] = float(num_cpus)
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        if self.head_node is None and not ray_tpu.is_initialized():
+            # First node: boot the head (driver not yet connected).
+            node_id = ray_tpu._boot_head(res, labels, object_store_memory)
+        else:
+            node_id = ray_tpu._global_head().add_node(
+                res, labels, store_capacity=object_store_memory)
+        self.nodes.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id):
+        ray_tpu._global_head().remove_node(node_id)
+        if node_id in self.nodes:
+            self.nodes.remove(node_id)
+
+    def connect(self):
+        ray_tpu._connect_driver()
+
+    def shutdown(self):
+        ray_tpu.shutdown()

@@ -1,0 +1,76 @@
+"""User-facing exceptions (reference: python/ray/exceptions.py)."""
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at `get()` on the caller.
+
+    Carries the remote traceback string (reference: RayTaskError,
+    python/ray/exceptions.py)."""
+
+    def __init__(self, cause_cls_name: str, cause: Optional[BaseException], tb_str: str, task_name: str = ""):
+        self.cause = cause
+        self.cause_cls_name = cause_cls_name
+        self.tb_str = tb_str
+        self.task_name = task_name
+        super().__init__(f"task {task_name!r} failed with {cause_cls_name}:\n{tb_str}")
+
+    @classmethod
+    def from_exception(cls, e: BaseException, task_name: str = "") -> "TaskError":
+        return cls(type(e).__name__, e, traceback.format_exc(), task_name)
+
+    def __reduce__(self):
+        # The cause itself may not be picklable; ship the name + traceback.
+        return (TaskError, (self.cause_cls_name, None, self.tb_str, self.task_name))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead (crashed, killed, or out of restarts)."""
+
+    def __init__(self, msg="the actor is dead"):
+        super().__init__(msg)
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unavailable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted/lost and could not be reconstructed from lineage."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get(timeout=...)` expired."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayTpuError):
+    """Placement group could not be reserved (infeasible or timeout)."""
+
+
+class CrossMeshTransferError(RayTpuError):
+    """Device-array transfer between meshes failed (ray_tpu.parallel)."""
+
+
+# Aliases matching the reference's names so ported user code reads naturally.
+RayError = RayTpuError
+RayTaskError = TaskError
+RayActorError = ActorDiedError

@@ -1,0 +1,80 @@
+"""ObjectRef: the user-facing future handle for an object in the cluster.
+
+Reference equivalent: ObjectRef in python/ray/includes/object_ref.pxi.
+Serialization registers borrows through the active worker so the
+owner-centralized refcounting in gcs.py sees every process holding the ref
+(reference protocol: src/ray/core_worker/reference_count.h:61).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.ids import ObjectID
+
+# Set by ray_tpu._private.worker at init; avoids an import cycle.
+_get_global_worker = lambda: None  # noqa: E731
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owner_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, skip_adding_local_ref: bool = False):
+        self.id = object_id
+        self._owner_registered = False
+        if not skip_adding_local_ref:
+            w = _get_global_worker()
+            if w is not None:
+                w.add_local_ref(object_id)
+                self._owner_registered = True
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        w = _get_global_worker()
+        return w.get_async(self)
+
+    def __await__(self):
+        import asyncio
+
+        w = _get_global_worker()
+        fut = w.get_async(self)
+        return asyncio.wrap_future(fut).__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        if ser.ref_context.active:
+            ser.ref_context.refs.append(self.id)
+        return (_deserialize_ref, (self.id.binary(),))
+
+    def __del__(self):
+        if self._owner_registered:
+            w = _get_global_worker()
+            if w is not None:
+                try:
+                    w.remove_local_ref(self.id)
+                except Exception:
+                    pass
+
+
+def _deserialize_ref(binary: bytes) -> ObjectRef:
+    ref = ObjectRef(ObjectID(binary))
+    if ser.ref_context.active:
+        ser.ref_context.refs.append(ref.id)
+    return ref

@@ -1,0 +1,105 @@
+"""@remote functions (reference: python/ray/remote_function.py:35)."""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private.ids import TaskID
+from ray_tpu._private.task_spec import SchedulingStrategy, TaskSpec, TaskType
+
+
+def _resources_from_options(opts: Dict[str, Any],
+                            default_num_cpus: float = 1.0) -> Dict[str, float]:
+    resources = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    resources["CPU"] = float(default_num_cpus if num_cpus is None else num_cpus)
+    if resources["CPU"] == 0:
+        resources.pop("CPU")
+    num_tpus = opts.get("num_tpus", opts.get("num_gpus"))
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    if opts.get("memory"):
+        resources["memory"] = float(opts["memory"])
+    return resources
+
+
+def _strategy_from_options(opts: Dict[str, Any]) -> SchedulingStrategy:
+    st = opts.get("scheduling_strategy")
+    if st is None:
+        return SchedulingStrategy()
+    if isinstance(st, str):
+        return SchedulingStrategy(kind=st)
+    # Duck-typed: util.scheduling_strategies classes.
+    if hasattr(st, "placement_group"):
+        pg = st.placement_group
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=pg.id,
+            bundle_index=getattr(st, "placement_group_bundle_index", -1),
+            capture_child_tasks=getattr(
+                st, "placement_group_capture_child_tasks", False),
+        )
+    if hasattr(st, "node_id"):
+        from ray_tpu._private.ids import NodeID
+
+        nid = st.node_id
+        if isinstance(nid, str):
+            nid = NodeID.from_hex(nid)
+        return SchedulingStrategy(kind="NODE_AFFINITY", node_id=nid,
+                                  soft=getattr(st, "soft", False))
+    raise TypeError(f"bad scheduling strategy {st!r}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._function = fn
+        self._options = options or {}
+        self._blob = cloudpickle.dumps(fn)
+        self._hash = hashlib.sha256(self._blob).digest()
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+
+    def options(self, **kw) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(kw)
+        rf = RemoteFunction.__new__(RemoteFunction)
+        rf._function = self._function
+        rf._options = merged
+        rf._blob = self._blob
+        rf._hash = self._hash
+        rf.__name__ = self.__name__
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.worker import global_worker
+
+        if global_worker is None:
+            raise RuntimeError("ray_tpu.init() has not been called")
+        opts = self._options
+        task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            job_id=global_worker.job_id,
+            task_type=TaskType.NORMAL,
+            name=opts.get("name") or self.__name__,
+            func_blob=self._blob,
+            func_hash=self._hash,
+            args=task_args,
+            kwargs=task_kwargs,
+            num_returns=opts.get("num_returns", 1),
+            resources=_resources_from_options(opts),
+            scheduling_strategy=_strategy_from_options(opts),
+            max_retries=opts.get("max_retries", 3),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            runtime_env=opts.get("runtime_env"),
+        )
+        refs = global_worker.submit_task(spec)
+        if spec.num_returns == 0:
+            return None
+        return refs[0] if spec.num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote()")

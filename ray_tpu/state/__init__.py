@@ -1,0 +1,58 @@
+"""State API (reference: python/ray/experimental/state/api.py —
+list_actors :736, list_nodes :827, list_tasks :959, list_objects :1003)."""
+from __future__ import annotations
+
+from typing import List
+
+
+def _query(what: str) -> List[dict]:
+    from ray_tpu import _worker
+
+    return _worker().transport.request("state", {"what": what})
+
+
+def list_actors() -> List[dict]:
+    return _query("actors")
+
+
+def list_nodes() -> List[dict]:
+    return _query("nodes")
+
+
+def list_tasks() -> List[dict]:
+    return _query("tasks")
+
+
+def list_objects() -> List[dict]:
+    return _query("objects")
+
+
+def list_jobs() -> List[dict]:
+    return _query("jobs")
+
+
+def list_named_actors(all_namespaces: bool = False) -> List[dict]:
+    return _query("named_actors")
+
+
+def summarize_tasks() -> dict:
+    tasks = list_tasks()
+    by_status: dict = {}
+    for t in tasks:
+        by_status.setdefault(t["status"], 0)
+        by_status[t["status"]] += 1
+    return {"total": len(tasks), "by_status": by_status}
+
+
+def summarize_actors() -> dict:
+    actors = list_actors()
+    by_state: dict = {}
+    for a in actors:
+        by_state.setdefault(a["state"], 0)
+        by_state[a["state"]] += 1
+    return {"total": len(actors), "by_state": by_state}
+
+
+def summarize_objects() -> dict:
+    objs = list_objects()
+    return {"total": len(objs), "total_bytes": sum(o["size"] for o in objs)}

@@ -1,0 +1,19 @@
+"""Utility APIs (reference: python/ray/util/)."""
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup,
+    get_current_placement_group,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("collective", "actor_pool", "queue", "metrics", "iter"):
+        return importlib.import_module(f"ray_tpu.util.{name}")
+    raise AttributeError(name)

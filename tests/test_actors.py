@@ -1,0 +1,152 @@
+"""Actor tests (modeled on python/ray/tests/test_actor.py and
+test_actor_failures.py in the reference)."""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+    def crash(self):
+        os._exit(1)
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_init_args(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_two_actors_isolated(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote(10)
+    ray_tpu.get([a.inc.remote(), b.inc.remote()])
+    assert ray_tpu.get(a.read.remote()) == 1
+    assert ray_tpu.get(b.read.remote()) == 11
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="counter").remote(7)
+    h = ray_tpu.get_actor("counter")
+    assert ray_tpu.get(h.read.remote()) == 7
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor method failed")
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="actor method failed"):
+        ray_tpu.get(b.boom.remote())
+    # Actor survives a method exception.
+    with pytest.raises(ray_tpu.exceptions.TaskError):
+        ray_tpu.get(b.boom.remote())
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.read.remote())
+
+
+def test_actor_crash_no_restart(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(c.crash.remote())
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(c.read.remote())
+
+
+def test_actor_restart(ray_start_regular):
+    c = Counter.options(max_restarts=1).remote()
+    ray_tpu.get(c.inc.remote())
+    try:
+        ray_tpu.get(c.crash.remote())
+    except ray_tpu.exceptions.RayTpuError:
+        pass
+    # After restart, state is reset (no checkpointing) but the actor is alive.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            assert ray_tpu.get(c.read.remote()) == 0
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_actor_pass_handle_to_task(ray_start_regular):
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.inc.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.read.remote()) == 1
+
+
+def test_max_concurrency(ray_start_regular):
+    @ray_tpu.remote
+    class Sleeper:
+        def nap(self, t):
+            time.sleep(t)
+            return t
+
+    s = Sleeper.options(max_concurrency=4).remote()
+    start = time.monotonic()
+    refs = [s.nap.remote(1) for _ in range(4)]
+    ray_tpu.get(refs)
+    assert time.monotonic() - start < 3.5  # would be ~4s serialized
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.work.remote(21)) == 42
+
+
+def test_state_api_lists_actor(ray_start_regular):
+    from ray_tpu import state
+
+    Counter.options(name="visible").remote()
+    time.sleep(0.1)
+    actors = state.list_actors()
+    assert any(a["name"] == "visible" for a in actors)

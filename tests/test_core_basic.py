@@ -1,0 +1,130 @@
+"""Core API tests: put/get/wait, tasks, errors, nested tasks.
+
+Modeled on the reference's python/ray/tests/test_basic.py."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_get_large_array_zero_copy(ray_start_regular):
+    x = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(x)
+    # Clear the local cache to force a store round-trip.
+    w = ray_tpu._worker()
+    w._value_cache.clear()
+    y = ray_tpu.get(ref)
+    assert np.array_equal(x, y)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    ref = ray_tpu.put(21)
+    assert ray_tpu.get(double.remote(ref)) == 42
+
+
+def test_task_large_result(ray_start_regular):
+    @ray_tpu.remote
+    def make_array(n):
+        return np.ones(n, dtype=np.float64)
+
+    out = ray_tpu.get(make_array.remote(500_000))
+    assert out.shape == (500_000,)
+    assert out.sum() == 500_000
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def f(i):
+        return i * i
+
+    refs = [f.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def never():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(never.remote(), timeout=0.5)
+
+
+def test_options_name(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(name="custom").remote()) == 1
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 8

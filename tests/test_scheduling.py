@@ -1,0 +1,153 @@
+"""Scheduler + placement group + multi-node tests (modeled on the
+reference's test_placement_group*.py and cluster_utils-based tests)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+def test_resource_gating(ray_start_regular):
+    # 8 CPUs: 8 concurrent 1-CPU sleepers saturate; a 9th waits.
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(0.6)
+        return 1
+
+    start = time.monotonic()
+    refs = [sleeper.remote() for _ in range(9)]
+    ray_tpu.get(refs)
+    assert time.monotonic() - start >= 1.0
+
+
+def test_fractional_cpus(ray_start_regular):
+    @ray_tpu.remote(num_cpus=0.5)
+    def f():
+        return 1
+
+    assert sum(ray_tpu.get([f.remote() for _ in range(16)])) == 16
+
+
+def test_custom_resource(shutdown_only):
+    ray_tpu.init(num_cpus=4, resources={"accel": 2})
+
+    @ray_tpu.remote(resources={"accel": 1})
+    def g():
+        return "ok"
+
+    assert ray_tpu.get(g.remote()) == "ok"
+
+
+def test_infeasible_task_fails(ray_start_regular):
+    @ray_tpu.remote(num_cpus=100)
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(f.remote(), timeout=10)
+
+
+def test_multi_node_cluster(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"head": 1})
+    cluster.add_node(num_cpus=2, resources={"extra": 1})
+    cluster.connect()
+
+    @ray_tpu.remote(resources={"extra": 0.1})
+    def on_extra():
+        return "extra"
+
+    assert ray_tpu.get(on_extra.remote()) == "extra"
+    assert ray_tpu.cluster_resources()["CPU"] == 4
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    n2 = cluster.add_node(num_cpus=2)
+    cluster.connect()
+
+    @ray_tpu.remote
+    def whereami():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nid = ray_tpu.get(whereami.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n2)).remote())
+    assert nid == n2.hex()
+
+
+def test_placement_group_pack(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def inside():
+        return "in-pg"
+
+    ref = inside.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg)).remote()
+    assert ray_tpu.get(ref) == "in-pg"
+    remove_placement_group(pg)
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+    # Bundles must land on distinct nodes.
+    head = ray_tpu._global_head()
+    info = head.scheduler.placement_groups[pg.id]
+    nodes = {b.node_id for b in info.bundles}
+    assert len(nodes) == 2
+
+
+def test_placement_group_infeasible(ray_start_regular):
+    pg = placement_group([{"CPU": 100}], strategy="PACK")
+    assert not pg.wait(2)
+
+
+def test_placement_group_releases_resources(ray_start_regular):
+    pg = placement_group([{"CPU": 8}], strategy="PACK")
+    assert pg.wait(10)
+    assert ray_tpu.available_resources().get("CPU", 0) == 0
+    remove_placement_group(pg)
+    time.sleep(0.2)
+    assert ray_tpu.available_resources()["CPU"] == 8
+
+
+def test_actor_in_placement_group(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg)).remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+
+def test_spread_strategy(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4)
+    cluster.connect()
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def whereami():
+        time.sleep(0.2)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([whereami.remote() for _ in range(4)]))
+    assert len(nodes) == 2
