@@ -1,0 +1,12 @@
+"""Model zoo (flax): GPT-2 family, ResNets, MLP, NatureCNN.
+
+The reference's model layer is RLlib's ModelCatalog + torch/tf ModelV2
+(rllib/models/catalog.py, rllib/models/torch/*) plus whatever user code
+brings to Train.  Here models are flax modules designed for pjit: static
+shapes, bfloat16-friendly, logical sharding annotations exposed per model
+via `param_logical_axes`.
+"""
+from ray_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn  # noqa: F401
+from ray_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401
+from ray_tpu.models.mlp import MLP  # noqa: F401
+from ray_tpu.models.nature_cnn import NatureCNN  # noqa: F401

@@ -1,0 +1,163 @@
+"""GPT-2 in flax, TPU-first.
+
+Flagship model for the Train/Data north-star config ("GPT-2 125M language
+modeling with streaming Dataset shards", BASELINE.json).  The reference has
+no GPT-2 implementation — its benchmark uses HuggingFace torch through
+TorchTrainer (python/ray/train/huggingface/) — so this is a ground-up
+design:
+
+- bfloat16 activations, fp32 params/optimizer (mixed precision via `dtype`),
+- attention through ray_tpu.ops (Pallas flash on TPU, XLA fallback, or ring
+  attention over a `sequence` mesh axis for long context),
+- logical sharding axes per parameter (embed/heads/mlp/vocab) so the same
+  module runs 1-chip, DP, FSDP, or DP×TP via ShardingRules,
+- static shapes + scan-free layer stack (12 layers unrolls fine; a
+  lax.scan-over-layers variant kicks in above `scan_layers_threshold` to
+  bound compile time for deep configs).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import mha_attention
+from ray_tpu.ops.layers import gelu
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_position_embeddings: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    use_flash: Optional[bool] = None  # None = auto by backend
+    scan_layers_threshold: int = 24
+
+    @classmethod
+    def gpt2_small(cls, **kw):  # 125M
+        return cls(**kw)
+
+    @classmethod
+    def gpt2_medium(cls, **kw):  # 350M
+        return cls(num_layers=24, num_heads=16, hidden_size=1024, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):  # test-sized
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("max_position_embeddings", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 2)
+        kw.setdefault("hidden_size", 64)
+        return cls(**kw)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+class Block(nn.Module):
+    config: GPT2Config
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        qkv = nn.Dense(3 * c.hidden_size, dtype=c.dtype, name="attn_qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, l, _ = q.shape
+        q = q.reshape(b, l, c.num_heads, c.head_dim)
+        k = k.reshape(b, l, c.num_heads, c.head_dim)
+        v = v.reshape(b, l, c.num_heads, c.head_dim)
+        if self.attn_fn is not None:
+            attn = self.attn_fn(q, k, v)
+        else:
+            attn = mha_attention(q, k, v, causal=True, use_flash=c.use_flash)
+        attn = attn.reshape(b, l, c.hidden_size)
+        x = x + nn.Dense(c.hidden_size, dtype=c.dtype, name="attn_proj")(attn)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        h = nn.Dense(c.mlp_ratio * c.hidden_size, dtype=c.dtype,
+                     name="mlp_fc")(h)
+        h = gelu(h)
+        x = x + nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_proj")(h)
+        return x
+
+
+class GPT2(nn.Module):
+    config: GPT2Config
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        """input_ids: [B, L] int32 → logits [B, L, vocab]."""
+        c = self.config
+        b, l = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (c.vocab_size, c.hidden_size), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (c.max_position_embeddings, c.hidden_size), jnp.float32)
+        x = wte[input_ids].astype(c.dtype) + wpe[None, :l].astype(c.dtype)
+        if c.num_layers >= c.scan_layers_threshold:
+            block = nn.remat(Block)
+            ScanBlocks = nn.scan(
+                block, variable_axes={"params": 0}, split_rngs={"params": True},
+                length=c.num_layers, metadata_params={"partition_name": "layers"})
+            x, _ = ScanBlocks(c, self.attn_fn, name="h_scan")(x, None)
+        else:
+            for i in range(c.num_layers):
+                x = Block(c, self.attn_fn, name=f"h_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Tied LM head.
+        logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
+                            wte.astype(jnp.float32))
+        return logits
+
+
+def gpt2_loss_fn(params, apply_fn, batch) -> jax.Array:
+    """Next-token cross-entropy. batch: {"input_ids": [B, L]} (labels are the
+    shifted inputs, standard LM objective)."""
+    ids = batch["input_ids"]
+    logits = apply_fn({"params": params}, ids)[:, :-1]
+    labels = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# Logical sharding axes per parameter name suffix (DP/FSDP/TP ready).
+_AXIS_BY_NAME: Dict[str, tuple] = {
+    "wte": ("vocab", "embed"),
+    "wpe": (None, "embed"),
+    "attn_qkv/kernel": ("embed", "heads"),   # fused qkv: shard output dim
+    "attn_qkv/bias": ("heads",),
+    "attn_proj/kernel": ("heads", "embed_fsdp"),
+    "attn_proj/bias": (None,),
+    "mlp_fc/kernel": ("embed", "mlp"),
+    "mlp_fc/bias": ("mlp",),
+    "mlp_proj/kernel": ("mlp", "embed_fsdp"),
+    "mlp_proj/bias": (None,),
+}
+
+
+def param_logical_axes(params) -> Any:
+    """Pytree of logical-axis tuples matching `params` (None = replicate)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def axes_for(path) -> Optional[tuple]:
+        name = "/".join(getattr(k, "key", str(k)) for k in path)
+        for suffix, axes in _AXIS_BY_NAME.items():
+            if name.endswith(suffix):
+                return axes
+        return None
+
+    leaves = [axes_for(path) for path, _ in flat]
+    treedef = jax.tree_util.tree_structure(
+        params, is_leaf=lambda x: hasattr(x, "shape"))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,24 @@
+"""Plain MLP (test/bench workhorse; RL policy trunk equivalent of RLlib's
+fcnet, rllib/models/torch/fcnet.py)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (64, 64)
+    out_dim: int = 1
+    activation: Callable = nn.tanh
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = self.activation(nn.Dense(f, dtype=self.dtype,
+                                         name=f"dense_{i}")(x))
+        return nn.Dense(self.out_dim, dtype=self.dtype, name="out")(x)

@@ -1,0 +1,27 @@
+"""Nature-DQN CNN trunk for pixel RL (equivalent of RLlib's visionnet,
+rllib/models/torch/visionnet.py).  NHWC, bfloat16-friendly."""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class NatureCNN(nn.Module):
+    out_dim: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """x: [B, H, W, C] uint8 or float → [B, out_dim]."""
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.dtype) / 255.0
+        else:
+            x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (8, 8), strides=(4, 4), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (4, 4), strides=(2, 2), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), dtype=self.dtype)(x))
+        x = x.reshape((x.shape[0], -1))
+        return nn.relu(nn.Dense(self.out_dim, dtype=self.dtype)(x))

@@ -1,0 +1,12 @@
+"""TPU compute ops: Pallas kernels with pure-XLA fallbacks.
+
+Kernel selection: pallas on real TPU, jnp reference elsewhere (CPU test
+meshes can't run Mosaic kernels).  Everything here is shape-static and
+jit/scan-friendly per XLA's compilation model.
+"""
+from ray_tpu.ops.attention import (  # noqa: F401
+    mha_attention,
+    flash_attention,
+    blockwise_update,
+)
+from ray_tpu.ops.layers import gelu, layer_norm, rms_norm, rope  # noqa: F401

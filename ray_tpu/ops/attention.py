@@ -1,0 +1,175 @@
+"""Attention ops: reference XLA implementation, online-softmax block update
+(shared with ring attention), and a Pallas TPU flash-attention kernel.
+
+The reference framework has no attention kernels at all — its models call
+torch; the closest analogue is RLlib's GTrXL attention_net
+(rllib/models/torch/attention_net.py), which is plain torch ops.  Here
+attention is a first-class fused kernel because on TPU the HBM-bandwidth win
+of not materializing the [L, L] score matrix is the difference between MXU-
+bound and memory-bound.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
+
+
+def mha_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True, sm_scale: Optional[float] = None,
+                  use_flash: Optional[bool] = None) -> jax.Array:
+    """Multi-head attention. q,k,v: [B, L, H, D] → [B, L, H, D].
+
+    Dispatches to the Pallas flash kernel on real TPU backends, XLA
+    reference otherwise."""
+    if use_flash is None:
+        use_flash = (jax.default_backend() not in ("cpu",)
+                     and q.shape[1] >= 256 and q.shape[1] % 128 == 0
+                     and k.shape[1] % 128 == 0)
+    if use_flash:
+        try:
+            return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        except Exception:
+            pass  # fall back to the XLA path (e.g. interpreter platforms)
+    return _xla_attention(q, k, v, causal, sm_scale)
+
+
+def _xla_attention(q, k, v, causal, sm_scale):
+    *_, d = q.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# Online-softmax block update (the flash recurrence), shared by ring
+# attention: numerically safe when a block is fully masked.
+# ---------------------------------------------------------------------------
+def blockwise_update(q, k_blk, v_blk, o, l, m, mask=None,
+                     sm_scale: Optional[float] = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One step of the flash-attention recurrence.
+
+    q: [B, Lq, H, D]; k_blk/v_blk: [B, Lk, H, D]
+    o: [B, Lq, H, D] unnormalized accumulator
+    l: [B, H, Lq] running denominator; m: [B, H, Lq] running max
+    mask: optional [Lq, Lk] bool (True = attend) applied on top of nothing.
+    """
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m_blk = jnp.max(s, axis=-1)  # [B,H,Lq]
+    m_new = jnp.maximum(m, m_blk)
+    # Fully-masked-so-far rows keep m = NEG_INF; corrections stay 0.
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    o_new = o * corr.transpose(0, 2, 1)[..., None].astype(o.dtype) + pv
+    return o_new, l_new, m_new
+
+
+def finalize_blockwise(o, l):
+    """Normalize the accumulator; fully-masked rows return zeros."""
+    denom = l.transpose(0, 2, 1)[..., None]
+    return jnp.where(denom > 0, o / denom.astype(o.dtype), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash attention (forward).  Grid over (batch*heads, q blocks);
+# K/V streamed through VMEM in blocks.  Residuals (lse) are returned so a
+# custom VJP can recompute the backward without the [L,L] matrix.
+# ---------------------------------------------------------------------------
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale,
+                      block_k, seq_len_k):
+    import jax.experimental.pallas as pl
+
+    q = q_ref[...].astype(jnp.float32)  # [block_q, d] (block squeezed)
+    block_q = q.shape[0]
+    q_idx = pl.program_id(1)
+    q_off = q_idx * block_q
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    o = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    num_k_blocks = seq_len_k // block_k
+
+    def body(kb, carry):
+        m, l, o = carry
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[:, None] + jnp.dot(p, v_blk,
+                                            preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    if causal:
+        # Only blocks at or below the diagonal contribute.
+        last = (q_off + block_q + block_k - 1) // block_k
+        num_iter = jnp.minimum(last, num_k_blocks)
+        m, l, o = jax.lax.fori_loop(0, num_iter, body, (m, l, o))
+    else:
+        m, l, o = jax.lax.fori_loop(0, num_k_blocks, body, (m, l, o))
+
+    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Fused attention forward on TPU via Pallas. q,k,v: [B, L, H, D]."""
+    import jax.experimental.pallas as pl
+
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if lq % block_q or lk % block_k:
+        raise ValueError(f"sequence lengths ({lq},{lk}) must be multiples of "
+                         f"block sizes ({block_q},{block_k})")
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    # Fold batch and heads into the grid's first dimension.
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+
+    kernel = functools.partial(_flash_fwd_kernel, causal=causal,
+                               sm_scale=scale, block_k=block_k,
+                               seq_len_k=lk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, lq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, lk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, lk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+    )(qf, kf, vf)
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)

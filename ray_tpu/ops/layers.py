@@ -1,0 +1,53 @@
+"""Elementwise/normalization building blocks.
+
+Pure jnp: XLA fuses these into surrounding matmuls on TPU, so hand-written
+kernels would only add compile complexity (guide: let XLA fuse what it
+already fuses; Pallas for what it can't — attention, ring collectives).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=True)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               eps: float = 1e-5) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(dtype)
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    y = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return (y * scale).astype(dtype)
+
+
+def rope(q: jax.Array, k: jax.Array, positions: Optional[jax.Array] = None,
+         base: float = 10000.0) -> Tuple[jax.Array, jax.Array]:
+    """Rotary position embeddings. q,k: [B, L, H, D]."""
+    b, l, h, d = q.shape
+    if positions is None:
+        positions = jnp.arange(l)[None, :]
+    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, L, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        y1 = x1 * cos - x2 * sin
+        y2 = x2 * cos + x1 * sin
+        return jnp.stack([y1, y2], axis=-1).reshape(x.shape)
+
+    return rot(q).astype(q.dtype), rot(k).astype(k.dtype)

@@ -1,0 +1,31 @@
+"""TPU-native parallelism layer.
+
+This is the framework's replacement for the reference's entire GPU
+communication stack — ray.util.collective NCCL groups
+(python/ray/util/collective/collective_group/nccl_collective_group.py:127),
+Torch DDP process groups (python/ray/train/torch/config.py:69) and the
+multi-GPU tower logic in RLlib (rllib/execution/train_ops.py:82).  On TPU
+none of that exists as a library: communication is *in the compiled
+program* — XLA collectives (psum/all_gather/ppermute/all_to_all) over ICI,
+placed by sharding annotations on a jax.sharding.Mesh.  What this package
+provides instead:
+
+- MeshSpec / make_mesh: named logical axes {data, fsdp, model, expert,
+  sequence, pipe} over real or virtual devices,
+- sharding rules: logical-axis → mesh-axis mapping and helpers,
+- ring attention + Ulysses all-to-all sequence parallelism (shard_map),
+- pipeline parallelism with microbatching (shard_map + ppermute),
+- MeshGroup: the gang-scheduled actor group that *hosts* a multi-host mesh
+  (the TPU equivalent of Train's worker-group + process-group bootstrap).
+"""
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh, local_mesh  # noqa: F401
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    batch_sharding,
+    replicated,
+    shard_params,
+)
+from ray_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,97 @@
+"""Device meshes with named logical axes.
+
+The accelerator unit in this framework is a *mesh*, not a device (see
+package docstring).  A MeshSpec names the parallelism axes and solves their
+sizes against the available devices; `make_mesh` materializes a
+jax.sharding.Mesh laid out so that the innermost axes map to adjacent
+devices (ICI neighbours on real TPU topologies, where jax's device order
+follows the torus).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Canonical axis order: outer (slow, DCN-friendly) → inner (fast, ICI).
+# data-parallel outermost, model/tensor innermost — the layout the scaling
+# playbook prescribes so tensor-parallel collectives ride nearest-neighbour
+# ICI links.
+CANONICAL_AXES = ("pipe", "data", "fsdp", "expert", "sequence", "model")
+
+
+@dataclass
+class MeshSpec:
+    """Named axis sizes; -1 means "absorb remaining devices" (≤ one axis)."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for name in self.axes:
+            if name not in CANONICAL_AXES:
+                raise ValueError(
+                    f"unknown mesh axis {name!r}; valid: {CANONICAL_AXES}")
+        if sum(1 for v in self.axes.values() if v == -1) > 1:
+            raise ValueError("at most one axis may be -1")
+
+    def solve(self, num_devices: int) -> "MeshSpec":
+        sizes = dict(self.axes)
+        known = 1
+        wild = None
+        for k, v in sizes.items():
+            if v == -1:
+                wild = k
+            else:
+                known *= v
+        if wild is not None:
+            if num_devices % known:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild] = num_devices // known
+        else:
+            total = int(np.prod(list(sizes.values()))) if sizes else 1
+            if total != num_devices:
+                raise ValueError(
+                    f"mesh {sizes} needs {total} devices, have {num_devices}")
+        return MeshSpec(sizes)
+
+    def ordered(self) -> List[Tuple[str, int]]:
+        return [(a, self.axes[a]) for a in CANONICAL_AXES if a in self.axes]
+
+    @property
+    def size(self) -> int:
+        return int(np.prod([v for _, v in self.ordered()])) if self.axes else 1
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a jax.sharding.Mesh from a (solved) spec.
+
+    Axis order in the device array follows CANONICAL_AXES so the last axes
+    are nearest-neighbour on the ICI torus."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if -1 not in spec.axes.values() and 0 < spec.size <= len(devs):
+        devs = devs[: spec.size]  # smaller meshes use a device subset
+    spec = spec.solve(len(devs))
+    names = [a for a, _ in spec.ordered()]
+    shape = [s for _, s in spec.ordered()]
+    if not names:
+        names, shape = ["data"], [len(devs)]
+    arr = np.array(devs[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def local_mesh(**axes) -> "object":
+    """Convenience: mesh over this process's local devices.
+
+    local_mesh(data=-1) → pure DP; local_mesh(data=2, model=4) → DP×TP."""
+    return make_mesh(MeshSpec(axes))
+
+
+def host_local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()

@@ -1,0 +1,104 @@
+"""Sharding rules: logical array axes → mesh axes.
+
+The pjit idiom: parameters and activations carry *logical* axis names
+("batch", "embed", "mlp", "heads", "seq", ...), and a rule table maps those
+to mesh axes.  This replaces the reference's per-framework wrapping (DDP
+module wrap, tower splits): instead of wrapping modules, we annotate shapes
+and let XLA insert the collectives.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+# Default logical→mesh rules for transformer-family models.
+DEFAULT_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", ("data", "fsdp")),
+    ("seq", "sequence"),
+    ("embed", None),
+    ("embed_fsdp", "fsdp"),
+    ("heads", "model"),
+    ("kv", None),
+    ("mlp", "model"),
+    ("vocab", "model"),
+    ("expert", "expert"),
+    ("stage", "pipe"),
+)
+
+
+class ShardingRules:
+    def __init__(self, rules: Sequence[Tuple[str, Any]] = DEFAULT_RULES):
+        self.table: Dict[str, Any] = dict(rules)
+
+    def spec_for(self, logical_axes: Sequence[Optional[str]], mesh) -> "object":
+        """PartitionSpec for an array annotated with logical axis names.
+        Mesh axes not present in `mesh` degrade to replication, so the same
+        model code runs on 1 chip and on a pod."""
+        from jax.sharding import PartitionSpec as P
+
+        entries = []
+        for ax in logical_axes:
+            if ax is None:
+                entries.append(None)
+                continue
+            mapped = self.table.get(ax)
+            if mapped is None:
+                entries.append(None)
+            elif isinstance(mapped, tuple):
+                present = tuple(m for m in mapped if m in mesh.axis_names)
+                entries.append(present if present else None)
+            else:
+                entries.append(mapped if mapped in mesh.axis_names else None)
+        return P(*entries)
+
+    def sharding_for(self, logical_axes: Sequence[Optional[str]], mesh):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(mesh, self.spec_for(logical_axes, mesh))
+
+
+def batch_sharding(mesh, ndim: int = 2):
+    """Shard dim-0 over the data axes; replicate the rest."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    return NamedSharding(mesh, P(data_axes if data_axes else None,
+                                 *([None] * (ndim - 1))))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params, mesh, rules: Optional[ShardingRules] = None,
+                 annotations=None):
+    """Place a parameter pytree on the mesh.
+
+    `annotations` is a matching pytree of logical-axis tuples (or None for
+    replicated).  Without annotations, everything is replicated — correct,
+    just not memory-scaled (pure DP)."""
+    import jax
+
+    rules = rules or ShardingRules()
+
+    if annotations is None:
+        sharding = replicated(mesh)
+        return jax.device_put(params, sharding)
+
+    def place(leaf, ann):
+        s = (rules.sharding_for(ann, mesh) if ann is not None
+             else replicated(mesh))
+        return jax.device_put(leaf, s)
+
+    return jax.tree_util.tree_map(place, params, annotations,
+                                  is_leaf=lambda x: x is None)
+
+
+def constraint(x, logical_axes, mesh, rules: Optional[ShardingRules] = None):
+    """with_sharding_constraint by logical axis names (inside jit)."""
+    import jax
+
+    rules = rules or ShardingRules()
+    return jax.lax.with_sharding_constraint(
+        x, rules.sharding_for(logical_axes, mesh))
