@@ -1,0 +1,13 @@
+"""AIR-equivalent glue: configs, Checkpoint, session, Result.
+
+Reference: python/ray/air/ (Checkpoint air/checkpoint.py:63, configs
+air/config.py:79-670, session air/session.py:41)."""
+from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result  # noqa: F401
+from ray_tpu.air import session  # noqa: F401

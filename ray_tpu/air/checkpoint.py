@@ -1,0 +1,96 @@
+"""Universal Checkpoint: dict ⇄ directory, with native jax-pytree support.
+
+Reference: ray.air.Checkpoint (python/ray/air/checkpoint.py:63) — the
+dict/directory/URI-interconvertible checkpoint that flows worker → driver →
+tune → storage.  The TPU-native addition is first-class jax pytrees:
+`from_pytree/to_pytree` store arrays via flax.serialization (msgpack) so
+device arrays round-trip without pickling device buffers; large trees can
+use orbax under the same interface.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+_PYTREE_FILE = "pytree.msgpack"
+_DICT_FILE = "checkpoint.pkl"
+
+
+class Checkpoint:
+    def __init__(self, data: Optional[Dict[str, Any]] = None,
+                 directory: Optional[str] = None):
+        if (data is None) == (directory is None):
+            raise ValueError("exactly one of data / directory required")
+        self._data = data
+        self._dir = directory
+
+    # ---- constructors ----
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(directory=path)
+
+    @classmethod
+    def from_pytree(cls, tree: Any, extra: Optional[dict] = None) -> "Checkpoint":
+        """Store a jax/flax pytree (host-transferred, msgpack-serialized)."""
+        import jax
+        from flax import serialization
+
+        host_tree = jax.device_get(tree)
+        return cls(data={"__pytree__": serialization.to_bytes(host_tree),
+                         "__template__": pickle.dumps(
+                             jax.tree_util.tree_map(lambda x: None, host_tree)),
+                         **(extra or {})})
+
+    # ---- accessors ----
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        path = os.path.join(self._dir, _DICT_FILE)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return pickle.load(f)
+        out: Dict[str, Any] = {}
+        pt = os.path.join(self._dir, _PYTREE_FILE)
+        if os.path.exists(pt):
+            with open(pt, "rb") as f:
+                out["__pytree__"] = f.read()
+        return out
+
+    def to_pytree(self, target: Any = None) -> Any:
+        """Restore the stored pytree; `target` provides the structure (else
+        the stored structure template is used)."""
+        from flax import serialization
+
+        data = self.to_dict()
+        blob = data["__pytree__"]
+        if target is None:
+            target = pickle.loads(data["__template__"])
+        return serialization.from_bytes(target, blob)
+
+    def extra(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.to_dict().items()
+                if k not in ("__pytree__", "__template__")}
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        path = path or os.path.join(tempfile.gettempdir(),
+                                    f"rtpu_ckpt_{uuid.uuid4().hex[:8]}")
+        os.makedirs(path, exist_ok=True)
+        if self._dir is not None:
+            if os.path.abspath(self._dir) != os.path.abspath(path):
+                shutil.copytree(self._dir, path, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(path, _DICT_FILE), "wb") as f:
+                pickle.dump(self._data, f)
+        return path
+
+    def __repr__(self):
+        kind = "dict" if self._data is not None else f"dir:{self._dir}"
+        return f"Checkpoint({kind})"

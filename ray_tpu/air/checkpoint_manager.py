@@ -1,0 +1,41 @@
+"""Top-K checkpoint retention (reference: air/_internal/checkpoint_manager.py
+:233 — keep best K by score attribute, delete the rest)."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, config: Optional[CheckpointConfig] = None):
+        self.config = config or CheckpointConfig()
+        # (score, seq, checkpoint, metrics)
+        self._entries: List[Tuple[float, int, Checkpoint, dict]] = []
+        self._seq = 0
+        self.latest: Optional[Checkpoint] = None
+
+    def register(self, checkpoint: Checkpoint, metrics: dict):
+        self._seq += 1
+        self.latest = checkpoint
+        attr = self.config.checkpoint_score_attribute
+        score = float(metrics.get(attr, self._seq)) if attr else float(self._seq)
+        if self.config.checkpoint_score_order == "min":
+            score = -score
+        self._entries.append((score, self._seq, checkpoint, dict(metrics)))
+        self._entries.sort(key=lambda e: (e[0], e[1]))
+        k = self.config.num_to_keep
+        if k is not None and len(self._entries) > k:
+            self._entries = self._entries[-k:]
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        return self._entries[-1][2] if self._entries else None
+
+    @property
+    def best_metrics(self) -> Optional[dict]:
+        return self._entries[-1][3] if self._entries else None
+
+    def checkpoints(self) -> List[Checkpoint]:
+        return [e[2] for e in self._entries]

@@ -1,0 +1,62 @@
+"""Run/scaling/failure/checkpoint configs (reference: python/ray/air/config.py
+ScalingConfig :79, FailureConfig :483, CheckpointConfig :542, RunConfig :670).
+
+TPU-specific: ScalingConfig speaks in *hosts* and *chips* and carries a
+MeshSpec — a "worker" is one process per TPU host and the real parallelism
+layout lives in the mesh axes, not in worker count.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """num_workers = processes (1 per TPU host). use_tpu selects the chip
+    resource; chips_per_worker reserves them; mesh describes the logical
+    parallelism over ALL chips of the group."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    mesh: Optional[MeshSpec] = None
+    placement_strategy: str = "PACK"
+
+    # Reference-compat alias (trainer_resources etc. intentionally dropped).
+    @property
+    def num_tpus_per_worker(self) -> int:
+        return self.chips_per_worker
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and self.chips_per_worker:
+            res["TPU"] = float(self.chips_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # -1 = unlimited trial retries
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # local dir (cloud URI round-2)
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1

@@ -1,0 +1,12 @@
+"""Ray-Train-equivalent distributed training (reference: python/ray/train/)."""
+from ray_tpu.train.backend import Backend, BackendConfig, TestConfig  # noqa: F401
+from ray_tpu.train.base_trainer import (  # noqa: F401
+    BaseTrainer,
+    DataParallelTrainer,
+)
+from ray_tpu.train.jax import JaxConfig, JaxTrainer  # noqa: F401
+from ray_tpu.train._internal.backend_executor import (  # noqa: F401
+    BackendExecutor,
+    TrainingWorkerError,
+)
+from ray_tpu.train._internal.worker_group import WorkerGroup  # noqa: F401

@@ -1,0 +1,106 @@
+"""BackendExecutor: placement group + worker group + rendezvous + training
+loop results (reference: python/ray/train/_internal/backend_executor.py:43 —
+PG creation :138, rank assignment :245, start_training :315; restart :571).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.util.placement_group import (
+    placement_group as _create_pg,
+    remove_placement_group as _remove_pg,
+)
+
+
+class TrainingWorkerError(Exception):
+    def __init__(self, cause, tb: str):
+        self.cause = cause
+        self.tb = tb
+        super().__init__(f"training worker failed:\n{tb}")
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: ScalingConfig):
+        self.backend_config = backend_config
+        self.backend: Backend = backend_config.backend_cls()()
+        self.scaling = scaling_config
+        self.worker_group: Optional[WorkerGroup] = None
+        self.pg = None
+
+    def start(self):
+        res = self.scaling.worker_resources()
+        if self.scaling.num_workers > 1:
+            bundles = [dict(res) for _ in range(self.scaling.num_workers)]
+            self.pg = _create_pg(
+                bundles, strategy=self.scaling.placement_strategy)
+            self.pg.ready(timeout=60)
+        self.worker_group = WorkerGroup(self.scaling.num_workers, res, self.pg)
+        # Rendezvous env: worker 0 is the jax.distributed coordinator.
+        infos = ray_tpu.get([w.node_info.remote()
+                             for w in self.worker_group.workers])
+        coordinator = f"{infos[0]['host']}:{_free_port()}"
+        env = {
+            "RTPU_COORDINATOR": coordinator,
+            "RTPU_WORLD_SIZE": str(self.scaling.num_workers),
+        }
+        ray_tpu.get([
+            w.setup_env.remote({**env, "RTPU_RANK": str(i)})
+            for i, w in enumerate(self.worker_group.workers)
+        ])
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(self, train_fn: Callable, config: dict,
+                       checkpoint: Optional[Checkpoint] = None,
+                       dataset_shards: Optional[List[dict]] = None):
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        ray_tpu.get([
+            w.start_training.remote(
+                train_fn, config, checkpoint,
+                dataset_shards[i] if dataset_shards else None)
+            for i, w in enumerate(self.worker_group.workers)
+        ])
+
+    def get_next_results(self, timeout: float = 600.0) -> Optional[List[tuple]]:
+        """Blocks for one result per worker. Returns None when all done.
+        Raises TrainingWorkerError on any worker error (reference surfaces
+        the first failure the same way)."""
+        results = ray_tpu.get([w.next_result.remote(timeout)
+                               for w in self.worker_group.workers])
+        kinds = {r[0] for r in results}
+        if "error" in kinds:
+            for r in results:
+                if r[0] == "error":
+                    raise TrainingWorkerError(r[1], r[2])
+        if kinds == {"done"}:
+            return None
+        if "timeout" in kinds:
+            raise TimeoutError("training workers produced no result in time")
+        return results
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group, self.backend_config)
+            self.worker_group.shutdown()
+            self.worker_group = None
+        if self.pg is not None:
+            try:
+                _remove_pg(self.pg)
+            except Exception:
+                pass
+            self.pg = None
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,133 @@
+"""WorkerGroup: the gang of training-worker actors.
+
+Reference: python/ray/train/_internal/worker_group.py:92 (plain actors with
+execute/execute_async).  Here each worker is a TrainWorker actor
+(max_concurrency=2 so result polling overlaps the training thread), spawned
+under a placement group for gang scheduling — on TPU this is the unit that
+*hosts a mesh*: one worker per TPU host.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air import session as air_session
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """Actor hosting one training process (one TPU host's worth of chips)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._results: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._env: Dict[str, str] = {}
+
+    def setup_env(self, env: Dict[str, str]):
+        import os
+
+        self._env.update(env)
+        os.environ.update(env)
+        return True
+
+    def node_info(self) -> dict:
+        import os
+        import socket
+
+        return {"rank": self.rank, "pid": os.getpid(),
+                "host": socket.gethostname()}
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function in the worker (reference
+        WorkerGroup.execute)."""
+        return fn(*args, **kwargs)
+
+    def start_training(self, train_fn: Callable, config: dict,
+                      checkpoint: Optional[Checkpoint],
+                      dataset_shards: Optional[dict] = None) -> bool:
+        """Launch the user loop in a thread; results flow via next_result."""
+
+        def report_fn(metrics, ckpt):
+            self._results.put(("report", metrics, ckpt))
+
+        def run():
+            import inspect
+
+            from ray_tpu.air import session as air_session
+
+            air_session.init_session(
+                report_fn=report_fn, world_rank=self.rank,
+                world_size=self.world_size, checkpoint=checkpoint,
+                dataset_shards=dataset_shards)
+            try:
+                wants_arg = True
+                try:
+                    wants_arg = len(inspect.signature(train_fn).parameters) >= 1
+                except (TypeError, ValueError):
+                    pass
+                out = train_fn(config) if wants_arg else train_fn()
+                self._results.put(("done", out, None))
+            except BaseException as e:  # noqa: BLE001 — shipped to driver
+                import traceback
+
+                self._results.put(("error", e, traceback.format_exc()))
+            finally:
+                air_session.shutdown_session()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="train-loop")
+        self._thread.start()
+        return True
+
+    def next_result(self, timeout: float = 3600.0):
+        try:
+            return self._results.get(timeout=timeout)
+        except queue.Empty:
+            return ("timeout", None, None)
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
+                 placement_group=None):
+        opts: Dict[str, Any] = {"max_concurrency": 2}
+        cpu = resources_per_worker.get("CPU", 1.0)
+        opts["num_cpus"] = cpu
+        if resources_per_worker.get("TPU"):
+            opts["num_tpus"] = resources_per_worker["TPU"]
+        extra = {k: v for k, v in resources_per_worker.items()
+                 if k not in ("CPU", "TPU")}
+        if extra:
+            opts["resources"] = extra
+        if placement_group is not None:
+            from ray_tpu.util import PlacementGroupSchedulingStrategy
+
+            opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                placement_group)
+        self.workers = [
+            TrainWorker.options(**opts).remote(rank, num_workers)
+            for rank in range(num_workers)
+        ]
+        self.num_workers = num_workers
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get([w.execute.remote(fn, *args, **kwargs)
+                            for w in self.workers])
+
+    def execute_async(self, fn: Callable, *args, **kwargs):
+        return [w.execute.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(self.workers[rank].execute.remote(fn, *args, **kwargs))
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -1,0 +1,31 @@
+"""Backend interface (reference: python/ray/train/backend.py Backend/
+BackendConfig; the torch/NCCL impl it replaces: train/torch/config.py:69)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks run by the BackendExecutor around worker-group lifetime."""
+
+    def on_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_training_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig):
+        pass
+
+
+class TestConfig(BackendConfig):
+    """No-op backend for executor tests (reference:
+    python/ray/train/tests/test_backend.py:45)."""
+
+    def backend_cls(self):
+        return Backend

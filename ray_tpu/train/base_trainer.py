@@ -1,0 +1,135 @@
+"""BaseTrainer / DataParallelTrainer.
+
+Reference: python/ray/train/base_trainer.py:39 (fit :344) and
+data_parallel_trainer.py:56 (training_loop :347).  One deliberate
+divergence: the reference routes EVERY fit() through Tune
+(base_trainer.py:344-363 constructs a Tuner even for a single run); here
+fit() drives the executor directly and `as_trainable()` provides the Tune
+integration — same capability, less layering in the common path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.checkpoint_manager import CheckpointManager
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train._internal.backend_executor import (
+    BackendExecutor,
+    TrainingWorkerError,
+)
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        failure = self.run_config.failure_config or FailureConfig()
+        attempts = max(1, failure.max_failures + 1) \
+            if failure.max_failures >= 0 else 10**9
+        last_error: Optional[BaseException] = None
+        checkpoint = self.resume_from_checkpoint
+        for _ in range(attempts):
+            try:
+                return self._run(checkpoint)
+            except TrainingWorkerError as e:
+                last_error = e
+                # Elastic restart resumes from the latest checkpoint.
+                checkpoint = getattr(self, "_latest_checkpoint", checkpoint)
+        return Result(metrics={}, checkpoint=checkpoint, error=last_error)
+
+    def _run(self, checkpoint: Optional[Checkpoint]) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Wrap for Tune: returns a function-trainable closing over self
+        (reference: TrainTrainable wrapper, base_trainer.py:431)."""
+        trainer = self
+
+        def train_func(config: Dict[str, Any]):
+            from ray_tpu.air import session
+
+            t = trainer.with_updated_config(config)
+            result = t.fit()
+            if result.error:
+                raise result.error
+            session.report(result.metrics, checkpoint=result.checkpoint)
+
+        return train_func
+
+    def with_updated_config(self, config: Dict[str, Any]) -> "BaseTrainer":
+        return self
+
+
+class DataParallelTrainer(BaseTrainer):
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or BackendConfig()
+        self.datasets = datasets or {}
+
+    def with_updated_config(self, config: Dict[str, Any]):
+        import copy
+
+        t = copy.copy(self)
+        t.train_loop_config = {**self.train_loop_config, **config}
+        return t
+
+    def _run(self, checkpoint: Optional[Checkpoint]) -> Result:
+        executor = BackendExecutor(self.backend_config, self.scaling_config)
+        ckpt_mgr = CheckpointManager(self.run_config.checkpoint_config)
+        history = []
+        final_metrics: Dict[str, Any] = {}
+        try:
+            executor.start()
+            shards = self._dataset_shards(self.scaling_config.num_workers)
+            executor.start_training(self.train_loop_per_worker,
+                                    self.train_loop_config, checkpoint, shards)
+            stop = self.run_config.stop or {}
+            while True:
+                results = executor.get_next_results()
+                if results is None:
+                    break
+                # rank-0 metrics are canonical (all ranks report in lockstep).
+                kind, metrics, ckpt = results[0]
+                if kind != "report":
+                    continue
+                for _, _, c in results:
+                    if c is not None:
+                        ckpt_mgr.register(c, metrics)
+                        self._latest_checkpoint = c
+                final_metrics = metrics
+                history.append(metrics)
+                if any(metrics.get(k) is not None and metrics[k] >= v
+                       for k, v in stop.items()):
+                    break
+        finally:
+            executor.shutdown()
+        return Result(metrics=final_metrics,
+                      checkpoint=ckpt_mgr.latest or checkpoint,
+                      metrics_history=history)
+
+    def _dataset_shards(self, n: int):
+        if not self.datasets:
+            return None
+        shards = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "split"):
+                for i, piece in enumerate(ds.split(n, equal=True)):
+                    shards[i][name] = piece
+            else:
+                for i in range(n):
+                    shards[i][name] = ds
+        return shards

@@ -1,0 +1,20 @@
+"""JAX trainer (the framework's TorchTrainer equivalent — reference:
+python/ray/train/torch/torch_trainer.py)."""
+from ray_tpu.train.jax.config import JaxConfig  # noqa: F401
+from ray_tpu.train.jax.train_loop_utils import (  # noqa: F401
+    get_mesh,
+    prepare_batch,
+    prepare_train_state,
+)
+from ray_tpu.train.base_trainer import DataParallelTrainer
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the jax.distributed backend.
+
+    The per-worker loop runs a pjit/shard_map program over the group's
+    mesh; gradients ride XLA collectives, not the object store."""
+
+    def __init__(self, train_loop_per_worker, *, jax_config=None, **kw):
+        kw.setdefault("backend_config", jax_config or JaxConfig())
+        super().__init__(train_loop_per_worker, **kw)

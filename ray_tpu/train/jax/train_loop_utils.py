@@ -1,0 +1,35 @@
+"""In-loop helpers (reference: python/ray/train/torch/train_loop_utils.py —
+prepare_model DDP wrap, prepare_data_loader).  The TPU equivalents don't
+wrap modules; they build the mesh and place arrays.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.parallel.sharding import ShardingRules, batch_sharding, shard_params
+
+
+def get_mesh(spec: Optional[MeshSpec] = None):
+    """Mesh over all devices visible to this training group.
+
+    After jax.distributed.initialize (multi-host), jax.devices() spans the
+    whole group, so the same call yields the global mesh on every worker."""
+    return make_mesh(spec or MeshSpec({"data": -1}))
+
+
+def prepare_train_state(params: Any, mesh, annotations=None,
+                        rules: Optional[ShardingRules] = None):
+    """Place params on the mesh (replicated or by logical-axis annotation) —
+    the moral equivalent of prepare_model's DDP wrap."""
+    return shard_params(params, mesh, rules, annotations)
+
+
+def prepare_batch(batch: Any, mesh):
+    """Shard a host batch's leading dim over the data axes."""
+    import jax
+
+    def place(x):
+        return jax.device_put(x, batch_sharding(mesh, getattr(x, "ndim", 1)))
+
+    return jax.tree_util.tree_map(place, batch)

@@ -1,0 +1,18 @@
+"""Tune-equivalent hyperparameter tuning (reference: python/ray/tune/)."""
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search.sample import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run  # noqa: F401
+
+ASHAScheduler = AsyncHyperBandScheduler

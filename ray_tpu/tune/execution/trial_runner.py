@@ -1,0 +1,191 @@
+"""TrialRunner: the Tune execution engine.
+
+Reference: python/ray/tune/execution/trial_runner.py:268 (step :931) +
+RayTrialExecutor (ray_trial_executor.py:191).  Each trial runs as a
+_TrialActor (a remote actor executing the trainable function in a thread and
+streaming reports through a queue — same mechanism as Train's TrainWorker).
+The runner multiplexes trial results with ray_tpu.wait, feeds the scheduler,
+and applies STOP/exploit decisions.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.tune import trial as trial_mod
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.trial import ERROR, PENDING, RUNNING, TERMINATED, Trial
+
+
+@ray_tpu.remote
+class _TrialActor:
+    def __init__(self, fn, config: dict, checkpoint=None):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+
+        def report_fn(metrics, ckpt):
+            self._q.put(("report", metrics, ckpt))
+            if self._stop.is_set():
+                raise SystemExit  # cooperative stop at next report
+
+        def run():
+            from ray_tpu.air import session as air_session
+
+            air_session.init_session(report_fn=report_fn,
+                                     checkpoint=checkpoint)
+            try:
+                import inspect
+
+                params = []
+                try:
+                    params = list(inspect.signature(fn).parameters)
+                except (TypeError, ValueError):
+                    pass
+                out = fn(config) if params else fn()
+                self._q.put(("done", out, None))
+            except SystemExit:
+                self._q.put(("done", None, None))
+            except BaseException as e:  # noqa: BLE001
+                import traceback as tb
+
+                self._q.put(("error", e, tb.format_exc()))
+            finally:
+                air_session.shutdown_session()
+
+        threading.Thread(target=run, daemon=True, name="trial").start()
+
+    def next_result(self, timeout: float = 600.0):
+        import queue
+
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return ("timeout", None, None)
+
+    def request_stop(self):
+        self._stop.set()
+        return True
+
+
+class TrialRunner:
+    def __init__(self, trainable: Callable, trials: List[Trial],
+                 scheduler: Optional[TrialScheduler] = None,
+                 max_concurrent: Optional[int] = None,
+                 max_failures: int = 0,
+                 stop: Optional[Dict[str, Any]] = None,
+                 metric: Optional[str] = None, mode: str = "max"):
+        self.trainable = trainable
+        self.trials = trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent or 4
+        self.max_failures = max_failures
+        self.stop_criteria = stop or {}
+        self.metric = metric
+        self.mode = mode
+
+    # ---- PBT hook ----
+    def exploit(self, trial: Trial, source: Trial, new_config: dict):
+        """Replace `trial` with a clone of `source` (checkpoint + mutated
+        config) — requires trainables that honor session.get_checkpoint."""
+        if source.checkpoint is None:
+            return
+        self._stop_actor(trial)
+        trial.config = new_config
+        trial.checkpoint = source.checkpoint
+        trial.rungs_passed = set()
+        self._launch(trial)
+
+    # ---- execution ----
+    def _launch(self, trial: Trial):
+        trial.status = RUNNING
+        trial.actor = _TrialActor.options(max_concurrency=2).remote(
+            self.trainable, trial.config, trial.checkpoint)
+
+    def _stop_actor(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def run(self) -> List[Trial]:
+        pending = [t for t in self.trials if t.status == PENDING]
+        active: Dict[Any, tuple] = {}  # future -> (trial, actor-at-poll-time)
+
+        def poll(trial: Trial):
+            fut = trial.actor.next_result.remote(timeout=600.0)
+            active[fut] = (trial, trial.actor)
+
+        while pending or active:
+            while pending and len({t[0].id for t in active.values()}) \
+                    < self.max_concurrent:
+                t = pending.pop(0)
+                self._launch(t)
+                poll(t)
+            if not active:
+                continue
+            ready, _ = ray_tpu.wait(list(active.keys()), num_returns=1,
+                                    timeout=60.0)
+            if not ready:
+                continue
+            fut = ready[0]
+            trial, actor = active.pop(fut)
+            if trial.actor is not actor:
+                # Stale future from a pre-exploit actor: poll the new one.
+                if trial.actor is not None:
+                    poll(trial)
+                continue
+            try:
+                kind, payload, extra = ray_tpu.get(fut)
+            except Exception as e:  # actor died
+                self._on_trial_error(trial, e, pending)
+                continue
+            if kind == "report":
+                trial.last_result = payload
+                trial.metrics_history.append(payload)
+                if extra is not None:
+                    trial.checkpoint = extra
+                decision = self.scheduler.on_trial_result(self, trial, payload)
+                if self._hit_stop_criteria(payload) or decision == STOP:
+                    self._terminate(trial)
+                elif trial.actor is not None:
+                    poll(trial)
+            elif kind == "done":
+                trial.status = TERMINATED
+                self.scheduler.on_trial_complete(self, trial,
+                                                 trial.last_result)
+                self._stop_actor(trial)
+            elif kind == "error":
+                self._on_trial_error(
+                    trial, payload if isinstance(payload, BaseException)
+                    else RuntimeError(str(extra)), pending)
+            elif kind == "timeout":
+                poll(trial)
+        return self.trials
+
+    def _terminate(self, trial: Trial):
+        trial.status = TERMINATED
+        self.scheduler.on_trial_complete(self, trial, trial.last_result)
+        self._stop_actor(trial)
+
+    def _on_trial_error(self, trial: Trial, error: BaseException,
+                        pending: List[Trial]):
+        self._stop_actor(trial)
+        trial.num_failures += 1
+        if self.max_failures < 0 or trial.num_failures <= self.max_failures:
+            trial.status = PENDING
+            pending.append(trial)  # retry (restores last checkpoint)
+        else:
+            trial.status = ERROR
+            trial.error = error
+
+    def _hit_stop_criteria(self, result: Dict[str, Any]) -> bool:
+        return any(result.get(k) is not None and result[k] >= v
+                   for k, v in self.stop_criteria.items())

@@ -1,0 +1,118 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Reference: python/ray/tune/schedulers/ — ASHA (async_hyperband.py:17, rung
+cutoff quantiles at :138,220), PBT (pbt.py: exploit top quantile :791,
+explore/mutate :48, quantiles :868).
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, runner, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial, result):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: rung-based async successive halving."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3.0):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # Rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.milestones: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        # rung milestone -> list of recorded metric values
+        self.rungs: Dict[float, List[float]] = {m: [] for m in self.milestones}
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = result.get(self.time_attr)
+        score = result.get(self.metric)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        value = score if self.mode == "max" else -score
+        for m in self.milestones:
+            if t >= m and m not in trial.rungs_passed:
+                trial.rungs_passed.add(m)
+                recorded = self.rungs[m]
+                recorded.append(value)
+                if len(recorded) >= max(2, int(self.rf)):
+                    top_k = max(1, int(len(recorded) / self.rf))
+                    cutoff = sorted(recorded, reverse=True)[top_k - 1]
+                    if value < cutoff:
+                        return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: bottom-quantile trials clone a top trial's checkpoint and mutate
+    hyperparameters.  Requires trials to report checkpoints."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: int = 0):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self.last_perturb: Dict[str, float] = {}
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        if t - self.last_perturb.get(trial.id, 0) < self.interval:
+            return CONTINUE
+        self.last_perturb[trial.id] = t
+        trials = [tr for tr in runner.trials if tr.last_result]
+        if len(trials) < 2:
+            return CONTINUE
+        key = lambda tr: tr.last_result.get(self.metric, -math.inf) \
+            * (1 if self.mode == "max" else -1)
+        ranked = sorted(trials, key=key)
+        n_q = max(1, int(len(ranked) * self.quantile))
+        bottom = ranked[:n_q]
+        top = ranked[-n_q:]
+        if trial in bottom:
+            source = self.rng.choice(top)
+            if source is trial:
+                return CONTINUE
+            new_config = dict(source.config)
+            for name, mut in self.mutations.items():
+                old = new_config.get(name)
+                if isinstance(mut, list):
+                    new_config[name] = self.rng.choice(mut)
+                elif callable(mut):
+                    new_config[name] = mut()
+                elif old is not None:
+                    factor = self.rng.choice([0.8, 1.2])
+                    new_config[name] = old * factor
+            runner.exploit(trial, source, new_config)
+        return CONTINUE

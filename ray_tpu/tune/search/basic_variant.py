@@ -1,0 +1,63 @@
+"""Grid/random variant generation (reference: python/ray/tune/search/
+basic_variant.py + variant_generator.py)."""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List
+
+from ray_tpu.tune.search.sample import Domain
+
+
+def _split_space(space: Dict[str, Any], prefix=()):
+    """Yield (path, spec) leaves; dicts recurse."""
+    for k, v in space.items():
+        path = prefix + (k,)
+        if isinstance(v, dict) and "grid_search" in v and len(v) == 1:
+            yield path, ("grid", v["grid_search"])
+        elif isinstance(v, dict):
+            yield from _split_space(v, path)
+        elif isinstance(v, Domain):
+            yield path, ("sample", v)
+        else:
+            yield path, ("const", v)
+
+
+def _set_path(d: dict, path, value):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(space: Dict[str, Any], num_samples: int = 1,
+                      seed: int | None = None) -> Iterator[Dict[str, Any]]:
+    """Cross product of grid axes × num_samples draws of stochastic axes."""
+    rng = random.Random(seed)
+    leaves = list(_split_space(space or {}))
+    grid_axes = [(p, vals) for p, (kind, vals) in leaves if kind == "grid"]
+    grids = itertools.product(*[vals for _, vals in grid_axes]) \
+        if grid_axes else [()]
+    for grid_combo in grids:
+        for _ in range(num_samples):
+            cfg: Dict[str, Any] = {}
+            for (p, (kind, v)) in leaves:
+                if kind == "const":
+                    _set_path(cfg, p, v)
+                elif kind == "sample":
+                    _set_path(cfg, p, v.sample(rng))
+            for (p, _), val in zip(grid_axes, grid_combo):
+                _set_path(cfg, p, val)
+            yield cfg
+
+
+class BasicVariantGenerator:
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: int | None = None):
+        self._variants: List[Dict[str, Any]] = list(
+            generate_variants(space, num_samples, seed))
+
+    def __iter__(self):
+        return iter(self._variants)
+
+    def __len__(self):
+        return len(self._variants)

@@ -1,0 +1,29 @@
+"""Trial state (reference: python/ray/tune/experiment/trial.py)."""
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, config: Dict[str, Any], trial_id: Optional[str] = None):
+        self.id = trial_id or uuid.uuid4().hex[:8]
+        self.config = config
+        self.status = PENDING
+        self.last_result: Dict[str, Any] = {}
+        self.metrics_history: list = []
+        self.checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[BaseException] = None
+        self.actor = None
+        self.num_failures = 0
+        self.rungs_passed: set = set()  # ASHA bookkeeping
+
+    def __repr__(self):
+        return f"Trial({self.id}, {self.status}, {self.config})"

@@ -1,0 +1,129 @@
+"""Tuner / tune.run / ResultGrid (reference: python/ray/tune/tuner.py:44,
+tune/tune.py:164, tune/result_grid.py)."""
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.result import Result
+from ray_tpu.tune.execution.trial_runner import TrialRunner
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.trial import ERROR, TERMINATED, Trial
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Any] = None  # BasicVariantGenerator default
+    seed: Optional[int] = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str = "max"):
+        self.trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __getitem__(self, i) -> Result:
+        t = self.trials[i]
+        return Result(metrics=t.last_result, checkpoint=t.checkpoint,
+                      error=t.error, metrics_history=t.metrics_history)
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [t.error for t in self.trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required")
+        sign = 1 if mode == "max" else -1
+        done = [t for t in self.trials if t.last_result.get(metric) is not None]
+        if not done:
+            raise ValueError("no trial reported the metric")
+        best = max(done, key=lambda t: sign * t.last_result[metric])
+        return Result(metrics=best.last_result, checkpoint=best.checkpoint,
+                      metrics_history=best.metrics_history)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([{"trial_id": t.id, **t.config, **t.last_result}
+                             for t in self.trials])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable,
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
+        # Trainers (BaseTrainer) are adapted via as_trainable().
+        from ray_tpu.train.base_trainer import BaseTrainer
+
+        if isinstance(trainable, BaseTrainer):
+            trainable = trainable.as_trainable()
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        gen = tc.search_alg or BasicVariantGenerator(
+            self.param_space, tc.num_samples, tc.seed)
+        trials = [Trial(cfg) for cfg in gen]
+        stop = getattr(self.run_config, "stop", None) if self.run_config else None
+        failure = getattr(self.run_config, "failure_config", None) \
+            if self.run_config else None
+        runner = TrialRunner(
+            self.trainable, trials, scheduler=tc.scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            max_failures=failure.max_failures if failure else 0,
+            stop=stop, metric=tc.metric, mode=tc.mode)
+        runner.run()
+        self._save_experiment_state(trials)
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+    def _save_experiment_state(self, trials: List[Trial]):
+        run = self.run_config
+        path = getattr(run, "storage_path", None) if run else None
+        if not path:
+            return
+        name = getattr(run, "name", None) or "experiment"
+        os.makedirs(os.path.join(path, name), exist_ok=True)
+        state = [{
+            "id": t.id, "config": t.config, "status": t.status,
+            "last_result": t.last_result, "error": repr(t.error) if t.error else None,
+        } for t in trials]
+        with open(os.path.join(path, name, "experiment_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+
+
+def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, scheduler: Optional[TrialScheduler] = None,
+        metric: Optional[str] = None, mode: str = "max",
+        stop: Optional[Dict[str, Any]] = None,
+        max_concurrent_trials: Optional[int] = None) -> ResultGrid:
+    """tune.run-style entry point (reference: python/ray/tune/tune.py:164)."""
+    from ray_tpu.air.config import RunConfig
+
+    tuner = Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler,
+                               max_concurrent_trials=max_concurrent_trials),
+        run_config=RunConfig(stop=stop))
+    return tuner.fit()

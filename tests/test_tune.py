@@ -1,0 +1,124 @@
+"""Tune tests (modeled on python/ray/tune/tests/ mock-trainable patterns)."""
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import Checkpoint, RunConfig, session
+from ray_tpu.air.config import FailureConfig
+from ray_tpu.tune.search.basic_variant import generate_variants
+
+
+def test_generate_variants_grid_and_random():
+    space = {"lr": tune.grid_search([0.1, 0.01]),
+             "wd": tune.uniform(0, 1),
+             "opt": "adam"}
+    variants = list(generate_variants(space, num_samples=3, seed=0))
+    assert len(variants) == 6
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(v["opt"] == "adam" for v in variants)
+    assert all(0 <= v["wd"] <= 1 for v in variants)
+
+
+def test_tuner_grid_search(ray_start_regular):
+    def objective(config):
+        session.report({"score": -(config["x"] - 3) ** 2,
+                        "training_iteration": 1})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"))
+    results = tuner.fit()
+    assert len(results) == 5
+    best = results.get_best_result()
+    assert best.metrics["score"] == 0
+
+
+def test_tuner_with_failures_retries(ray_start_regular):
+    import os
+
+    marker = "/tmp/rtpu_tune_fail"
+    if os.path.exists(marker):
+        os.remove(marker)
+
+    def flaky(config):
+        import os
+
+        if config["x"] == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            raise RuntimeError("boom")
+        session.report({"score": config["x"], "training_iteration": 1})
+
+    tuner = tune.Tuner(
+        flaky, param_space={"x": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=1)))
+    results = tuner.fit()
+    assert not results.errors
+    assert results.get_best_result().metrics["score"] == 1
+
+
+def test_asha_stops_bad_trials(ray_start_regular):
+    def objective(config):
+        for i in range(1, 13):
+            session.report({"score": config["q"] * i,
+                            "training_iteration": i})
+
+    # Strong trials first: ASHA is async, so a weak trial is only cut when
+    # it reports into a rung that already has stronger entries.
+    sched = tune.ASHAScheduler(metric="score", mode="max", max_t=12,
+                               grace_period=2, reduction_factor=2)
+    tuner = tune.Tuner(
+        objective, param_space={"q": tune.grid_search([4, 3, 2, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=2))
+    results = tuner.fit()
+    # The best trial must finish; at least one bad one should be stopped early.
+    best = results.get_best_result()
+    assert best.metrics["score"] == 4 * 12
+    iters = [r.metrics.get("training_iteration", 0) for r in
+             [results[i] for i in range(len(results))]]
+    assert min(iters) < 12
+
+
+def test_pbt_exploits_checkpoint(ray_start_regular):
+    def objective(config):
+        ckpt = session.get_checkpoint()
+        level = ckpt.to_dict()["level"] if ckpt else 0
+        for i in range(1, 20):
+            level += config["rate"]
+            session.report({"score": level, "training_iteration": i},
+                           checkpoint=Checkpoint.from_dict({"level": level}))
+
+    sched = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=5,
+        hyperparam_mutations={"rate": [1, 5]})
+    tuner = tune.Tuner(
+        objective, param_space={"rate": tune.grid_search([1, 5])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=2))
+    results = tuner.fit()
+    assert not results.errors
+    # Exploitation should pull the slow trial up toward the fast one.
+    scores = sorted(r.metrics["score"] for r in
+                    [results[i] for i in range(len(results))])
+    assert scores[-1] >= 5 * 19 * 0.8
+
+
+def test_tuner_over_trainer(ray_start_regular):
+    """Tuner(trainer) integration (reference: BaseTrainer.as_trainable)."""
+    from ray_tpu.train import DataParallelTrainer, TestConfig
+    from ray_tpu.air import ScalingConfig
+
+    def loop(config):
+        session.report({"value": config.get("v", 0) * 2})
+
+    trainer = DataParallelTrainer(
+        loop, backend_config=TestConfig(),
+        scaling_config=ScalingConfig(num_workers=1))
+    tuner = tune.Tuner(trainer, param_space={"v": tune.grid_search([1, 3])},
+                       tune_config=tune.TuneConfig(metric="value", mode="max"))
+    results = tuner.fit()
+    assert results.get_best_result().metrics["value"] == 6
