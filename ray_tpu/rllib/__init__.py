@@ -1,0 +1,24 @@
+"""RLlib-equivalent reinforcement learning on TPU (reference: rllib/).
+
+Two execution modes everywhere: `anakin` (envs inside the compiled TPU
+program — the throughput path) and `actor` (CPU rollout actors feeding the
+mesh learner — the generality path, shaped like the reference)."""
+from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup  # noqa: F401
+from ray_tpu.rllib.core.rl_module import (  # noqa: F401
+    DiscreteActorCritic,
+    RLModuleSpec,
+)
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # noqa: F401
+
+ALGORITHMS = {"PPO": PPOConfig, "IMPALA": IMPALAConfig}
+
+
+def get_algorithm_config(name: str) -> AlgorithmConfig:
+    """Registry lookup (reference: rllib/algorithms/registry.py)."""
+    if name not in ALGORITHMS:
+        raise ValueError(f"unknown algorithm {name!r}; have {list(ALGORITHMS)}")
+    return ALGORITHMS[name]()

@@ -1,0 +1,80 @@
+"""Algorithm base: the Trainable-like driver (reference:
+rllib/algorithms/algorithm.py:150 — setup :482, step :744,
+save/load_checkpoint :2018,2081)."""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Algorithm:
+    _default_config_cls = None
+
+    def __init__(self, config=None):
+        if config is None:
+            config = self._default_config_cls()
+        self.config = config
+        self.iteration = 0
+        self._num_env_steps_sampled = 0
+        self.setup()
+
+    # ---- lifecycle ----
+    def setup(self):
+        if self.config.mode == "anakin":
+            self._setup_anakin()
+        else:
+            self._setup_actor_mode()
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        if self.config.mode == "anakin":
+            metrics = self._training_step_anakin()
+        else:
+            metrics = self._training_step_actor()
+        self.iteration += 1
+        self._num_env_steps_sampled += metrics.get(
+            "num_env_steps_sampled_this_iter", 0)
+        metrics.update({
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled": self._num_env_steps_sampled,
+            "time_this_iter_s": time.perf_counter() - t0,
+        })
+        return metrics
+
+    def stop(self):
+        workers = getattr(self, "workers", None)
+        if workers is not None:
+            workers.stop()
+
+    # ---- checkpointing (Trainable protocol) ----
+    def save_checkpoint(self) -> Checkpoint:
+        if self.config.mode == "anakin":
+            return Checkpoint.from_pytree(
+                self._anakin_state.params,
+                extra={"iteration": self.iteration})
+        return Checkpoint.from_pytree(self.learner.get_weights(),
+                                      extra={"iteration": self.iteration})
+
+    def load_checkpoint(self, checkpoint: Checkpoint):
+        params = checkpoint.to_pytree()
+        self.iteration = checkpoint.extra().get("iteration", 0)
+        if self.config.mode == "anakin":
+            self._anakin_state = self._anakin_state._replace(params=params)
+        else:
+            self.learner.set_weights(params)
+            self.workers.sync_weights(params)
+
+    # hooks provided by concrete algorithms
+    def _setup_anakin(self):
+        raise NotImplementedError(f"{type(self).__name__} has no anakin mode")
+
+    def _setup_actor_mode(self):
+        raise NotImplementedError(f"{type(self).__name__} has no actor mode")
+
+    def _training_step_anakin(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _training_step_actor(self) -> Dict[str, Any]:
+        raise NotImplementedError

@@ -1,0 +1,114 @@
+"""AlgorithmConfig: fluent builder (reference:
+rllib/algorithms/algorithm_config.py — .environment/.rollouts/.training/
+.resources/.framework chain, 2.9k LoC there; the essentials here)."""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Type
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[Type] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Any = "CartPole-v1"
+        self.env_config: Dict[str, Any] = {}
+        # rollouts
+        self.num_rollout_workers = 0
+        self.num_envs_per_worker = 1
+        self.rollout_fragment_length = 200
+        self.mode = "anakin"  # "anakin" (on-device envs) | "actor" (CPU actors)
+        # anakin-specific
+        self.num_envs = 64
+        self.unroll_length = 128
+        # training
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_sgd_iter = 4
+        self.sgd_minibatch_size = 512
+        self.train_batch_size = 4000
+        self.grad_clip: Optional[float] = 0.5
+        # IMPALA
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        self.broadcast_interval = 1
+        # model
+        self.hiddens = (64, 64)
+        # resources / misc
+        self.seed = 0
+        self.framework_str = "jax"
+
+    # ---- fluent sections ----
+    def environment(self, env=None, env_config: Optional[dict] = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def rollouts(self, num_rollout_workers: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None,
+                 mode: Optional[str] = None):
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+            if mode is None and num_rollout_workers > 0:
+                self.mode = "actor"
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if mode is not None:
+            self.mode = mode
+        return self
+
+    def env_runners(self, **kw):  # new-stack alias
+        return self.rollouts(**kw)
+
+    def anakin(self, num_envs: Optional[int] = None,
+               unroll_length: Optional[int] = None):
+        if num_envs is not None:
+            self.num_envs = num_envs
+        if unroll_length is not None:
+            self.unroll_length = unroll_length
+        self.mode = "anakin"
+        return self
+
+    def training(self, **kw):
+        for k, v in kw.items():
+            if k == "model" and isinstance(v, dict):
+                self.hiddens = tuple(v.get("fcnet_hiddens", self.hiddens))
+                continue
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def framework(self, framework: str = "jax"):
+        if framework != "jax":
+            raise ValueError("this framework is jax-native; torch/tf ports "
+                             "of user models belong in user space")
+        return self
+
+    def resources(self, **kw):
+        return self
+
+    def debugging(self, seed: Optional[int] = None, **kw):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self, env=None):
+        if env is not None:
+            self.env = env
+        if self.algo_class is None:
+            raise ValueError("no algorithm class bound to this config")
+        return self.algo_class(self)

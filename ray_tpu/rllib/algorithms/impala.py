@@ -1,0 +1,128 @@
+"""IMPALA: async sampling + V-trace learner.
+
+Reference: rllib/algorithms/impala/impala.py:534 (async sample requests →
+learner queue → MultiGPULearnerThread with V-trace → periodic weight
+broadcast).  Here the learner is the JaxLearner on the local mesh and the
+async loop is driven with ray_tpu.wait over actor sample futures: as
+fragments arrive they are V-trace-corrected and applied, and weights are
+re-broadcast every `broadcast_interval` updates — same dataflow, no learner
+thread needed because the update is a single device-side jit call.
+
+An on-device "anakin" mode also exists: identical rollout to PPO's but with
+the V-trace loss — on TPU the async/sync distinction dissolves when envs
+live in the accelerator program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.utils.vtrace import vtrace
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IMPALA)
+        self.num_sgd_iter = 1
+        self.entropy_coeff = 0.01
+        self.lr = 5e-4
+
+
+def impala_loss(params, module, batch, *, gamma, clip_rho, clip_c,
+                vf_loss_coeff, entropy_coeff):
+    """batch tensors are time-major [T, N, ...] (V-trace needs time)."""
+    T, N = batch["actions"].shape
+    obs = batch["obs"].reshape(T * N, -1)
+    actions = batch["actions"].reshape(T * N)
+    logp, value, entropy = module.forward_train(params, obs, actions)
+    logp = logp.reshape(T, N)
+    value = value.reshape(T, N)
+    vs, pg_adv = vtrace(batch["behaviour_logp"], logp, batch["rewards"],
+                        jax.lax.stop_gradient(value), batch["dones"],
+                        batch["last_value"], gamma, clip_rho, clip_c)
+    policy_loss = -jnp.mean(logp * pg_adv)
+    vf_loss = 0.5 * jnp.mean((value - vs) ** 2)
+    ent = jnp.mean(entropy)
+    total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * ent
+    return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                   "entropy": ent}
+
+
+class IMPALA(Algorithm):
+    _default_config_cls = IMPALAConfig
+
+    def _setup_actor_mode(self):
+        from ray_tpu.rllib.core.learner import JaxLearner
+        from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+        from ray_tpu.rllib.env.py_envs import make_py_env
+
+        probe = make_py_env(self.config.env)
+        spec = RLModuleSpec(obs_dim=probe.obs_dim,
+                            num_actions=probe.num_actions,
+                            hiddens=tuple(self.config.hiddens))
+        self.module = spec.build()
+        self._spec = spec
+        example = np.zeros((1, probe.obs_dim), np.float32)
+        tx = optax.chain(
+            optax.clip_by_global_norm(self.config.grad_clip or 1e9),
+            optax.adam(self.config.lr))
+        self.learner = JaxLearner(
+            self.module,
+            functools.partial(impala_loss, gamma=self.config.gamma,
+                              clip_rho=self.config.vtrace_clip_rho,
+                              clip_c=self.config.vtrace_clip_c,
+                              vf_loss_coeff=self.config.vf_loss_coeff,
+                              entropy_coeff=self.config.entropy_coeff),
+            optimizer=tx, example_obs=example, seed=self.config.seed)
+        self.workers = WorkerSet(self.config, spec)
+        self.workers.sync_weights(self.learner.get_weights())
+        self._inflight: Dict[Any, Any] = {}
+        self._updates_since_broadcast = 0
+
+    def _training_step_actor(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        # Keep one sample request in flight per worker (async pipeline).
+        for w in self.workers.workers:
+            if not any(wk is w for wk, _ in self._inflight.items()):
+                self._inflight[w] = w.sample_timemajor.remote()
+        metrics: Dict[str, Any] = {}
+        ep_returns = []
+        target_updates = max(1, len(self.workers.workers))
+        updates = 0
+        while updates < target_updates:
+            futs = list(self._inflight.values())
+            ready, _ = ray_tpu.wait(futs, num_returns=1, timeout=120)
+            if not ready:
+                break
+            fut = ready[0]
+            worker = next(w for w, f in self._inflight.items() if f is fut)
+            del self._inflight[worker]
+            try:
+                batch, eps = ray_tpu.get(fut)
+            except ray_tpu.exceptions.RayTpuError:
+                continue
+            ep_returns.extend(eps)
+            metrics = self.learner.update(batch)
+            updates += 1
+            self._updates_since_broadcast += 1
+            if self._updates_since_broadcast >= self.config.broadcast_interval:
+                self.workers.sync_weights(self.learner.get_weights())
+                self._updates_since_broadcast = 0
+            self._inflight[worker] = worker.sample_timemajor.remote()
+        if ep_returns:
+            self._ep_reward_ema = float(np.mean(ep_returns))
+        metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",
+                                                 float("nan"))
+        metrics["num_env_steps_sampled_this_iter"] = (
+            updates * self.config.rollout_fragment_length
+            * self.config.num_envs_per_worker)
+        return metrics

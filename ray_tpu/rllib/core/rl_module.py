@@ -1,0 +1,66 @@
+"""RLModule: the model abstraction of the new stack (reference:
+rllib/core/rl_module/rl_module.py; jax skeleton the reference already
+sketches: rllib/models/jax/).  A module bundles policy + value heads and
+exposes forward_inference / forward_exploration / forward_train."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.mlp import MLP
+from ray_tpu.models.nature_cnn import NatureCNN
+
+
+@dataclasses.dataclass(frozen=True)
+class RLModuleSpec:
+    obs_dim: Optional[int] = None
+    obs_shape: Optional[Tuple[int, ...]] = None  # set for pixel obs
+    num_actions: int = 2
+    hiddens: Tuple[int, ...] = (64, 64)
+    conv: bool = False
+
+    def build(self) -> "DiscreteActorCritic":
+        return DiscreteActorCritic(self)
+
+
+class DiscreteActorCritic(nn.Module):
+    """Categorical policy + value baseline (separate heads, shared trunk for
+    pixels, separate trunks for vectors — matching RLlib PPO defaults)."""
+
+    spec: RLModuleSpec
+
+    @nn.compact
+    def __call__(self, obs) -> Tuple[jax.Array, jax.Array]:
+        s = self.spec
+        if s.conv:
+            trunk = NatureCNN(out_dim=256, name="trunk")(obs)
+            logits = nn.Dense(s.num_actions, name="pi")(trunk)
+            value = nn.Dense(1, name="vf")(trunk)[..., 0]
+        else:
+            logits = MLP(s.hiddens, s.num_actions, name="pi_mlp")(obs)
+            value = MLP(s.hiddens, 1, name="vf_mlp")(obs)[..., 0]
+        return logits, value
+
+    # ---- RLModule API ----
+    def forward_inference(self, params, obs):
+        logits, _ = self.apply(params, obs)
+        return jnp.argmax(logits, axis=-1)
+
+    def forward_exploration(self, params, obs, rng):
+        logits, value = self.apply(params, obs)
+        action = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)
+        action_logp = jnp.take_along_axis(logp, action[..., None], -1)[..., 0]
+        return action, action_logp, value
+
+    def forward_train(self, params, obs, actions):
+        logits, value = self.apply(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        action_logp = jnp.take_along_axis(
+            logp_all, actions[..., None].astype(jnp.int32), -1)[..., 0]
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        return action_logp, value, entropy

@@ -1,0 +1,145 @@
+"""JAX-native vectorized environments.
+
+No equivalent exists in the reference: RLlib steps Python gym envs on CPU
+rollout workers (rllib/evaluation/sampler.py).  The TPU-native design
+additionally runs envs *inside the compiled program* (Podracer/Anakin
+architecture, PAPERS.md) — thousands of env instances as a batched state
+pytree, stepped by lax.scan on device, so rollout+learn is one jit with no
+host↔device traffic.  CPU-actor rollouts (py_envs.py) remain for envs that
+can't be expressed in JAX.
+
+Env contract (functional, vmap/scan-safe):
+    reset(rng) -> (state, obs)
+    step(state, action, rng) -> (state, obs, reward, done, info)
+Auto-reset on done is built into step (standard Anakin practice).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CartPole:
+    """CartPole-v1 dynamics (matches the classic gym spec: 500-step limit,
+    ±2.4 position, ±12° angle)."""
+
+    num_actions = 2
+    obs_dim = 4
+
+    gravity = 9.8
+    masscart = 1.0
+    masspole = 0.1
+    length = 0.5
+    force_mag = 10.0
+    tau = 0.02
+    theta_threshold = 12 * 2 * jnp.pi / 360
+    x_threshold = 2.4
+    max_steps = 500
+
+    def reset(self, rng) -> Tuple[Any, jax.Array]:
+        core = jax.random.uniform(rng, (4,), minval=-0.05, maxval=0.05)
+        state = {"core": core, "t": jnp.zeros((), jnp.int32)}
+        return state, core
+
+    def step(self, state, action, rng):
+        x, x_dot, theta, theta_dot = state["core"]
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta) / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        core = jnp.stack([x, x_dot, theta, theta_dot])
+        t = state["t"] + 1
+        done = (
+            (jnp.abs(x) > self.x_threshold)
+            | (jnp.abs(theta) > self.theta_threshold)
+            | (t >= self.max_steps)
+        )
+        reward = jnp.ones(())
+        # Auto-reset.
+        reset_state, reset_obs = self.reset(rng)
+        new_state = {
+            "core": jnp.where(done, reset_state["core"], core),
+            "t": jnp.where(done, reset_state["t"], t),
+        }
+        obs = jnp.where(done, reset_obs, core)
+        return new_state, obs, reward, done, {}
+
+
+class Pendulum:
+    """Pendulum-v1 with 3-bin discretized torque (keeps one categorical
+    policy head across envs; continuous head lands with the SAC family)."""
+
+    num_actions = 3
+    obs_dim = 3
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    l = 1.0
+    max_steps = 200
+
+    def reset(self, rng):
+        k1, k2 = jax.random.split(rng)
+        th = jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi)
+        thdot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        state = {"th": th, "thdot": thdot, "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(state)
+
+    def _obs(self, state):
+        return jnp.stack([jnp.cos(state["th"]), jnp.sin(state["th"]),
+                          state["thdot"]])
+
+    def step(self, state, action, rng):
+        u = (action.astype(jnp.float32) - 1.0) * self.max_torque
+        th, thdot = state["th"], state["thdot"]
+        norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.g / (2 * self.l) * jnp.sin(th)
+                         + 3.0 / (self.m * self.l ** 2) * u) * self.dt
+        thdot = jnp.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        t = state["t"] + 1
+        done = t >= self.max_steps
+        reset_state, reset_obs = self.reset(rng)
+        new_state = {
+            "th": jnp.where(done, reset_state["th"], th),
+            "thdot": jnp.where(done, reset_state["thdot"], thdot),
+            "t": jnp.where(done, reset_state["t"], t),
+        }
+        obs_next = self._obs({"th": th, "thdot": thdot})
+        obs = jnp.where(done, reset_obs, obs_next)
+        return new_state, obs, -cost, done, {}
+
+
+REGISTRY = {
+    "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
+}
+
+
+def make_jax_env(name: str):
+    if name not in REGISTRY:
+        raise ValueError(f"unknown jax env {name!r}; have {list(REGISTRY)}")
+    return REGISTRY[name]()
+
+
+def vector_reset(env, rng, num_envs: int):
+    """Batched reset: returns (states, obs) with leading [num_envs]."""
+    return jax.vmap(env.reset)(jax.random.split(rng, num_envs))
+
+
+def vector_step(env, states, actions, rng):
+    num = actions.shape[0]
+    return jax.vmap(env.step)(states, actions, jax.random.split(rng, num))

@@ -1,0 +1,85 @@
+"""Python-API envs for CPU actor rollouts (gym-style contract, since gym is
+not a dependency).  Mirrors the reference's env layer (rllib/env/*.py) in
+miniature: single env + VectorEnv.  NumPy mirrors of the JAX dynamics so
+actor-path and Anakin-path PPO train on identical MDPs."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class PyCartPole:
+    """CartPole-v1 (numpy). API: reset(seed) -> obs; step(a) -> (obs, r,
+    terminated, truncated, info)."""
+
+    num_actions = 2
+    obs_dim = 4
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.state = None
+        self.t = 0
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.state = self.rng.uniform(-0.05, 0.05, 4)
+        self.t = 0
+        return self.state.copy()
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = 10.0 if action == 1 else -10.0
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + 0.05 * theta_dot ** 2 * sintheta) / 1.1
+        thetaacc = (9.8 * sintheta - costheta * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costheta ** 2 / 1.1))
+        xacc = temp - 0.05 * thetaacc * costheta / 1.1
+        x += 0.02 * x_dot
+        x_dot += 0.02 * xacc
+        theta += 0.02 * theta_dot
+        theta_dot += 0.02 * thetaacc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self.t += 1
+        terminated = bool(abs(x) > 2.4 or abs(theta) > 0.2095)
+        truncated = self.t >= 500
+        return self.state.copy(), 1.0, terminated, truncated, {}
+
+
+PY_REGISTRY = {"CartPole-v1": PyCartPole}
+
+
+def make_py_env(name: str, seed: Optional[int] = None):
+    if callable(name):
+        return name()
+    if name not in PY_REGISTRY:
+        raise ValueError(f"unknown env {name!r}")
+    return PY_REGISTRY[name](seed)
+
+
+class VectorEnv:
+    """N python envs stepped together (reference: rllib/env/vector_env.py)."""
+
+    def __init__(self, env_fn, num_envs: int, seed: int = 0):
+        self.envs = [env_fn() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        for i, e in enumerate(self.envs):
+            e.reset(seed + i)
+
+    def reset_all(self) -> np.ndarray:
+        return np.stack([e.reset() for e in self.envs])
+
+    def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[dict]]:
+        obs, rews, dones, infos = [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, term, trunc, info = e.step(int(a))
+            done = term or trunc
+            if done:
+                o = e.reset()
+            obs.append(o)
+            rews.append(r)
+            dones.append(done)
+            infos.append(info)
+        return (np.stack(obs), np.asarray(rews, np.float32),
+                np.asarray(dones), infos)

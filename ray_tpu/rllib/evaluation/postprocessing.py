@@ -1,0 +1,59 @@
+"""GAE advantage estimation (reference: rllib/evaluation/postprocessing.py
+compute_advantages/compute_gae_for_sample_batch).  Both a numpy version (CPU
+rollout actors) and a jax version (inside the jitted Anakin train step)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import (
+    ADVANTAGES,
+    DONES,
+    REWARDS,
+    SampleBatch,
+    VALUE_TARGETS,
+    VF_PREDS,
+)
+
+
+def compute_gae(batch: SampleBatch, last_value: float, gamma: float = 0.99,
+                lambda_: float = 0.95) -> SampleBatch:
+    """In-place GAE over a time-ordered fragment (dones mark resets)."""
+    rewards = batch[REWARDS].astype(np.float64)
+    values = batch[VF_PREDS].astype(np.float64)
+    dones = batch[DONES].astype(np.float64)
+    n = len(rewards)
+    adv = np.zeros(n)
+    last_gae = 0.0
+    next_value = last_value
+    for t in range(n - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lambda_ * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    batch[ADVANTAGES] = adv.astype(np.float32)
+    batch[VALUE_TARGETS] = (adv + values).astype(np.float32)
+    return batch
+
+
+def gae_jax(rewards, values, dones, last_value, gamma: float = 0.99,
+            lambda_: float = 0.95):
+    """rewards/values/dones: [T, N] time-major. Returns (advantages,
+    value_targets) [T, N].  Pure scan — runs inside jit on device."""
+    import jax
+    import jax.numpy as jnp
+
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+
+    def step(carry, xs):
+        last_gae, next_value = carry
+        r, v, nt = xs
+        delta = r + gamma * next_value * nt - v
+        gae = delta + gamma * lambda_ * nt * last_gae
+        return (gae, v), gae
+
+    (_, _), adv_rev = jax.lax.scan(
+        step, (jnp.zeros_like(last_value), last_value),
+        (rewards[::-1], values[::-1], nonterminal[::-1]))
+    adv = adv_rev[::-1]
+    return adv, adv + values

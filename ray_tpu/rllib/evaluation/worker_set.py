@@ -1,0 +1,177 @@
+"""RolloutWorker actors + WorkerSet (reference:
+rllib/evaluation/rollout_worker.py sample :878, worker_set.py:78 with
+fault-tolerant sync_weights/sample)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class RolloutWorker:
+    """CPU actor stepping python envs with jax-on-CPU policy inference.
+
+    Weights arrive via the object store (reference: sync_weights broadcast,
+    worker_set.py)."""
+
+    def __init__(self, env_name, module_spec, worker_index: int,
+                 num_envs: int, fragment_length: int, gamma: float,
+                 lambda_: float, seed: int):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        from ray_tpu.rllib.env.py_envs import VectorEnv, make_py_env
+
+        self.env = VectorEnv(lambda: make_py_env(env_name),
+                             num_envs, seed + worker_index * 1000)
+        self.module = module_spec.build()
+        self.params = None
+        self.fragment_length = fragment_length
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self.rng = jax.random.PRNGKey(seed + worker_index)
+        self.obs = self.env.reset_all().astype(np.float32)
+        self.ep_returns = np.zeros(num_envs)
+        self.completed: List[float] = []
+        self._explore = jax.jit(self.module.forward_exploration)
+        self._value = jax.jit(
+            lambda p, o: self.module.apply(p, o)[1])
+
+    def set_weights(self, params):
+        self.params = params
+        return True
+
+    def sample(self):
+        """Returns (SampleBatch with GAE columns, completed episode returns)."""
+        import jax
+        import numpy as np
+
+        from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+        T = self.fragment_length
+        obs_l, act_l, logp_l, val_l, rew_l, done_l = [], [], [], [], [], []
+        for _ in range(T):
+            self.rng, k = jax.random.split(self.rng)
+            action, logp, value = self._explore(self.params, self.obs, k)
+            action = np.asarray(action)
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_l.append(self.obs)
+            act_l.append(action)
+            logp_l.append(np.asarray(logp))
+            val_l.append(np.asarray(value))
+            rew_l.append(reward)
+            done_l.append(done)
+            self.ep_returns += reward
+            for i, d in enumerate(done):
+                if d:
+                    self.completed.append(float(self.ep_returns[i]))
+                    self.ep_returns[i] = 0.0
+            self.obs = next_obs.astype(np.float32)
+
+        last_value = np.asarray(self._value(self.params, self.obs))
+        rewards = np.stack(rew_l)          # [T, N]
+        values = np.stack(val_l)
+        dones = np.stack(done_l)
+        # GAE, time-major vectorized over envs.
+        from ray_tpu.rllib.evaluation.postprocessing import gae_jax
+
+        adv, vtarg = gae_jax(rewards, values, dones.astype(np.float32),
+                             last_value, self.gamma, self.lambda_)
+        n = rewards.size
+        batch = SampleBatch({
+            "obs": np.stack(obs_l).reshape(n, -1),
+            "actions": np.stack(act_l).reshape(n),
+            "action_logp": np.stack(logp_l).reshape(n),
+            "vf_preds": values.reshape(n),
+            "rewards": rewards.reshape(n),
+            "dones": dones.reshape(n),
+            "advantages": np.asarray(adv).reshape(n),
+            "value_targets": np.asarray(vtarg).reshape(n),
+        })
+        completed, self.completed = self.completed, []
+        return batch, completed
+
+    def sample_timemajor(self):
+        """IMPALA fragment: time-major [T, N] tensors + behaviour logp +
+        bootstrap value (what V-trace consumes)."""
+        import jax
+        import numpy as np
+
+        T = self.fragment_length
+        obs_l, act_l, logp_l, rew_l, done_l = [], [], [], [], []
+        for _ in range(T):
+            self.rng, k = jax.random.split(self.rng)
+            action, logp, _ = self._explore(self.params, self.obs, k)
+            action = np.asarray(action)
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_l.append(self.obs)
+            act_l.append(action)
+            logp_l.append(np.asarray(logp))
+            rew_l.append(reward)
+            done_l.append(done)
+            self.ep_returns += reward
+            for i, d in enumerate(done):
+                if d:
+                    self.completed.append(float(self.ep_returns[i]))
+                    self.ep_returns[i] = 0.0
+            self.obs = next_obs.astype(np.float32)
+        last_value = np.asarray(self._value(self.params, self.obs))
+        batch = {
+            "obs": np.stack(obs_l),                      # [T, N, obs]
+            "actions": np.stack(act_l),                  # [T, N]
+            "behaviour_logp": np.stack(logp_l),
+            "rewards": np.stack(rew_l).astype(np.float32),
+            "dones": np.stack(done_l).astype(np.float32),
+            "last_value": last_value,
+        }
+        completed, self.completed = self.completed, []
+        return batch, completed
+
+
+class WorkerSet:
+    def __init__(self, config, module_spec):
+        n = max(1, config.num_rollout_workers)
+        self.workers = [
+            RolloutWorker.options(max_restarts=1).remote(
+                config.env, module_spec, i, config.num_envs_per_worker,
+                config.rollout_fragment_length, config.gamma, config.lambda_,
+                config.seed)
+            for i in range(n)
+        ]
+        self._weights_ref = None
+
+    def sync_weights(self, params):
+        # One put, N borrowers — the object-store broadcast pattern the
+        # reference uses for sync_weights.
+        self._weights_ref = ray_tpu.put(params)
+        ray_tpu.get([w.set_weights.remote(self._weights_ref)
+                     for w in self.workers])
+
+    def sample_sync(self) -> Tuple[List[Any], List[float]]:
+        """synchronous_parallel_sample (reference:
+        rllib/execution/rollout_ops.py:21) with dead-worker tolerance."""
+        futures = [w.sample.remote() for w in self.workers]
+        batches, returns = [], []
+        for f in futures:
+            try:
+                b, eps = ray_tpu.get(f)
+                batches.append(b)
+                returns.extend(eps)
+            except ray_tpu.exceptions.RayTpuError:
+                continue  # dead worker; restart policy handles it
+        return batches, returns
+
+    def sample_async(self):
+        return [(w, w.sample.remote()) for w in self.workers]
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

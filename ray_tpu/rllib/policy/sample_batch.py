@@ -1,0 +1,86 @@
+"""SampleBatch: the RL data container (reference:
+rllib/policy/sample_batch.py:96; MultiAgentBatch :1218)."""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+# Canonical columns (reference SampleBatch.OBS etc.)
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+NEXT_OBS = "new_obs"
+VF_PREDS = "vf_preds"
+ACTION_LOGP = "action_logp"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+EPS_ID = "eps_id"
+
+
+class SampleBatch(dict):
+    """Dict of equally-long numpy arrays."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in list(self.items()):
+            if not isinstance(v, np.ndarray):
+                self[k] = np.asarray(v)
+
+    def __len__(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    @staticmethod
+    def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch({
+            k: np.concatenate([b[k] for b in batches]) for k in keys})
+
+    def shuffle(self, seed: Optional[int] = None) -> "SampleBatch":
+        idx = np.random.default_rng(seed).permutation(len(self))
+        return SampleBatch({k: v[idx] for k, v in self.items()})
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        for s in range(0, len(self) - size + 1, size):
+            yield self.slice(s, s + size)
+
+    def split_by_episode(self) -> List["SampleBatch"]:
+        if EPS_ID not in self:
+            return [self]
+        out = []
+        ids = self[EPS_ID]
+        boundaries = np.flatnonzero(np.diff(ids)) + 1
+        start = 0
+        for b in list(boundaries) + [len(self)]:
+            out.append(self.slice(start, b))
+            start = b
+        return out
+
+    def as_jax(self, device=None):
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(v) for k, v in self.items()}
+
+
+class MultiAgentBatch:
+    def __init__(self, policy_batches: Dict[str, SampleBatch], env_steps: int):
+        self.policy_batches = policy_batches
+        self._env_steps = env_steps
+
+    def env_steps(self) -> int:
+        return self._env_steps
+
+    def agent_steps(self) -> int:
+        return sum(len(b) for b in self.policy_batches.values())

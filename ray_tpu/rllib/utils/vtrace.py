@@ -1,0 +1,42 @@
+"""V-trace off-policy correction (IMPALA), jax scan implementation.
+
+Reference: rllib/algorithms/impala/vtrace_torch.py — re-derived from the
+IMPALA paper's recursion, not translated:
+    vs = V(xs) + sum_t gamma^t * (prod c) * rho_t * delta_t
+computed right-to-left with clipped importance weights.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vtrace(behaviour_logp, target_logp, rewards, values, dones, last_value,
+           gamma: float = 0.99, clip_rho: float = 1.0, clip_c: float = 1.0):
+    """All inputs time-major [T, N]; last_value [N].
+
+    Returns (vs, pg_advantages): value targets for the critic and
+    importance-corrected advantages for the policy gradient."""
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    clipped_cs = jnp.minimum(clip_c, rhos)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+
+    values_tp1 = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + gamma * values_tp1 * nonterminal - values)
+
+    def step(acc, xs):
+        delta, c, nt = xs
+        acc = delta + gamma * nt * c * acc
+        return acc, acc
+
+    _, vs_minus_v_rev = jax.lax.scan(
+        step, jnp.zeros_like(last_value),
+        (deltas[::-1], clipped_cs[::-1], nonterminal[::-1]))
+    vs_minus_v = vs_minus_v_rev[::-1]
+    vs = values + vs_minus_v
+
+    vs_tp1 = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + gamma * vs_tp1 * nonterminal - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
