@@ -1,0 +1,45 @@
+"""Headline benchmark: Anakin PPO on CartPole — env-steps/sec on the local
+accelerator, with learning on (full PPO update each iteration).
+
+Baseline (BASELINE.md north star): PPO at >= 1,000,000 env-steps/s on a TPU
+v4-32 pod (16 chips) => 62,500 env-steps/s/chip.  vs_baseline is measured
+per-chip throughput divided by that per-chip share.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+
+def main():
+    import jax
+
+    from ray_tpu.rllib import PPOConfig
+
+    num_devices = max(1, len(jax.devices()))
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .anakin(num_envs=8192, unroll_length=128)
+        .training(num_sgd_iter=4, sgd_minibatch_size=32768, lr=3e-4)
+        .debugging(seed=0)
+        .build()
+    )
+    algo.train()  # compile + warmup
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        result = algo.train()
+    dt = time.perf_counter() - t0
+    steps_per_s = iters * 8192 * 128 / dt
+    per_chip = steps_per_s / num_devices
+    print(json.dumps({
+        "metric": "ppo_cartpole_env_steps_per_sec",
+        "value": round(steps_per_s),
+        "unit": "env_steps/s",
+        "vs_baseline": round(per_chip / 62500.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
