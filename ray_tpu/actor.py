@@ -96,13 +96,29 @@ class ActorClass:
     def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
         self._cls = cls
         self._options = options or {}
-        self._blob = cloudpickle.dumps(cls)
-        self._hash = hashlib.sha256(self._blob).digest()
+        # Pickled lazily on first .remote(): decoration runs mid-module-import,
+        # and pickling then would snapshot the module globals before
+        # later-defined helpers exist (cloudpickle captures by-value classes'
+        # globals at dump time).
+        self._blob_cache: Optional[bytes] = None
+        self._hash_cache: Optional[bytes] = None
         self._method_names = [
             n for n in dir(cls)
             if callable(getattr(cls, n, None)) and not n.startswith("__")
         ]
         self.__name__ = getattr(cls, "__name__", "Actor")
+
+    @property
+    def _blob(self) -> bytes:
+        if self._blob_cache is None:
+            self._blob_cache = cloudpickle.dumps(self._cls)
+            self._hash_cache = hashlib.sha256(self._blob_cache).digest()
+        return self._blob_cache
+
+    @property
+    def _hash(self) -> bytes:
+        self._blob
+        return self._hash_cache
 
     def options(self, **kw) -> "ActorClass":
         merged = dict(self._options)
@@ -110,8 +126,8 @@ class ActorClass:
         ac = ActorClass.__new__(ActorClass)
         ac._cls = self._cls
         ac._options = merged
-        ac._blob = self._blob
-        ac._hash = self._hash
+        ac._blob_cache = self._blob_cache
+        ac._hash_cache = self._hash_cache
         ac._method_names = self._method_names
         ac.__name__ = self.__name__
         return ac

@@ -1,0 +1,57 @@
+"""Lazy DAG nodes (reference: python/ray/dag/dag_node.py — FunctionNode/
+ClassNode graphs used by Serve deployment graphs)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import ray_tpu
+
+
+class DAGNode:
+    def execute(self):
+        raise NotImplementedError
+
+    def _resolve(self, v):
+        if isinstance(v, DAGNode):
+            return v.execute()
+        return v
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def execute(self):
+        args = [self._resolve(a) for a in self.args]
+        kwargs = {k: self._resolve(v) for k, v in self.kwargs.items()}
+        args = [ray_tpu.get(a) if hasattr(a, "id") else a for a in args]
+        return self.fn.remote(*args, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder bound at execute time: dag.execute(input=...)"""
+
+    _current: Any = None
+
+    def execute(self):
+        return InputNode._current
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def bind(remote_fn, *args, **kwargs) -> FunctionNode:
+    return FunctionNode(remote_fn, args, kwargs)
+
+
+def execute(node: DAGNode, input_value: Any = None):
+    InputNode._current = input_value
+    try:
+        return node.execute()
+    finally:
+        InputNode._current = None

@@ -1,0 +1,42 @@
+"""Ray-Data-equivalent distributed datasets (reference: python/ray/data/)."""
+from typing import Any, Dict, List, Union
+
+import numpy as np
+
+from ray_tpu.data.dataset import Dataset  # noqa: F401
+from ray_tpu.data.preprocessors import (  # noqa: F401
+    BatchMapper,
+    Preprocessor,
+    StandardScaler,
+)
+
+
+def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
+    return Dataset.from_items(items, parallelism)
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
+    return Dataset.range(n, parallelism)
+
+
+def from_numpy(arrays: Union[np.ndarray, Dict[str, np.ndarray]],
+               parallelism: int = 8) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    return Dataset.from_numpy(arrays, parallelism)
+
+
+def read_parquet(paths, columns=None) -> Dataset:
+    return Dataset.read(paths, "parquet", columns)
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset.read(paths, "csv")
+
+
+def read_json(paths) -> Dataset:
+    return Dataset.read(paths, "json")
+
+
+def read_numpy(paths) -> Dataset:
+    return Dataset.read(paths, "numpy")

@@ -1,0 +1,91 @@
+"""Blocks: the unit of distributed data (reference: python/ray/data/block.py
+— Arrow/pandas/py-list partitions living in the object store).
+
+A block here is a pyarrow.Table (canonical), with converters to/from numpy
+batches and pandas.  Blocks travel as ObjectRefs; pyarrow's pickle path is
+buffer-based so the store's zero-copy read applies.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Batch = Union[Dict[str, np.ndarray], "pa.Table"]
+
+
+def block_from_items(items: List[Any]) -> pa.Table:
+    if items and isinstance(items[0], dict):
+        cols = {k: [it[k] for it in items] for k in items[0]}
+        return pa.table(cols)
+    return pa.table({"item": items})
+
+
+def block_from_numpy(arrays: Dict[str, np.ndarray]) -> pa.Table:
+    cols = {}
+    for k, v in arrays.items():
+        v = np.asarray(v)
+        if v.ndim <= 1:
+            cols[k] = pa.array(v)
+        else:
+            # Fixed-shape tensors: flatten rows into FixedSizeList.
+            flat = v.reshape(len(v), -1)
+            cols[k] = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.ravel()), flat.shape[1])
+            cols[k] = pa.chunked_array([cols[k]])
+    t = pa.table(cols)
+    meta = {f"shape:{k}": ",".join(map(str, np.asarray(v).shape[1:]))
+            for k, v in arrays.items() if np.asarray(v).ndim > 1}
+    if meta:
+        t = t.replace_schema_metadata(
+            {**(t.schema.metadata or {}),
+             **{k.encode(): v.encode() for k, v in meta.items()}})
+    return t
+
+
+def block_to_numpy(block: pa.Table) -> Dict[str, np.ndarray]:
+    out = {}
+    meta = block.schema.metadata or {}
+    for name in block.column_names:
+        col = block.column(name)
+        arr = col.combine_chunks()
+        if pa.types.is_fixed_size_list(arr.type):
+            flat = np.asarray(arr.values)
+            shape_meta = meta.get(f"shape:{name}".encode())
+            inner = (tuple(int(x) for x in shape_meta.decode().split(","))
+                     if shape_meta else (arr.type.list_size,))
+            out[name] = flat.reshape((len(block),) + inner)
+        else:
+            out[name] = np.asarray(arr)
+    return out
+
+
+def block_num_rows(block: pa.Table) -> int:
+    return block.num_rows
+
+
+def block_slice(block: pa.Table, start: int, end: int) -> pa.Table:
+    return block.slice(start, end - start)
+
+
+def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def apply_batch_fn(block: pa.Table, fn, batch_format: str) -> pa.Table:
+    """Run a user map_batches fn over one block."""
+    if batch_format == "numpy":
+        result = fn(block_to_numpy(block))
+        if isinstance(result, dict):
+            return block_from_numpy(result)
+        if isinstance(result, pa.Table):
+            return result
+        raise TypeError("numpy-format fn must return dict or Table")
+    if batch_format == "pandas":
+        result = fn(block.to_pandas())
+        return pa.Table.from_pandas(result)
+    if batch_format == "pyarrow":
+        return fn(block)
+    raise ValueError(f"bad batch_format {batch_format!r}")

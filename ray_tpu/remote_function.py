@@ -56,9 +56,23 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._function = fn
         self._options = options or {}
-        self._blob = cloudpickle.dumps(fn)
-        self._hash = hashlib.sha256(self._blob).digest()
+        # Lazy pickle: see ActorClass — dumping at decoration time snapshots
+        # incomplete module globals.
+        self._blob_cache: Optional[bytes] = None
+        self._hash_cache: Optional[bytes] = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
+
+    @property
+    def _blob(self) -> bytes:
+        if self._blob_cache is None:
+            self._blob_cache = cloudpickle.dumps(self._function)
+            self._hash_cache = hashlib.sha256(self._blob_cache).digest()
+        return self._blob_cache
+
+    @property
+    def _hash(self) -> bytes:
+        self._blob
+        return self._hash_cache
 
     def options(self, **kw) -> "RemoteFunction":
         merged = dict(self._options)
@@ -66,8 +80,8 @@ class RemoteFunction:
         rf = RemoteFunction.__new__(RemoteFunction)
         rf._function = self._function
         rf._options = merged
-        rf._blob = self._blob
-        rf._hash = self._hash
+        rf._blob_cache = self._blob_cache
+        rf._hash_cache = self._hash_cache
         rf.__name__ = self.__name__
         return rf
 

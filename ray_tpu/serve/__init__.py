@@ -1,0 +1,26 @@
+"""Serve-equivalent model serving on actors.
+
+Reference: python/ray/serve/ — control plane (ServeController reconciling
+DeploymentState, serve/controller.py:60), data plane (HTTPProxy → Router →
+replica actors, _private/http_proxy.py:230, router.py:221, replica.py:507).
+
+This implementation keeps the same three planes in miniature:
+- deployments: @serve.deployment + serve.run build replica actor sets,
+- routing: DeploymentHandle round-robins replicas with an in-flight cap
+  and queue-based backpressure,
+- HTTP: a proxy actor running a threaded stdlib HTTP server (uvicorn isn't
+  in the image) that forwards JSON bodies to handles.
+Replica autoscaling uses the reference's formula (autoscaling_policy.py:10):
+ceil(current * avg_queued / target) clamped to [min, max].
+"""
+from ray_tpu.serve.api import (  # noqa: F401
+    Deployment,
+    DeploymentHandle,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+)
+from ray_tpu.serve.autoscaling import calculate_desired_num_replicas  # noqa: F401

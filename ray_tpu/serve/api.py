@@ -1,0 +1,243 @@
+"""Serve API: @deployment, run, handles, HTTP proxy."""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+_deployments: Dict[str, "Deployment"] = {}
+_proxy = None
+
+
+@ray_tpu.remote
+class _Replica:
+    """Hosts one copy of the user callable (reference: RayServeReplica,
+    serve/_private/replica.py:260).  A replica can hold a pjit-compiled
+    inference mesh — the callable owns whatever devices its worker sees."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs):
+        if isinstance(cls_or_fn, type):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self._callable = cls_or_fn
+        self._queued = 0
+
+    def handle_request(self, method: str, args, kwargs):
+        target = (self._callable if method == "__call__"
+                  else getattr(self._callable, method))
+        if not callable(target):
+            raise TypeError(f"{method} is not callable on this deployment")
+        return target(*args, **kwargs)
+
+    def queue_len(self) -> int:
+        return self._queued
+
+    def reconfigure(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+
+class DeploymentHandle:
+    """Round-robin router over replica actors with an in-flight cap
+    (reference: Router.assign_replica, serve/_private/router.py:221)."""
+
+    def __init__(self, name: str, replicas: List[Any],
+                 max_in_flight_per_replica: int = 8):
+        self.name = name
+        self._replicas = replicas
+        self._rr = itertools.cycle(range(len(replicas)))
+        self._in_flight: Dict[int, int] = {i: 0 for i in range(len(replicas))}
+        self._cap = max_in_flight_per_replica
+        self._lock = threading.Lock()
+
+    def remote(self, *args, _method: str = "__call__", **kwargs):
+        with self._lock:
+            for _ in range(len(self._replicas)):
+                i = next(self._rr)
+                if self._in_flight[i] < self._cap:
+                    break
+            self._in_flight[i] += 1
+        ref = self._replicas[i].handle_request.remote(_method, args, kwargs)
+
+        def done(_f):
+            with self._lock:
+                self._in_flight[i] -= 1
+
+        try:
+            ref.future().add_done_callback(done)
+        except Exception:
+            with self._lock:
+                self._in_flight[i] -= 1
+        return ref
+
+    def method(self, name: str):
+        h = self
+
+        class _M:
+            def remote(self, *a, **kw):
+                return h.remote(*a, _method=name, **kw)
+
+        return _M()
+
+    @property
+    def num_replicas(self):
+        return len(self._replicas)
+
+
+class Deployment:
+    def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
+                 ray_actor_options: Optional[dict] = None,
+                 user_config: Any = None,
+                 autoscaling_config: Optional[dict] = None):
+        self._func = cls_or_fn
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options or {}
+        self.user_config = user_config
+        self.autoscaling_config = autoscaling_config
+        self._init_args: tuple = ()
+        self._init_kwargs: dict = {}
+        self.handle: Optional[DeploymentHandle] = None
+        self._replicas: List[Any] = []
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        self._init_args = args
+        self._init_kwargs = kwargs
+        return self
+
+    def options(self, **kw) -> "Deployment":
+        import copy
+
+        d = copy.copy(self)
+        for k, v in kw.items():
+            setattr(d, k, v)
+        return d
+
+    # ---- lifecycle (controller-lite reconciliation) ----
+    def _deploy(self) -> DeploymentHandle:
+        opts = dict(self.ray_actor_options)
+        opts.setdefault("max_concurrency", 8)
+        self._replicas = [
+            _Replica.options(**opts).remote(self._func, self._init_args,
+                                            self._init_kwargs)
+            for _ in range(self.num_replicas)
+        ]
+        if self.user_config is not None:
+            ray_tpu.get([r.reconfigure.remote(self.user_config)
+                         for r in self._replicas])
+        self.handle = DeploymentHandle(self.name, self._replicas)
+        return self.handle
+
+    def _teardown(self):
+        for r in self._replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._replicas = []
+
+
+def deployment(_func=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, ray_actor_options: Optional[dict] = None,
+               user_config: Any = None,
+               autoscaling_config: Optional[dict] = None):
+    """@serve.deployment (reference: serve/api.py:251)."""
+
+    def wrap(cls_or_fn):
+        return Deployment(cls_or_fn, name or cls_or_fn.__name__,
+                          num_replicas, ray_actor_options, user_config,
+                          autoscaling_config)
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap
+
+
+def run(dep: Deployment, name: Optional[str] = None) -> DeploymentHandle:
+    """serve.run (reference: serve/api.py:455)."""
+    key = name or dep.name
+    old = _deployments.pop(key, None)
+    if old is not None:
+        old._teardown()
+    handle = dep._deploy()
+    _deployments[key] = dep
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return _deployments[name].handle
+
+
+def delete(name: str):
+    dep = _deployments.pop(name, None)
+    if dep is not None:
+        dep._teardown()
+
+
+def shutdown():
+    global _proxy
+    for name in list(_deployments):
+        delete(name)
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None
+
+
+class _HttpProxy:
+    """Threaded stdlib HTTP server forwarding POST /<deployment> bodies
+    (JSON) to handles (reference: HTTPProxy ASGI actor)."""
+
+    def __init__(self, port: int):
+        import http.server
+
+        proxy = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                name = self.path.strip("/").split("/")[0]
+                dep = _deployments.get(name)
+                if dep is None or dep.handle is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no such deployment"}')
+                    return
+                try:
+                    payload = json.loads(body) if body else None
+                    result = ray_tpu.get(dep.handle.remote(payload))
+                    out = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    out = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                      Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self.server.shutdown()
+
+
+def start_http_proxy(port: int = 0) -> int:
+    """Start the HTTP ingress; returns the bound port."""
+    global _proxy
+    if _proxy is None:
+        _proxy = _HttpProxy(port)
+    return _proxy.port

@@ -1,0 +1,48 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._pending: List[tuple] = []
+        self._results: List[Any] = []
+
+    def submit(self, fn: Callable, value: Any):
+        if self._idle:
+            actor = self._idle.pop()
+            fut = fn(actor, value)
+            self._future_to_actor[fut] = actor
+        else:
+            self._pending.append((fn, value))
+
+    def get_next(self, timeout=None):
+        if not self._future_to_actor:
+            raise StopIteration("no pending work")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError
+        fut = ready[0]
+        actor = self._future_to_actor.pop(fut)
+        if self._pending:
+            fn, value = self._pending.pop(0)
+            nfut = fn(actor, value)
+            self._future_to_actor[nfut] = actor
+        else:
+            self._idle.append(actor)
+        return ray_tpu.get(fut)
+
+    def map(self, fn: Callable, values: List[Any]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        for _ in range(len(values)):
+            yield self.get_next()
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor or self._pending)

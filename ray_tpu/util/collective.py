@@ -1,0 +1,176 @@
+"""Actor-level collective API (reference: python/ray/util/collective/
+collective.py — init_collective_group :120, allreduce :258, etc., over
+cupy-NCCL groups with a named-actor rendezvous).
+
+TPU-native position (SURVEY.md §2.3): *in-mesh* communication is in-graph
+XLA collectives over ICI and never goes through this API.  What remains is
+out-of-graph coordination between CPU actors / separate meshes — host
+numpy arrays moved through the object store via a named rendezvous actor.
+The group/rendezvous shape matches the reference so ported code keeps
+working; the NCCL communicator underneath is simply gone.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_LOCAL_GROUPS: Dict[str, "GroupHandle"] = {}
+
+
+@ray_tpu.remote
+class _CollectiveGroupActor:
+    """Rendezvous + reduction state for one group (the moral equivalent of
+    the reference's NCCLUniqueIDStore named actor, util/collective/util.py:9)."""
+
+    def __init__(self, world_size: int):
+        import threading
+
+        self.world_size = world_size
+        self._round: Dict[str, dict] = {}
+        self._cv = threading.Condition()
+
+    def _slot(self, op_key: str):
+        if op_key not in self._round:
+            self._round[op_key] = {"values": {}, "result": None, "done": 0}
+        return self._round[op_key]
+
+    def contribute(self, op_key: str, rank: int, value, op: str):
+        """Blocks until all ranks contribute; returns the reduced result."""
+        with self._cv:
+            slot = self._slot(op_key)
+            slot["values"][rank] = value
+            if len(slot["values"]) == self.world_size:
+                vals = [slot["values"][r] for r in range(self.world_size)]
+                slot["result"] = _reduce(vals, op)
+                self._cv.notify_all()
+            else:
+                self._cv.wait_for(
+                    lambda: slot["result"] is not None, timeout=300)
+            slot["done"] += 1
+            result = slot["result"]
+            if slot["done"] == self.world_size:
+                del self._round[op_key]
+            return result
+
+    def put_value(self, key: str, value):
+        with self._cv:
+            self._slot(key)["result"] = value
+            self._cv.notify_all()
+        return True
+
+    def get_value(self, key: str):
+        with self._cv:
+            slot = self._slot(key)
+            self._cv.wait_for(lambda: slot["result"] is not None, timeout=300)
+            return slot["result"]
+
+
+def _reduce(vals: List[Any], op: str):
+    if op == "SUM":
+        return sum(vals[1:], vals[0])
+    if op == "MAX":
+        return np.maximum.reduce(vals)
+    if op == "MIN":
+        return np.minimum.reduce(vals)
+    if op == "MEAN":
+        return sum(vals[1:], vals[0]) / len(vals)
+    if op == "GATHER":
+        return list(vals)
+    raise ValueError(f"bad reduce op {op}")
+
+
+class GroupHandle:
+    def __init__(self, name: str, world_size: int, rank: int, actor):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.actor = actor
+        self._op_counter = 0
+
+    def _next_key(self, op: str) -> str:
+        self._op_counter += 1
+        return f"{op}:{self._op_counter}"
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> GroupHandle:
+    """Create/join a named group (reference: collective.py:120)."""
+    actor_name = f"__collective__{group_name}"
+    if rank == 0:
+        # contribute() blocks in-actor until all ranks arrive, so the actor
+        # needs one execution slot per rank.
+        actor = _CollectiveGroupActor.options(
+            name=actor_name, num_cpus=0,
+            max_concurrency=world_size + 2).remote(world_size)
+    else:
+        import time
+
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                actor = ray_tpu.get_actor(actor_name)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+    handle = GroupHandle(group_name, world_size, rank, actor)
+    _LOCAL_GROUPS[group_name] = handle
+    return handle
+
+
+def _group(group_name: str) -> GroupHandle:
+    if group_name not in _LOCAL_GROUPS:
+        raise ValueError(f"collective group {group_name!r} not initialized "
+                         f"in this process")
+    return _LOCAL_GROUPS[group_name]
+
+
+def allreduce(tensor: np.ndarray, group_name: str = "default",
+              op: str = "SUM") -> np.ndarray:
+    g = _group(group_name)
+    key = g._next_key("allreduce")
+    return ray_tpu.get(g.actor.contribute.remote(key, g.rank,
+                                                 np.asarray(tensor), op))
+
+
+def allgather(tensor: np.ndarray, group_name: str = "default") -> List[np.ndarray]:
+    g = _group(group_name)
+    key = g._next_key("allgather")
+    return ray_tpu.get(g.actor.contribute.remote(key, g.rank,
+                                                 np.asarray(tensor), "GATHER"))
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = "SUM"):
+    out = allreduce(tensor, group_name, op)
+    g = _group(group_name)
+    return out if g.rank == dst_rank else tensor
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _group(group_name)
+    key = g._next_key("broadcast")
+    if g.rank == src_rank:
+        ray_tpu.get(g.actor.put_value.remote(key, np.asarray(tensor)))
+        return tensor
+    return ray_tpu.get(g.actor.get_value.remote(key))
+
+
+def barrier(group_name: str = "default"):
+    allreduce(np.zeros(1), group_name)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    ray_tpu.get(g.actor.put_value.remote(
+        f"p2p:{g.rank}->{dst_rank}:{g._next_key('send')}", np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    g = _group(group_name)
+    return ray_tpu.get(g.actor.get_value.remote(
+        f"p2p:{src_rank}->{g.rank}:{g._next_key('send')}"))

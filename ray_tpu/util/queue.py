@@ -1,0 +1,51 @@
+"""Distributed queue on an actor (reference: python/ray/util/queue.py)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import queue
+
+        self.q = queue.Queue(maxsize=maxsize)
+
+    def put(self, item, timeout=None):
+        self.q.put(item, timeout=timeout)
+        return True
+
+    def get(self, timeout=None):
+        return self.q.get(timeout=timeout)
+
+    def qsize(self):
+        return self.q.qsize()
+
+    def empty(self):
+        return self.q.empty()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0):
+        self.actor = _QueueActor.options(
+            num_cpus=0, max_concurrency=16).remote(maxsize)
+
+    def put(self, item: Any, timeout: Optional[float] = None):
+        ray_tpu.get(self.actor.put.remote(item, timeout))
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return ray_tpu.get(self.actor.get.remote(timeout))
+
+    def put_async(self, item: Any):
+        return self.actor.put.remote(item, None)
+
+    def get_async(self):
+        return self.actor.get.remote(None)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())

@@ -1,0 +1,201 @@
+"""Data / Serve / util-shim tests."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------- data ----------------
+def test_dataset_from_items_map_filter(cluster):
+    from ray_tpu import data
+
+    ds = data.from_items([{"x": i} for i in range(100)], parallelism=4)
+    assert ds.count() == 100
+    doubled = ds.map_batches(lambda b: {"x": b["x"] * 2})
+    assert doubled.take(3) == [{"x": 0}, {"x": 2}, {"x": 4}]
+    evens = ds.filter(lambda row: row["x"] % 2 == 0)
+    assert evens.count() == 50
+
+
+def test_dataset_split_and_iter_batches(cluster):
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=5)
+    shards = ds.split(4, equal=True)
+    assert [s.count() for s in shards] == [25, 25, 25, 25]
+    batches = list(ds.iter_batches(batch_size=32, drop_last=False))
+    assert sum(len(b["id"]) for b in batches) == 100
+
+
+def test_dataset_tensors_roundtrip(cluster):
+    from ray_tpu import data
+
+    x = np.random.rand(64, 8, 3).astype(np.float32)
+    ds = data.from_numpy({"img": x, "label": np.arange(64)}, parallelism=4)
+    got = np.concatenate([b["img"] for b in ds.iter_batches(16)])
+    np.testing.assert_array_equal(got, x)
+
+
+def test_dataset_parquet_io(cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu import data
+
+    path = str(tmp_path / "part0.parquet")
+    pq.write_table(pa.table({"a": list(range(10))}), path)
+    ds = data.read_parquet(path)
+    assert ds.count() == 10
+    assert ds.take(2) == [{"a": 0}, {"a": 1}]
+
+
+def test_standard_scaler(cluster):
+    from ray_tpu import data
+    from ray_tpu.data import StandardScaler
+
+    ds = data.from_numpy({"v": np.arange(100, dtype=np.float64)})
+    scaled = StandardScaler(["v"]).fit_transform(ds)
+    vals = np.concatenate([b["v"] for b in scaled.iter_batches(50)])
+    assert abs(vals.mean()) < 1e-6
+    assert abs(vals.std() - 1.0) < 1e-2
+
+
+# ---------------- serve ----------------
+def test_serve_function_deployment(cluster):
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind())
+    out = ray_tpu.get([handle.remote(i) for i in range(10)])
+    assert out == [i * i for i in range(10)]
+    serve.delete("square")
+
+
+def test_serve_class_deployment_and_http(cluster):
+    import json
+    import urllib.request
+
+    from ray_tpu import serve
+
+    @serve.deployment(name="adder")
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, payload):
+            return self.base + payload["x"]
+
+    serve.run(Adder.bind(10))
+    port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/adder",
+        data=json.dumps({"x": 5}).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert resp["result"] == 15
+    serve.shutdown()
+
+
+def test_autoscaling_policy():
+    from ray_tpu.serve import calculate_desired_num_replicas
+
+    assert calculate_desired_num_replicas(2, 4.0, 1.0, 1, 10) == 8
+    assert calculate_desired_num_replicas(4, 0.0, 1.0, 2, 10) == 2
+    assert calculate_desired_num_replicas(5, 1.0, 1.0, 1, 10) == 5
+
+
+# ---------------- util ----------------
+def test_actor_pool(cluster):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class W:
+        def work(self, x):
+            return x + 1
+
+    pool = ActorPool([W.remote() for _ in range(2)])
+    out = sorted(pool.map(lambda a, v: a.work.remote(v), list(range(8))))
+    assert out == list(range(1, 9))
+
+
+def test_queue(cluster):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue()
+    q.put({"a": 1})
+    q.put(2)
+    assert q.get() == {"a": 1}
+    assert q.get() == 2
+    assert q.empty()
+
+
+def test_collective_allreduce_between_actors(cluster):
+    from ray_tpu.util import collective as col  # driver import for API check
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective
+
+            self.col = collective
+            self.col.init_collective_group(world, rank, "g1")
+            self.rank = rank
+
+        def reduce_sum(self):
+            import numpy as np
+
+            return self.col.allreduce(np.full(3, self.rank + 1.0), "g1")
+
+        def bcast(self, value=None):
+            import numpy as np
+
+            if self.rank == 0:
+                return self.col.broadcast(np.asarray(value), 0, "g1")
+            return self.col.broadcast(None, 0, "g1")
+
+    r0 = Rank.options(max_concurrency=2).remote(0, 2)
+    r1 = Rank.options(max_concurrency=2).remote(1, 2)
+    out = ray_tpu.get([r0.reduce_sum.remote(), r1.reduce_sum.remote()])
+    np.testing.assert_array_equal(out[0], np.full(3, 3.0))
+    np.testing.assert_array_equal(out[1], np.full(3, 3.0))
+
+
+def test_dag(cluster):
+    import ray_tpu.dag as dag
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    graph = dag.bind(mul, dag.bind(add, 1, 2), 10)
+    assert ray_tpu.get(dag.execute(graph)) == 30
+
+
+def test_metrics(cluster):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("requests", tag_keys=("route",))
+    c.inc(1, {"route": "/a"})
+    c.inc(2, {"route": "/a"})
+    g = metrics.Gauge("temp")
+    g.set(42.5)
+    text = metrics.prometheus_text()
+    assert 'requests{route="/a"} 3' in text
+    assert "temp 42.5" in text
