@@ -825,6 +825,14 @@ class Head:
                                    lineage_task=msg.get("lineage_task"))
             self._notify_object(oid)
 
+    def on_arena_sealed(self, msg: dict):
+        """Driver wrote directly into the head raylet's native arena."""
+        oid = ObjectID(msg["oid"])
+        with self._lock:
+            self.gcs.object_sealed(oid, NodeID(msg["node_id"]), msg["size"],
+                                   lineage_task=msg.get("lineage_task"))
+            self._notify_object(oid)
+
     def on_put_inline(self, msg: dict):
         oid = ObjectID(msg["oid"])
         with self._lock:
@@ -847,6 +855,9 @@ class Head:
             for node_id in entry.locations:
                 raylet = self.raylets.get(node_id)
                 if raylet is not None:
+                    hit = raylet.store.arena_lookup(oid)
+                    if hit is not None:
+                        return hit
                     meta = raylet.store.meta(oid)
                     if meta is not None:
                         return {"kind": "store", "oid": oid, "meta": meta}

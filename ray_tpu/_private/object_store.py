@@ -18,6 +18,7 @@ Small objects never come here — they live in the in-process memory store
 """
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from multiprocessing import resource_tracker, shared_memory
@@ -82,7 +83,8 @@ class SharedMemoryStore:
     objects are the round-2 extension point (local_object_manager.h:41).
     """
 
-    def __init__(self, capacity_bytes: int = 2 * 1024**3):
+    def __init__(self, capacity_bytes: int = 2 * 1024**3,
+                 use_native_arena: bool = True):
         self.capacity = capacity_bytes
         self.used = 0
         self._objects: "OrderedDict[ObjectID, PlasmaObject]" = OrderedDict()
@@ -91,6 +93,19 @@ class SharedMemoryStore:
         # Called with the ObjectID when LRU eviction frees an object, so the
         # object directory can mark it lost / trigger lineage reconstruction.
         self.evict_callback = None
+        # Native C++ arena (plasma-core equivalent, ray_tpu/_native): used for
+        # owner-process writes (driver puts).  Worker-created objects keep
+        # the per-segment zero-round-trip path; both are zero-copy reads.
+        self.arena = None
+        if use_native_arena and os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+            try:
+                from ray_tpu import _native
+
+                if _native.available():
+                    self.arena = _native.NativeArenaStore(
+                        "rtpu_arena_" + os.urandom(6).hex(), capacity_bytes)
+            except Exception:
+                self.arena = None
 
     # -- create/seal ------------------------------------------------------
     def create(self, object_id: ObjectID, data_size: int) -> memoryview:
@@ -190,6 +205,8 @@ class SharedMemoryStore:
 
     def delete(self, object_id: ObjectID, evicted: bool = False):
         with self._lock:
+            if self.arena is not None:
+                self.arena.delete(object_id.binary())
             obj = self._objects.pop(object_id, None)
             self._pinned.pop(object_id, None)
             if obj is not None:
@@ -220,10 +237,32 @@ class SharedMemoryStore:
             if self._objects[oid].sealed:
                 self.delete(oid, evicted=True)
 
+    # -- native arena paths (owner process only) --
+    def arena_write(self, object_id: ObjectID, size: int) -> Optional[memoryview]:
+        if self.arena is None:
+            return None
+        return self.arena.allocate(object_id.binary(), size)
+
+    def arena_seal(self, object_id: ObjectID, metadata: bytes):
+        self.arena.seal(object_id.binary(), metadata)
+
+    def arena_lookup(self, object_id: ObjectID):
+        if self.arena is None:
+            return None
+        hit = self.arena.lookup(object_id.binary())
+        if hit is None:
+            return None
+        offset, size, meta = hit
+        return {"kind": "arena", "store": self.arena.name, "offset": offset,
+                "size": size, "meta": meta, "capacity": self.arena.capacity}
+
     def shutdown(self):
         with self._lock:
             for oid in list(self._objects.keys()):
                 self.delete(oid)
+            if self.arena is not None:
+                self.arena.close()
+                self.arena = None
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

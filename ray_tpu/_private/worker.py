@@ -64,6 +64,16 @@ class DirectTransport:
             self.head.on_put_inline(msg)
         elif t == "task_done":
             self.head.on_task_done(msg)
+        elif t == "arena_sealed":
+            self.head.on_arena_sealed(msg)
+
+    def arena_store_for(self, node_id):
+        """In-process fast path: the driver writes straight into the head
+        raylet's native arena (zero IPC)."""
+        raylet = self.head.raylets.get(node_id)
+        if raylet is not None and raylet.store.arena is not None:
+            return raylet.store
+        return None
 
     def close(self):
         pass
@@ -209,11 +219,25 @@ class CoreWorker:
                                    "meta": meta, "data": data,
                                    "lineage_task": lineage_task})
         else:
-            meta = self._write_to_store(oid, s, size)
-            self.transport.notify({"type": "seal", "oid": oid.binary(),
-                                   "node_id": self.node_id.binary(),
-                                   "size": size, "meta": meta,
-                                   "lineage_task": lineage_task})
+            store = getattr(self.transport, "arena_store_for",
+                            lambda n: None)(self.node_id)
+            view = store.arena_write(oid, size) if store is not None else None
+            if view is not None:
+                try:
+                    meta = ser.pack_into(s, view)
+                finally:
+                    view.release()
+                store.arena_seal(oid, meta)
+                self.transport.notify({
+                    "type": "arena_sealed", "oid": oid.binary(),
+                    "node_id": self.node_id.binary(), "size": size,
+                    "lineage_task": lineage_task})
+            else:
+                meta = self._write_to_store(oid, s, size)
+                self.transport.notify({"type": "seal", "oid": oid.binary(),
+                                       "node_id": self.node_id.binary(),
+                                       "size": size, "meta": meta,
+                                       "lineage_task": lineage_task})
         self._cache_value(oid, value)
 
     def _write_to_store(self, oid: ObjectID, s: ser.SerializedObject,
@@ -277,6 +301,17 @@ class CoreWorker:
             value, _ = ser.unpack(msg["meta"], shm.buf)
             self._cache_value(oid, value)
             self._shm_registry[oid] = shm  # keep mapping alive for zero-copy views
+            return value
+        if kind == "arena":
+            from ray_tpu._native import ArenaReader
+
+            try:
+                view = ArenaReader.view(msg["store"], msg["offset"],
+                                        msg["size"], msg["capacity"])
+            except FileNotFoundError:
+                raise exc.ObjectLostError(f"arena object {oid} vanished")
+            value, _ = ser.unpack(msg["meta"], view)
+            self._cache_value(oid, value)
             return value
         if kind == "error":
             err, _ = ser.unpack(msg["meta"], memoryview(msg["data"]))

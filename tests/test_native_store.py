@@ -1,0 +1,76 @@
+"""Native C++ arena store tests (build + allocator + e2e put/get)."""
+import numpy as np
+import pytest
+
+from ray_tpu import _native
+
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="g++ build unavailable")
+
+
+def test_arena_alloc_seal_get_delete():
+    store = _native.NativeArenaStore("rtpu_test_arena", 1 << 20)
+    try:
+        oid = b"x" * 20
+        view = store.allocate(oid, 1000)
+        view[:4] = b"abcd"
+        view.release()
+        store.seal(oid, b"meta!")
+        off, size, meta = store.lookup(oid)
+        assert size == 1000 and meta == b"meta!"
+        assert bytes(store.view(off, 4)) == "abcd".encode()
+        assert store.num_objects == 1
+        assert store.delete(oid)
+        assert store.lookup(oid) is None
+        assert store.used == 0
+    finally:
+        store.close()
+
+
+def test_arena_free_list_coalescing():
+    store = _native.NativeArenaStore("rtpu_test_arena2", 1 << 16)
+    try:
+        ids = [bytes([i]) * 20 for i in range(4)]
+        for i in ids:
+            assert store.allocate(i, 10_000) is not None
+        # Full-ish: a big allocation must fail.
+        assert store.allocate(b"z" * 20, 40_000) is None
+        # Free two adjacent blocks; coalesced space fits a 20k object.
+        store.delete(ids[1])
+        store.delete(ids[2])
+        assert store.allocate(b"z" * 20, 20_000) is not None
+    finally:
+        store.close()
+
+
+def test_driver_put_uses_arena(shutdown_only):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024**2)
+    head = ray_tpu._global_head()
+    store = next(iter(head.raylets.values())).store
+    if store.arena is None:
+        pytest.skip("arena disabled")
+    before = store.arena.num_objects
+    x = np.arange(500_000, dtype=np.float32)
+    ref = ray_tpu.put(x)
+    assert store.arena.num_objects == before + 1
+    # Force a real read (drop the local cache).
+    ray_tpu._worker()._value_cache.clear()
+    y = ray_tpu.get(ref)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_worker_reads_arena_object(shutdown_only):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024**2)
+    x = np.arange(300_000, dtype=np.float64)
+    ref = ray_tpu.put(x)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(total.remote(ref)) == float(x.sum())
