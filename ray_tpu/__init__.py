@@ -194,8 +194,12 @@ def nodes() -> List[dict]:
     return _worker().transport.request("state", {"what": "nodes"})
 
 
-def timeline() -> List[dict]:
-    return _worker().transport.request("state", {"what": "tasks"})
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace dump of task execution (reference: ray.timeline())."""
+    from ray_tpu._private.profiling import chrome_tracing_dump
+
+    tasks = _worker().transport.request("state", {"what": "tasks"})
+    return chrome_tracing_dump(tasks, filename)
 
 
 # Submodules re-exported lazily to keep `import ray_tpu` light (jax-free).

@@ -1,0 +1,59 @@
+"""Chaos / fault-injection hooks for schedule-perturbation testing.
+
+Reference: asio delay injection (src/ray/common/asio/asio_chaos.h:22, flag
+RAY_testing_asio_delay_us in ray_config_def.h:735-738) and the node-killer
+actor (python/ray/_private/test_utils.py:1337).
+
+Enable delays with RAY_TPU_TESTING_DELAY_MS="<op_substr>:<min>:<max>", e.g.
+"submit:0:20" delays every task submission by 0-20ms.  `kill_random_worker`
+is the in-process node-killer equivalent.
+"""
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Optional, Tuple
+
+
+def _parse() -> Optional[Tuple[str, float, float]]:
+    spec = os.environ.get("RAY_TPU_TESTING_DELAY_MS")
+    if not spec:
+        return None
+    try:
+        op, lo, hi = spec.split(":")
+        return op, float(lo), float(hi)
+    except ValueError:
+        return None
+
+
+def maybe_delay(op: str):
+    """Called on head-side operations; sleeps if the op matches the spec."""
+    parsed = _parse()
+    if parsed is None:
+        return
+    needle, lo, hi = parsed
+    if needle in op:
+        time.sleep(random.uniform(lo, hi) / 1000.0)
+
+
+def kill_random_worker(head=None, rng: Optional[random.Random] = None) -> bool:
+    """Kill one random busy worker process (crash injection). Returns True
+    if something was killed."""
+    import ray_tpu
+
+    head = head or ray_tpu._global_head()
+    rng = rng or random.Random()
+    with head._lock:
+        candidates = [
+            w for r in head.raylets.values() for w in r.workers.values()
+            if w.proc is not None and w.conn is not None
+        ]
+    if not candidates:
+        return False
+    victim = rng.choice(candidates)
+    try:
+        victim.proc.kill()
+        return True
+    except Exception:
+        return False

@@ -406,6 +406,9 @@ class GCS:
                     "attempt": t.attempt,
                     "type": t.type,
                     "error": t.error,
+                    "start": t.start,
+                    "end": t.end,
+                    "worker_id": t.worker_id.hex() if t.worker_id else None,
                 }
                 for t in self.task_events.values()
             ]

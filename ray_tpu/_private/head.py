@@ -470,6 +470,9 @@ class Head:
 
     # ================= task manager =================
     def submit_task(self, spec: TaskSpec):
+        from ray_tpu._private.chaos import maybe_delay
+
+        maybe_delay("submit")
         with self._lock:
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, TaskStatus.PENDING,
@@ -536,6 +539,9 @@ class Head:
             info.pending_calls.append(spec)
 
     def on_task_done(self, msg: dict):
+        from ray_tpu._private.chaos import maybe_delay
+
+        maybe_delay("task_done")
         task_id = TaskID(msg["task_id"])
         with self._lock:
             spec_worker = self.running.pop(task_id, None)
@@ -556,7 +562,10 @@ class Head:
                     return
                 status = TaskStatus.FAILED if error else TaskStatus.FINISHED
                 self.gcs.update_task_status(task_id, status,
-                                            error=msg.get("error_str"))
+                                            error=msg.get("error_str"),
+                                            worker_id=worker_id,
+                                            start=msg.get("start"),
+                                            end=msg.get("end"))
                 # Unpin arg refs (direct and nested).
                 for arg in list(spec.args) + list(spec.kwargs.values()):
                     for oid in ([arg.ref] if arg.ref is not None else []) \

@@ -1,0 +1,31 @@
+"""Chrome-trace timeline export (reference: ray.timeline() →
+chrome_tracing_dump, python/ray/_private/profiling.py:43 over core-worker
+profile events, src/ray/core_worker/profile_event.h)."""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def chrome_tracing_dump(task_events: List[dict],
+                        filename: Optional[str] = None) -> List[dict]:
+    """Convert the state API's task list into chrome://tracing events."""
+    events = []
+    for t in task_events:
+        if t.get("start") is None or t.get("end") is None:
+            continue
+        events.append({
+            "name": t["name"],
+            "cat": t.get("type", "TASK"),
+            "ph": "X",  # complete event
+            "ts": t["start"] * 1e6,
+            "dur": (t["end"] - t["start"]) * 1e6,
+            "pid": "ray_tpu",
+            "tid": (t.get("worker_id") or "driver")[:12],
+            "args": {"task_id": t["task_id"], "attempt": t.get("attempt", 0),
+                     "status": t.get("status")},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -391,9 +391,12 @@ class CoreWorker:
     # ---- task execution ----
     def execute_task(self, spec: TaskSpec) -> dict:
         """Run a task and build the task_done message (does not send it)."""
+        import time as _time
+
         self.ctx.task_id = spec.task_id
         self.ctx.task_name = spec.name
         self.ctx.put_counter = 0
+        start_ts = _time.time()
         error = None
         error_str = None
         results: List[TaskResult] = []
@@ -434,6 +437,8 @@ class CoreWorker:
             "error": error,
             "error_str": error_str,
             "crashed": False,
+            "start": start_ts,
+            "end": _time.time(),
         }
 
     def _resolve_arg(self, arg: TaskArg):

@@ -1,0 +1,147 @@
+"""Autoscaler: resource-demand-driven node scaling.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py:168
+(StandardAutoscaler.update :366 — read load metrics, bin-pack pending
+demands onto node types, ask the NodeProvider to launch/terminate) and the
+FakeMultiNodeProvider test provider (fake_multi_node/node_provider.py:237).
+
+The TPU deployment unit is a *slice* (a whole pod-slice of hosts joins or
+leaves together), so node types here are slice-shaped bundles.  The
+in-process provider adds/removes virtual raylets — the same mechanism the
+reference uses for autoscaler tests.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Pluggable provider interface (reference: autoscaler/node_provider.py:13)."""
+
+    def create_node(self, node_type: str, resources: Dict[str, float]):
+        raise NotImplementedError
+
+    def terminate_node(self, node_id):
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Adds/removes virtual raylets in the running head (the fake-multinode
+    pattern)."""
+
+    def __init__(self, head=None):
+        import ray_tpu
+
+        self.head = head or ray_tpu._global_head()
+        self.created: List = []
+
+    def create_node(self, node_type: str, resources: Dict[str, float]):
+        node_id = self.head.add_node(resources, labels={"node_type": node_type})
+        self.created.append(node_id)
+        return node_id
+
+    def terminate_node(self, node_id):
+        self.head.remove_node(node_id)
+        if node_id in self.created:
+            self.created.remove(node_id)
+
+    def non_terminated_nodes(self) -> List:
+        return list(self.created)
+
+
+class StandardAutoscaler:
+    def __init__(self, node_types: Dict[str, Dict],
+                 provider: Optional[NodeProvider] = None,
+                 max_nodes: int = 8, idle_timeout_s: float = 60.0,
+                 head=None):
+        """node_types: {name: {"resources": {...}, "max_workers": n}}."""
+        import ray_tpu
+
+        self.head = head or ray_tpu._global_head()
+        self.provider = provider or LocalNodeProvider(self.head)
+        self.node_types = node_types
+        self.max_nodes = max_nodes
+        self.idle_timeout_s = idle_timeout_s
+        self._node_idle_since: Dict = {}
+
+    # ---- one reconciliation pass (reference: update :366) ----
+    def update(self) -> Dict[str, int]:
+        launched: Dict[str, int] = {}
+        demands = self._pending_demands()
+        for demand in demands:
+            if len(self.provider.non_terminated_nodes()) >= self.max_nodes:
+                break
+            nt = self._fit_node_type(demand)
+            if nt is not None:
+                self.provider.create_node(nt, dict(
+                    self.node_types[nt]["resources"]))
+                launched[nt] = launched.get(nt, 0) + 1
+        self._terminate_idle()
+        return launched
+
+    def _pending_demands(self) -> List[Dict[str, float]]:
+        with self.head._lock:
+            demands = [dict(spec.resources) for spec in self.head.pending]
+            for raylet in self.head.raylets.values():
+                demands.extend(dict(s.resources) for s in raylet.queued)
+            # Pending placement groups contribute bundle demands.
+            for pg in self.head._pending_pgs:
+                demands.extend(dict(b.resources) for b in pg.bundles)
+        return demands
+
+    def _fit_node_type(self, demand: Dict[str, float]) -> Optional[str]:
+        for name, nt in self.node_types.items():
+            res = nt["resources"]
+            if all(res.get(k, 0.0) >= v for k, v in demand.items()):
+                count = sum(1 for n in self.provider.non_terminated_nodes())
+                if count < nt.get("max_workers", self.max_nodes):
+                    return name
+        return None
+
+    def _terminate_idle(self):
+        now = time.monotonic()
+        for node_id in list(self.provider.non_terminated_nodes()):
+            raylet = self.head.raylets.get(node_id)
+            if raylet is None:
+                continue
+            busy = (raylet.queued
+                    or any(w.busy or w.actor_id for w in raylet.workers.values()))
+            if busy:
+                self._node_idle_since.pop(node_id, None)
+                continue
+            since = self._node_idle_since.setdefault(node_id, now)
+            if now - since > self.idle_timeout_s:
+                self.provider.terminate_node(node_id)
+                self._node_idle_since.pop(node_id, None)
+
+
+class Monitor:
+    """Background loop hosting the autoscaler (reference:
+    autoscaler/_private/monitor.py:126)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler, interval_s: float = 1.0):
+        self.autoscaler = autoscaler
+        self.interval = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-autoscaler")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.update()
+            except Exception:
+                pass
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()

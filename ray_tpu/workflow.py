@@ -1,0 +1,104 @@
+"""Durable workflows: DAGs with storage-backed step results and resume.
+
+Reference: python/ray/workflow/ (workflow_executor.py, storage-backed step
+results; 10.1k LoC there).  The essentials here: steps are remote tasks
+whose results are checkpointed to a storage dir keyed by (workflow_id,
+step name); re-running a workflow skips completed steps (idempotent
+resume after a crash).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+_storage_dir: Optional[str] = None
+
+
+def init(storage: str):
+    global _storage_dir
+    _storage_dir = storage
+    os.makedirs(storage, exist_ok=True)
+
+
+class StepNode:
+    def __init__(self, fn: Callable, args: tuple, kwargs: dict,
+                 name: Optional[str] = None, max_retries: int = 3):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.max_retries = max_retries
+        self.name = name or getattr(fn, "__name__", "step")
+
+    def options(self, name: Optional[str] = None, max_retries: Optional[int] = None):
+        if name:
+            self.name = name
+        if max_retries is not None:
+            self.max_retries = max_retries
+        return self
+
+
+def step(fn: Callable):
+    """@workflow.step decorator: fn(*args) -> StepNode on .step(...)."""
+
+    class _Builder:
+        def step(self, *args, **kwargs) -> StepNode:
+            return StepNode(fn, args, kwargs)
+
+        def __call__(self, *args, **kwargs):
+            return fn(*args, **kwargs)
+
+    return _Builder()
+
+
+def _step_key(workflow_id: str, node: StepNode, resolved_args) -> str:
+    h = hashlib.sha256()
+    h.update(node.name.encode())
+    try:
+        h.update(pickle.dumps(resolved_args))
+    except Exception:
+        pass
+    return f"{workflow_id}/{node.name}_{h.hexdigest()[:12]}"
+
+
+def _result_path(key: str) -> str:
+    return os.path.join(_storage_dir, key + ".pkl")
+
+
+def run(node: StepNode, workflow_id: str) -> Any:
+    """Execute the DAG rooted at `node`, checkpointing each step."""
+    if _storage_dir is None:
+        raise RuntimeError("workflow.init(storage_dir) first")
+    os.makedirs(os.path.join(_storage_dir, workflow_id), exist_ok=True)
+    return _run_node(node, workflow_id)
+
+
+def _run_node(node: StepNode, workflow_id: str) -> Any:
+    resolved_args = [
+        _run_node(a, workflow_id) if isinstance(a, StepNode) else a
+        for a in node.args
+    ]
+    resolved_kwargs = {
+        k: _run_node(v, workflow_id) if isinstance(v, StepNode) else v
+        for k, v in node.kwargs.items()
+    }
+    key = _step_key(workflow_id, node, (resolved_args, resolved_kwargs))
+    path = _result_path(key)
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return pickle.load(f)  # resume: step already completed
+    remote_fn = ray_tpu.remote(node.fn).options(max_retries=node.max_retries)
+    result = ray_tpu.get(remote_fn.remote(*resolved_args, **resolved_kwargs))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(result, f)
+    os.replace(tmp, path)  # atomic commit
+    return result
+
+
+def list_steps(workflow_id: str) -> List[str]:
+    d = os.path.join(_storage_dir, workflow_id)
+    return sorted(os.listdir(d)) if os.path.isdir(d) else []
