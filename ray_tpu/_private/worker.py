@@ -401,6 +401,15 @@ class CoreWorker:
         error_str = None
         results: List[TaskResult] = []
         try:
+            # Runtime env (lite): per-task/actor env vars (reference:
+            # python/ray/_private/runtime_env/ plugin architecture; the
+            # conda/pip/container plugins need per-node agents — round 2).
+            env_vars = (spec.runtime_env or {}).get("env_vars") or {}
+            if env_vars:
+                import os as _os
+
+                _os.environ.update({str(k): str(v)
+                                    for k, v in env_vars.items()})
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(a) for k, a in spec.kwargs.items()}
             if spec.task_type == TaskType.NORMAL:

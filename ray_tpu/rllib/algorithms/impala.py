@@ -59,6 +59,89 @@ def impala_loss(params, module, batch, *, gamma, clip_rho, clip_c,
 class IMPALA(Algorithm):
     _default_config_cls = IMPALAConfig
 
+    # ---- anakin mode: on-device rollout + V-trace update in one jit ----
+    def _setup_anakin(self):
+        import functools as ft
+
+        from ray_tpu.rllib.algorithms import ppo as ppo_mod
+        from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+
+        config = self.config
+        env = make_jax_env(config.env) if isinstance(config.env, str) \
+            else config.env
+        spec = RLModuleSpec(obs_dim=env.obs_dim, num_actions=env.num_actions,
+                            hiddens=tuple(config.hiddens))
+        module = self.module = spec.build()
+        tx = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip or 1e9),
+            optax.adam(config.lr))
+        N, T = config.num_envs, config.unroll_length
+        loss_fn = ft.partial(impala_loss, gamma=config.gamma,
+                             clip_rho=config.vtrace_clip_rho,
+                             clip_c=config.vtrace_clip_c,
+                             vf_loss_coeff=config.vf_loss_coeff,
+                             entropy_coeff=config.entropy_coeff)
+
+        def init_fn(seed=0):
+            rng = jax.random.PRNGKey(seed)
+            rng, k_init, k_env = jax.random.split(rng, 3)
+            env_states, obs = vector_reset(env, k_env, N)
+            params = module.init(k_init, obs)
+            return ppo_mod.AnakinState(params, tx.init(params), env_states,
+                                       obs, rng, jnp.zeros(N), jnp.zeros(()),
+                                       jnp.zeros(()))
+
+        def rollout_step(carry, _):
+            params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+            rng, k_act, k_step = jax.random.split(rng, 3)
+            action, logp, _ = module.forward_exploration(params, obs, k_act)
+            env_states, next_obs, reward, done, _ = vector_step(
+                env, env_states, action, k_step)
+            ep_ret = ep_ret + reward
+            dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+            dcnt = dcnt + jnp.sum(done)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            out = (obs, action, logp, reward, done)
+            return (params, env_states, next_obs, rng, ep_ret, dsum, dcnt), out
+
+        def train_step(state):
+            carry = (state.params, state.env_states, state.obs, state.rng,
+                     state.ep_return, state.done_return_sum, state.done_count)
+            carry, traj = jax.lax.scan(rollout_step, carry, None, length=T)
+            params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+            obs_t, act_t, logp_t, rew_t, done_t = traj
+            _, last_value = module.apply(params, obs)
+            batch = {"obs": obs_t, "actions": act_t, "behaviour_logp": logp_t,
+                     "rewards": rew_t, "dones": done_t.astype(jnp.float32),
+                     "last_value": last_value}
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, module, batch)
+            updates, opt_state = tx.update(grads, state.opt_state, params)
+            params = optax.apply_updates(params, updates)
+            new_state = ppo_mod.AnakinState(params, opt_state, env_states,
+                                            obs, rng, ep_ret, dsum, dcnt)
+            metrics = {"total_loss": loss, **aux,
+                       "episode_return_sum": dsum, "episode_count": dcnt}
+            return new_state, metrics
+
+        self._anakin_state = init_fn(config.seed)
+        self._train_step = jax.jit(train_step)
+        self._steps_per_iter = N * T
+
+    def _training_step_anakin(self):
+        prev_sum = float(self._anakin_state.done_return_sum)
+        prev_cnt = float(self._anakin_state.done_count)
+        self._anakin_state, metrics = self._train_step(self._anakin_state)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        dsum = metrics.pop("episode_return_sum") - prev_sum
+        dcnt = metrics.pop("episode_count") - prev_cnt
+        if dcnt > 0:
+            self._ep_reward_ema = dsum / dcnt
+        metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",
+                                                 float("nan"))
+        metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
+        return metrics
+
     def _setup_actor_mode(self):
         from ray_tpu.rllib.core.learner import JaxLearner
         from ray_tpu.rllib.evaluation.worker_set import WorkerSet

@@ -1,0 +1,122 @@
+"""Replay buffers (reference: rllib/utils/replay_buffers/ +
+rllib/execution/segment_tree.py): uniform ReplayBuffer and
+PrioritizedReplayBuffer over sum/min segment trees."""
+from __future__ import annotations
+
+import random
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class SegmentTree:
+    def __init__(self, capacity: int, op, neutral: float):
+        assert capacity > 0 and capacity & (capacity - 1) == 0, \
+            "capacity must be a power of 2"
+        self.capacity = capacity
+        self.op = op
+        self.tree = np.full(2 * capacity, neutral, np.float64)
+        self.neutral = neutral
+
+    def __setitem__(self, idx: int, val: float):
+        idx += self.capacity
+        self.tree[idx] = val
+        idx //= 2
+        while idx >= 1:
+            self.tree[idx] = self.op(self.tree[2 * idx], self.tree[2 * idx + 1])
+            idx //= 2
+
+    def __getitem__(self, idx: int) -> float:
+        return float(self.tree[idx + self.capacity])
+
+    def reduce(self) -> float:
+        return float(self.tree[1])
+
+
+class SumSegmentTree(SegmentTree):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, np.add, 0.0)
+
+    def find_prefixsum_idx(self, prefixsum: float) -> int:
+        idx = 1
+        while idx < self.capacity:
+            if self.tree[2 * idx] > prefixsum:
+                idx = 2 * idx
+            else:
+                prefixsum -= self.tree[2 * idx]
+                idx = 2 * idx + 1
+        return idx - self.capacity
+
+
+class MinSegmentTree(SegmentTree):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, np.minimum, float("inf"))
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int = 10000, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._storage: List[Any] = []
+        self._next_idx = 0
+        self.rng = random.Random(seed)
+
+    def __len__(self):
+        return len(self._storage)
+
+    def add(self, item: Any):
+        if self._next_idx >= len(self._storage):
+            self._storage.append(item)
+        else:
+            self._storage[self._next_idx] = item
+        self._next_idx = (self._next_idx + 1) % self.capacity
+
+    def sample(self, num_items: int) -> SampleBatch:
+        idxes = [self.rng.randrange(len(self._storage))
+                 for _ in range(num_items)]
+        return SampleBatch.concat_samples([self._storage[i] for i in idxes])
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    def __init__(self, capacity: int = 10000, alpha: float = 0.6,
+                 seed: Optional[int] = None):
+        super().__init__(capacity, seed)
+        cap2 = 1
+        while cap2 < capacity:
+            cap2 *= 2
+        self._sum = SumSegmentTree(cap2)
+        self._min = MinSegmentTree(cap2)
+        self._max_priority = 1.0
+        self.alpha = alpha
+
+    def add(self, item: Any, priority: Optional[float] = None):
+        idx = self._next_idx
+        super().add(item)
+        p = (priority if priority is not None else self._max_priority)
+        self._sum[idx] = p ** self.alpha
+        self._min[idx] = p ** self.alpha
+
+    def sample(self, num_items: int, beta: float = 0.4):
+        """Returns (batch, idxes, is_weights)."""
+        idxes = []
+        total = self._sum.reduce()
+        for _ in range(num_items):
+            mass = self.rng.random() * total
+            idxes.append(self._sum.find_prefixsum_idx(mass))
+        p_min = self._min.reduce() / total
+        max_weight = (p_min * len(self._storage)) ** (-beta)
+        weights = np.array([
+            ((self._sum[i] / total) * len(self._storage)) ** (-beta)
+            / max_weight
+            for i in idxes
+        ], np.float32)
+        batch = SampleBatch.concat_samples([self._storage[i] for i in idxes])
+        return batch, idxes, weights
+
+    def update_priorities(self, idxes: List[int], priorities: np.ndarray):
+        for i, p in zip(idxes, priorities):
+            p = float(max(p, 1e-6))
+            self._sum[i] = p ** self.alpha
+            self._min[i] = p ** self.alpha
+            self._max_priority = max(self._max_priority, p)
