@@ -71,6 +71,14 @@ class Head:
         self._conns: Dict[WorkerID, Any] = {}
         self._conn_worker: Dict[int, WorkerID] = {}
         self._pending_pgs: List[PlacementGroupInfo] = []
+        # Arena reader leases: oid -> {holder worker id: count}.  Granted when
+        # an arena resolution is handed to a reader, released when the reader
+        # drops its last zero-copy view.  The equivalent of plasma's client
+        # in-use counts (the reference never reuses memory while a client
+        # holds the buffer): an arena slot must not be recycled while any
+        # process may still read it.
+        self._arena_leases: Dict[ObjectID, Dict[bytes, int]] = defaultdict(dict)
+        self._arena_pending_free: set = set()
         self._cancelled: set = set()  # task ids cancelled while running
         self._shutdown = False
         self._listener = Listener(self.socket_path, family="AF_UNIX",
@@ -176,6 +184,8 @@ class Head:
                     self.on_seal(msg)
                 elif mtype == "put_inline":
                     self.on_put_inline(msg)
+                elif mtype == "arena_release":
+                    self.on_arena_release(msg)
                 elif mtype == "request":
                     self._handle_request(msg, conn, worker_id)
         except (EOFError, OSError, BrokenPipeError):
@@ -204,6 +214,7 @@ class Head:
                     raylet.on_worker_lost(worker_id)
                     raylet.try_dispatch()
                     break
+            self._drop_arena_leases_for(worker_id.binary())
             freed = self.gcs.remove_all_references(worker_id.binary())
             for oid in freed:
                 self._free_object(oid)
@@ -254,6 +265,8 @@ class Head:
         with self._lock:
             resolved = self._resolve_object(oid)
             if resolved is not None:
+                if resolved.get("kind") == "arena":
+                    self._grant_arena_lease(oid, caller)
                 reply(resolved)
                 return
             entry = self.gcs.object_lookup(oid)
@@ -267,6 +280,8 @@ class Head:
             def cb(resolved_msg):
                 if not record["done"]:
                     record["done"] = True
+                    if resolved_msg.get("kind") == "arena":
+                        self._grant_arena_lease(oid, caller)
                     reply(resolved_msg)
 
             cb_list.append(cb)
@@ -909,11 +924,56 @@ class Head:
             return
         if b"task:" in {h[:5] for h in entry.holders}:
             return
+        if self._arena_leases.get(oid):
+            # A reader still holds a zero-copy view over the arena slot:
+            # defer the free until the last lease is returned (plasma
+            # semantics — never recycle memory under a client).
+            self._arena_pending_free.add(oid)
+            return
+        self._arena_pending_free.discard(oid)
         for node_id in list(entry.locations):
             raylet = self.raylets.get(node_id)
             if raylet is not None:
                 raylet.store.delete(oid)
         self.gcs.free_object(oid)
+
+    # ----- arena reader leases -----
+    def _grant_arena_lease(self, oid: ObjectID, caller: Optional[WorkerID]):
+        holder = caller.binary() if caller is not None else b"driver"
+        with self._lock:
+            holders = self._arena_leases[oid]
+            holders[holder] = holders.get(holder, 0) + 1
+
+    def on_arena_release(self, msg: dict):
+        oid = ObjectID(msg["oid"])
+        holder = msg["holder"]
+        with self._lock:
+            holders = self._arena_leases.get(oid)
+            if holders is not None and holder in holders:
+                if holders[holder] <= 1:
+                    holders.pop(holder)
+                else:
+                    holders[holder] -= 1
+                if not holders:
+                    self._arena_leases.pop(oid, None)
+            self._maybe_complete_deferred_free(oid)
+
+    def _drop_arena_leases_for(self, holder: bytes):
+        for oid in list(self._arena_leases.keys()):
+            # .get(): a reentrant on_arena_release (GC finalizer on this
+            # thread — the RLock does not exclude it) may have removed the
+            # entry since the snapshot.
+            holders = self._arena_leases.get(oid)
+            if holders is not None and holder in holders:
+                holders.pop(holder)
+                if not holders:
+                    self._arena_leases.pop(oid, None)
+                self._maybe_complete_deferred_free(oid)
+
+    def _maybe_complete_deferred_free(self, oid: ObjectID):
+        if oid in self._arena_pending_free and not self._arena_leases.get(oid):
+            self._arena_pending_free.discard(oid)
+            self._free_object(oid)
 
     # ================= shutdown =================
     def shutdown(self):

@@ -64,10 +64,20 @@ class Raylet:
         """Spawn a new worker process if needed for `spec` (or any task)."""
         needs_tpu = spec is not None and spec.resources.get("TPU", 0) > 0
         if needs_tpu:
-            # TPU tasks need a TPU-visible worker; spawn one if none exists
-            # (idle or busy) and none is starting.
-            if any(w.tpu_visible for w in self.workers.values()):
-                return
+            # TPU tasks need a TPU-visible worker.  A worker that is busy or
+            # permanently pinned to an actor can never serve this spec, so
+            # "some TPU worker exists" is not enough — that silently
+            # deadlocked a second TPU actor on the same node.  Spawn another
+            # as long as none is *available or starting* and the node has
+            # pool headroom (the scheduler already capped concurrent TPU
+            # grants to the node's TPU resource total).
+            for w in self.workers.values():
+                if not w.tpu_visible:
+                    continue
+                if w.conn is None:  # still starting — wait for it
+                    return
+                if not w.busy and w.actor_id is None:  # idle and claimable
+                    return
             if len(self.workers) < self.max_workers:
                 self.spawn_worker(tpu_visible=True)
             return

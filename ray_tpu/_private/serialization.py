@@ -173,6 +173,12 @@ def unpack(meta: bytes, data: memoryview) -> Tuple[Any, List[Any]]:
     return deserialize(inband, buffers)
 
 
+def num_oob_buffers(meta: bytes) -> int:
+    """Number of out-of-band buffers recorded in an object's metadata —
+    i.e. whether deserializing it yields zero-copy views over the store."""
+    return len(pickle.loads(meta)["buffers"])
+
+
 def _align(n: int, a: int = 64) -> int:
     return (n + a - 1) & ~(a - 1)
 

@@ -66,6 +66,8 @@ class DirectTransport:
             self.head.on_task_done(msg)
         elif t == "arena_sealed":
             self.head.on_arena_sealed(msg)
+        elif t == "arena_release":
+            self.head.on_arena_release(msg)
 
     def arena_store_for(self, node_id):
         """In-process fast path: the driver writes straight into the head
@@ -129,6 +131,75 @@ class ConnTransport:
                 if not fut.done():
                     fut.set_exception(exc.RayTpuError("connection closed"))
             self._futures.clear()
+
+
+class _EnvOverlay:
+    """Refcounted runtime-env env-var overlay for pooled workers.
+
+    Concurrent execute_task threads (async/threaded actors) mutate the
+    process-global os.environ; a naive per-task save/restore can permanently
+    install another task's injected value.  Instead the *pristine* value of
+    each key is recorded once (while any override is active) and restored
+    when the last overriding task finishes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._orig: Dict[str, Optional[str]] = {}
+        self._counts: Dict[str, int] = {}
+
+    def apply(self, env_vars: Dict[str, Any]):
+        import os
+
+        with self._lock:
+            for k, v in env_vars.items():
+                k = str(k)
+                if self._counts.get(k, 0) == 0:
+                    self._orig[k] = os.environ.get(k)
+                self._counts[k] = self._counts.get(k, 0) + 1
+                os.environ[k] = str(v)
+
+    def restore(self, env_vars: Dict[str, Any]):
+        import os
+
+        with self._lock:
+            for k in env_vars:
+                k = str(k)
+                n = self._counts.get(k, 0)
+                if n <= 1:
+                    self._counts.pop(k, None)
+                    old = self._orig.pop(k, None)
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
+                else:
+                    self._counts[k] = n - 1
+
+    def adopt(self, env_vars: Dict[str, Any]):
+        """Make the current overrides permanent (actor-creation: the worker
+        is dedicated to the actor from here on)."""
+        with self._lock:
+            for k in env_vars:
+                k = str(k)
+                self._counts.pop(k, None)
+                self._orig.pop(k, None)
+
+
+_env_overlay = _EnvOverlay()
+
+
+def _arena_lease_releaser(transport, oid_bin: bytes, holder_bin: bytes):
+    """Standalone finalizer (must not capture the buffer owner) that returns
+    this process's reader lease on an arena object to the head."""
+
+    def release():
+        try:
+            transport.notify({"type": "arena_release", "oid": oid_bin,
+                              "holder": holder_bin})
+        except Exception:
+            pass
+
+    return release
 
 
 # ---------------------------------------------------------------------------
@@ -303,14 +374,39 @@ class CoreWorker:
             self._shm_registry[oid] = shm  # keep mapping alive for zero-copy views
             return value
         if kind == "arena":
+            import weakref
+
+            import numpy as np
+
             from ray_tpu._native import ArenaReader
 
+            # The head granted this process a reader lease on the arena slot
+            # when it handed out this resolution; the slot will not be
+            # recycled until we release it (plasma in-use-count semantics).
             try:
                 view = ArenaReader.view(msg["store"], msg["offset"],
                                         msg["size"], msg["capacity"])
             except FileNotFoundError:
+                self._release_arena_lease(oid)
                 raise exc.ObjectLostError(f"arena object {oid} vanished")
-            value, _ = ser.unpack(msg["meta"], view)
+            try:
+                # Wrap the raw view in a weakref-able carrier: every
+                # zero-copy array deserialized out of this object keeps a
+                # buffer chain back to `owner`, so its finalizer fires
+                # exactly when the last view is garbage-collected.
+                owner = np.frombuffer(view, dtype=np.uint8)
+                value, _ = ser.unpack(msg["meta"], memoryview(owner))
+            except BaseException:
+                self._release_arena_lease(oid)
+                raise
+            if ser.num_oob_buffers(msg["meta"]):
+                weakref.finalize(
+                    owner, _arena_lease_releaser(
+                        self.transport, oid.binary(),
+                        self.worker_id.binary()))
+            else:
+                # Nothing in `value` views the arena (in-band pickle only).
+                self._release_arena_lease(oid)
             self._cache_value(oid, value)
             return value
         if kind == "error":
@@ -319,6 +415,14 @@ class CoreWorker:
                 raise err
             raise exc.RayTpuError(str(err))
         raise exc.RayTpuError(f"bad resolution kind {kind}")
+
+    def _release_arena_lease(self, oid: ObjectID):
+        try:
+            self.transport.notify({"type": "arena_release",
+                                   "oid": oid.binary(),
+                                   "holder": self.worker_id.binary()})
+        except Exception:
+            pass
 
     def get_async(self, ref: ObjectRef) -> Future:
         fut: Future = Future()
@@ -400,16 +504,18 @@ class CoreWorker:
         error = None
         error_str = None
         results: List[TaskResult] = []
+        env_vars: Dict[str, Any] = {}
         try:
             # Runtime env (lite): per-task/actor env vars (reference:
             # python/ray/_private/runtime_env/ plugin architecture; the
             # conda/pip/container plugins need per-node agents — round 2).
             env_vars = (spec.runtime_env or {}).get("env_vars") or {}
             if env_vars:
-                import os as _os
-
-                _os.environ.update({str(k): str(v)
-                                    for k, v in env_vars.items()})
+                # Pooled workers execute many tasks: overlay the keys and
+                # restore the pristine values afterwards so one task's env
+                # does not leak into the next (the reference instead
+                # dedicates workers to a runtime env).
+                _env_overlay.apply(env_vars)
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(a) for k, a in spec.kwargs.items()}
             if spec.task_type == TaskType.NORMAL:
@@ -436,6 +542,14 @@ class CoreWorker:
             s = ser.serialize(terr)
             error = ser.pack(s)
         finally:
+            # Actor-creation env vars stay: the worker is dedicated to the
+            # actor from here on (matching the reference's dedicated-worker
+            # runtime-env model).
+            if env_vars:
+                if spec.task_type == TaskType.ACTOR_CREATION:
+                    _env_overlay.adopt(env_vars)
+                else:
+                    _env_overlay.restore(env_vars)
             self.ctx.task_id = None
         return {
             "type": "task_done",

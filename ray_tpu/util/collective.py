@@ -19,6 +19,9 @@ import ray_tpu
 
 _LOCAL_GROUPS: Dict[str, "GroupHandle"] = {}
 
+# Rendezvous timeout: failing loudly beats silently returning None.
+TIMEOUT_S = 300.0
+
 
 @ray_tpu.remote
 class _CollectiveGroupActor:
@@ -38,7 +41,9 @@ class _CollectiveGroupActor:
         return self._round[op_key]
 
     def contribute(self, op_key: str, rank: int, value, op: str):
-        """Blocks until all ranks contribute; returns the reduced result."""
+        """Blocks until all ranks contribute; returns the reduced result.
+        Raises TimeoutError if the group never completes the rendezvous —
+        a silent None would poison every subsequent collective."""
         with self._cv:
             slot = self._slot(op_key)
             slot["values"][rank] = value
@@ -46,13 +51,17 @@ class _CollectiveGroupActor:
                 vals = [slot["values"][r] for r in range(self.world_size)]
                 slot["result"] = _reduce(vals, op)
                 self._cv.notify_all()
-            else:
-                self._cv.wait_for(
-                    lambda: slot["result"] is not None, timeout=300)
+            elif not self._cv.wait_for(
+                    lambda: slot["result"] is not None, timeout=TIMEOUT_S):
+                # Leave the slot in place: other waiters hold references to
+                # this dict, and a late arrival must still complete them.
+                raise TimeoutError(
+                    f"collective op {op_key!r} timed out after {TIMEOUT_S}s: "
+                    f"{len(slot['values'])}/{self.world_size} ranks arrived")
             slot["done"] += 1
             result = slot["result"]
             if slot["done"] == self.world_size:
-                del self._round[op_key]
+                self._round.pop(op_key, None)
             return result
 
     def put_value(self, key: str, value):
@@ -61,11 +70,21 @@ class _CollectiveGroupActor:
             self._cv.notify_all()
         return True
 
-    def get_value(self, key: str):
+    def get_value(self, key: str, expected_consumers: Optional[int] = None):
         with self._cv:
             slot = self._slot(key)
-            self._cv.wait_for(lambda: slot["result"] is not None, timeout=300)
-            return slot["result"]
+            if not self._cv.wait_for(
+                    lambda: slot["result"] is not None, timeout=TIMEOUT_S):
+                # Leave the slot: other consumers may still be inside their
+                # own timeout windows and must see a late-arriving value.
+                raise TimeoutError(
+                    f"rendezvous for {key!r} timed out after {TIMEOUT_S}s")
+            result = slot["result"]
+            if expected_consumers is not None:
+                slot["done"] += 1
+                if slot["done"] >= expected_consumers:
+                    self._round.pop(key, None)
+            return result
 
 
 def _reduce(vals: List[Any], op: str):
@@ -89,10 +108,24 @@ class GroupHandle:
         self.rank = rank
         self.actor = actor
         self._op_counter = 0
+        # p2p sequence numbers are kept per (src, dst) *pair*: the global op
+        # counter only advances on ops a rank participates in, so any
+        # asymmetric send pattern (rank 0 -> 1 then 0 -> 2) would
+        # permanently desynchronize sender and receiver keys.
+        self._p2p_send: Dict[int, int] = {}
+        self._p2p_recv: Dict[int, int] = {}
 
     def _next_key(self, op: str) -> str:
         self._op_counter += 1
         return f"{op}:{self._op_counter}"
+
+    def _next_send_seq(self, dst_rank: int) -> int:
+        self._p2p_send[dst_rank] = self._p2p_send.get(dst_rank, 0) + 1
+        return self._p2p_send[dst_rank]
+
+    def _next_recv_seq(self, src_rank: int) -> int:
+        self._p2p_recv[src_rank] = self._p2p_recv.get(src_rank, 0) + 1
+        return self._p2p_recv[src_rank]
 
 
 def init_collective_group(world_size: int, rank: int,
@@ -157,7 +190,7 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     if g.rank == src_rank:
         ray_tpu.get(g.actor.put_value.remote(key, np.asarray(tensor)))
         return tensor
-    return ray_tpu.get(g.actor.get_value.remote(key))
+    return ray_tpu.get(g.actor.get_value.remote(key, g.world_size - 1))
 
 
 def barrier(group_name: str = "default"):
@@ -166,11 +199,13 @@ def barrier(group_name: str = "default"):
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
     g = _group(group_name)
+    seq = g._next_send_seq(dst_rank)
     ray_tpu.get(g.actor.put_value.remote(
-        f"p2p:{g.rank}->{dst_rank}:{g._next_key('send')}", np.asarray(tensor)))
+        f"p2p:{g.rank}->{dst_rank}:{seq}", np.asarray(tensor)))
 
 
 def recv(src_rank: int, group_name: str = "default"):
     g = _group(group_name)
+    seq = g._next_recv_seq(src_rank)
     return ray_tpu.get(g.actor.get_value.remote(
-        f"p2p:{src_rank}->{g.rank}:{g._next_key('send')}"))
+        f"p2p:{src_rank}->{g.rank}:{seq}", 1))

@@ -199,3 +199,41 @@ def test_metrics(cluster):
     text = metrics.prometheus_text()
     assert 'requests{route="/a"} 3' in text
     assert "temp 42.5" in text
+
+
+def test_collective_asymmetric_send_recv(cluster):
+    """p2p messages are keyed per (src, dst) pair: rank 0 sending to 1 then
+    2 must not desynchronize receiver sequence numbers (ADVICE r1)."""
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective
+
+            self.col = collective
+            self.col.init_collective_group(world, rank, "g2")
+            self.rank = rank
+
+        def send_to(self, dst, value):
+            import numpy as np
+
+            self.col.send(np.asarray(value), dst, "g2")
+            return True
+
+        def recv_from(self, src):
+            return self.col.recv(src, "g2")
+
+    ranks = [Rank.options(max_concurrency=3).remote(i, 3) for i in range(3)]
+    # Asymmetric pattern: 0->1 (x2), 0->2, 2->1.
+    sends = [ranks[0].send_to.remote(1, [10.0]),
+             ranks[0].send_to.remote(1, [11.0]),
+             ranks[0].send_to.remote(2, [20.0]),
+             ranks[2].send_to.remote(1, [30.0])]
+    got_1a = ray_tpu.get(ranks[1].recv_from.remote(0))
+    got_1b = ray_tpu.get(ranks[1].recv_from.remote(0))
+    got_2 = ray_tpu.get(ranks[2].recv_from.remote(0))
+    got_1c = ray_tpu.get(ranks[1].recv_from.remote(2))
+    ray_tpu.get(sends)
+    assert sorted([float(got_1a[0]), float(got_1b[0])]) == [10.0, 11.0]
+    assert float(got_2[0]) == 20.0
+    assert float(got_1c[0]) == 30.0

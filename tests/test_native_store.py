@@ -74,3 +74,61 @@ def test_worker_reads_arena_object(shutdown_only):
         return float(a.sum())
 
     assert ray_tpu.get(total.remote(ref)) == float(x.sum())
+
+
+def test_arena_slot_pinned_while_actor_holds_view(shutdown_only):
+    """Regression: an arena slot must not be recycled while a reader process
+    holds a zero-copy view (plasma in-use-count semantics) — previously the
+    slot was freed as soon as the GCS holder set emptied, so later puts
+    silently overwrote an actor's stored arrays."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024**2)
+    head = ray_tpu._global_head()
+    store = next(iter(head.raylets.values())).store
+    if store.arena is None:
+        pytest.skip("arena disabled")
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.arr = None
+
+        def store(self, arr):
+            self.arr = arr
+            return True
+
+        def checksum(self):
+            return float(self.arr.sum())
+
+        def drop(self):
+            self.arr = None
+            import gc
+
+            import ray_tpu as rt
+
+            rt._worker()._value_cache.clear()
+            gc.collect()
+            return True
+
+    h = Holder.remote()
+    arr = np.full(300_000, 7.0, dtype=np.float64)
+    expected = float(arr.sum())
+    ref = ray_tpu.put(arr)
+    assert ray_tpu.get(h.store.remote(ref)) is True
+    del ref  # driver's root reference gone; only the actor's view remains
+    # Hammer the arena: without reader pinning these puts recycle the slot.
+    for _ in range(20):
+        r = ray_tpu.put(np.zeros(300_000, dtype=np.float64))
+        del r
+    assert ray_tpu.get(h.checksum.remote()) == expected
+
+    # Once the reader drops its views, the deferred free completes.
+    before = store.arena.num_objects
+    assert ray_tpu.get(h.drop.remote()) is True
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline and store.arena.num_objects >= before:
+        time.sleep(0.05)
+    assert store.arena.num_objects < before

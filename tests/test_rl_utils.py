@@ -99,3 +99,23 @@ def test_runtime_env_env_vars(ray_start_regular):
         return os.environ.get("MY_FLAG")
 
     assert ray_tpu.get(read_flag.remote()) == "hello"
+
+
+def test_runtime_env_env_vars_do_not_leak(ray_start_regular):
+    """Pooled workers restore mutated env vars after each task (ADVICE r1)."""
+    @ray_tpu.remote(runtime_env={"env_vars": {"LEAK_FLAG": "yes"}})
+    def with_flag():
+        import os
+
+        return os.environ.get("LEAK_FLAG")
+
+    @ray_tpu.remote
+    def without_flag():
+        import os
+
+        return os.environ.get("LEAK_FLAG")
+
+    assert ray_tpu.get(with_flag.remote()) == "yes"
+    # Run enough bare tasks that at least one reuses the mutated worker.
+    results = ray_tpu.get([without_flag.remote() for _ in range(16)])
+    assert all(r is None for r in results)

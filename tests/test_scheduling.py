@@ -1,5 +1,6 @@
 """Scheduler + placement group + multi-node tests (modeled on the
 reference's test_placement_group*.py and cluster_utils-based tests)."""
+import os
 import time
 
 import pytest
@@ -151,3 +152,19 @@ def test_spread_strategy(ray_start_cluster):
 
     nodes = set(ray_tpu.get([whereami.remote() for _ in range(4)]))
     assert len(nodes) == 2
+
+
+def test_two_tpu_actors_same_node(shutdown_only):
+    """A second TPU actor on a node must get its own TPU-visible worker
+    instead of queueing forever behind an actor-pinned one (ADVICE r1)."""
+    ray_tpu.init(num_cpus=4, num_tpus=2)
+
+    @ray_tpu.remote(resources={"TPU": 1})
+    class TpuActor:
+        def ping(self):
+            return os.getpid()
+
+    a = TpuActor.remote()
+    b = TpuActor.remote()
+    pids = ray_tpu.get([a.ping.remote(), b.ping.remote()], timeout=60)
+    assert pids[0] != pids[1]
