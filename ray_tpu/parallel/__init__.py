@@ -29,3 +29,8 @@ from ray_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from ray_tpu.parallel.mesh_group import (  # noqa: F401
+    MeshGroup,
+    bootstrap_jax_distributed,
+    rendezvous,
+)

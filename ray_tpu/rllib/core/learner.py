@@ -73,9 +73,10 @@ class JaxLearner:
 
 
 class LearnerGroup:
-    """Single-host degenerate form: one in-process learner driving the whole
-    local mesh.  Multi-host: one JaxLearner per host process inside a Train
-    WorkerGroup, same API (reference TrainerRunner shape)."""
+    """Single-host form: one in-process learner driving the whole local
+    mesh.  For multi-host, see DistributedLearnerGroup (same API over a
+    MeshGroup — the reference TrainerRunner shape,
+    rllib/core/rl_trainer/trainer_runner.py:24)."""
 
     def __init__(self, learner: JaxLearner):
         self.learner = learner
@@ -85,3 +86,71 @@ class LearnerGroup:
 
     def get_weights(self):
         return self.learner.get_weights()
+
+    def shutdown(self):
+        pass
+
+
+def _build_learner(state, factory):
+    state["learner"] = factory()
+    return True
+
+
+def _learner_update(state, batch):
+    return state["learner"].update(batch)
+
+
+def _learner_get_weights(state):
+    return state["learner"].get_weights()
+
+
+def _learner_set_weights(state, weights):
+    state["learner"].set_weights(weights)
+    return True
+
+
+class DistributedLearnerGroup:
+    """Multi-host LearnerGroup: one learner process per TPU host, gang-
+    scheduled as a MeshGroup, all hosts running the same pjit update over
+    one global mesh (gradients reduced in-graph by XLA over ICI/DCN).
+
+    The reference bootstraps its TrainerRunner through Train's
+    BackendExecutor and wraps each RLTrainer in Torch DDP
+    (rllib/core/rl_trainer/torch/torch_rl_trainer.py:139); here the DDP
+    wrapper dissolves — after the MeshGroup rendezvous the per-host
+    JaxLearner's mesh simply spans every host's devices.
+
+    `learner_factory` must be a picklable zero-arg callable returning a
+    JaxLearner; it runs once inside each host process after rendezvous.
+    """
+
+    def __init__(self, learner_factory, num_hosts: int = 1,
+                 resources_per_host=None, platform=None,
+                 local_device_count=None):
+        from ray_tpu.parallel.mesh_group import MeshGroup
+
+        self.group = MeshGroup(num_hosts, resources_per_host,
+                               platform=platform,
+                               local_device_count=local_device_count)
+        self.group.run_stateful(_build_learner, learner_factory)
+
+    def update(self, batch) -> Dict[str, float]:
+        """Every host receives the batch and extracts its addressable
+        shards (multi-controller SPMD); metrics are identical across hosts
+        post-psum, so rank 0's are returned."""
+        import ray_tpu
+
+        # One serialization + one store object shared by all hosts (a ref
+        # arg resolves zero-copy per host) instead of num_hosts copies.
+        batch_ref = ray_tpu.put(batch)
+        results = self.group.run_stateful(_learner_update, batch_ref)
+        return results[0]
+
+    def get_weights(self):
+        return self.group.run_rank_stateful(0, _learner_get_weights)
+
+    def set_weights(self, weights):
+        self.group.run_stateful(_learner_set_weights, weights)
+
+    def shutdown(self):
+        self.group.shutdown()

@@ -41,18 +41,9 @@ class BackendExecutor:
                 bundles, strategy=self.scaling.placement_strategy)
             self.pg.ready(timeout=60)
         self.worker_group = WorkerGroup(self.scaling.num_workers, res, self.pg)
-        # Rendezvous env: worker 0 is the jax.distributed coordinator.
-        infos = ray_tpu.get([w.node_info.remote()
-                             for w in self.worker_group.workers])
-        coordinator = f"{infos[0]['host']}:{_free_port()}"
-        env = {
-            "RTPU_COORDINATOR": coordinator,
-            "RTPU_WORLD_SIZE": str(self.scaling.num_workers),
-        }
-        ray_tpu.get([
-            w.setup_env.remote({**env, "RTPU_RANK": str(i)})
-            for i, w in enumerate(self.worker_group.workers)
-        ])
+        # Gang rendezvous (jax.distributed coordinator on worker 0) is the
+        # backend's job, shared with MeshGroup: see
+        # ray_tpu/parallel/mesh_group.py:rendezvous.
         self.backend.on_start(self.worker_group, self.backend_config)
 
     def start_training(self, train_fn: Callable, config: dict,
@@ -95,12 +86,3 @@ class BackendExecutor:
                 pass
             self.pg = None
 
-
-def _free_port() -> int:
-    import socket
-
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port

@@ -2,47 +2,37 @@
 (reference: python/ray/train/torch/config.py:69-132 — MASTER_ADDR from
 worker 0, dist.init_process_group(nccl) on every worker).
 
-TPU equivalent: worker 0's address is the jax.distributed coordinator; each
-worker process calls `jax.distributed.initialize(coordinator, world_size,
-rank)` and from then on `jax.devices()` spans the whole group — gradient
-traffic is in-graph XLA collectives over ICI/DCN, no process-group library.
-Single-worker groups (one host, N chips) skip rendezvous entirely: pjit over
-local devices IS the data-parallel path.
+TPU equivalent: the gang rendezvous is delegated to the MeshGroup primitive
+(ray_tpu/parallel/mesh_group.py) — worker 0's address becomes the
+jax.distributed coordinator, each worker process joins, and from then on
+`jax.devices()` spans the whole group.  Gradient traffic is in-graph XLA
+collectives over ICI/DCN; no process-group library exists.  Single-worker
+groups (one host, N chips) skip rendezvous entirely: pjit over local
+devices IS the data-parallel path.
 """
 from __future__ import annotations
 
-import os
+from typing import Optional
 
 from ray_tpu.train.backend import Backend, BackendConfig
 
 
 class JaxConfig(BackendConfig):
-    def __init__(self, platform: str | None = None):
+    def __init__(self, platform: Optional[str] = None,
+                 local_device_count: Optional[int] = None):
         # platform override for tests ("cpu" meshes); None = autodetect TPU.
+        # local_device_count: virtual devices per worker process (the JAX
+        # fake-accelerator mode used by multi-process CPU tests).
         self.platform = platform
+        self.local_device_count = local_device_count
 
     def backend_cls(self):
         return _JaxBackend
 
 
-def _init_jax_distributed(platform):
-    """Runs inside each training worker before the user loop."""
-    import os
-
-    if platform:
-        os.environ.setdefault("JAX_PLATFORMS", platform)
-    world = int(os.environ.get("RTPU_WORLD_SIZE", "1"))
-    if world > 1:
-        import jax
-
-        jax.distributed.initialize(
-            coordinator_address=os.environ["RTPU_COORDINATOR"],
-            num_processes=world,
-            process_id=int(os.environ["RTPU_RANK"]),
-        )
-    return True
-
-
 class _JaxBackend(Backend):
     def on_start(self, worker_group, backend_config: JaxConfig):
-        worker_group.execute(_init_jax_distributed, backend_config.platform)
+        from ray_tpu.parallel.mesh_group import rendezvous
+
+        rendezvous(worker_group.workers, backend_config.platform,
+                   backend_config.local_device_count)
