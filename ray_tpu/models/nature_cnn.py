@@ -25,3 +25,20 @@ class NatureCNN(nn.Module):
         x = nn.relu(nn.Conv(64, (3, 3), strides=(1, 1), dtype=self.dtype)(x))
         x = x.reshape((x.shape[0], -1))
         return nn.relu(nn.Dense(self.out_dim, dtype=self.dtype)(x))
+
+
+class MinAtarCNN(nn.Module):
+    """Small-grid pixel trunk (10x10-class boards): the 84x84 Nature stack's
+    8x8/4 stride degenerates below ~32px, so small boards get one 3x3
+    conv + dense, the standard MinAtar-scale architecture."""
+
+    out_dim: int = 128
+    features: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(self.features, (3, 3), dtype=self.dtype)(x))
+        x = x.reshape((x.shape[0], -1))
+        return nn.relu(nn.Dense(self.out_dim, dtype=self.dtype)(x))

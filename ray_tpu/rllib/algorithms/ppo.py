@@ -70,8 +70,13 @@ def make_anakin_ppo(config: AlgorithmConfig):
     """Builds (init_fn, jitted train_step) for fully-on-device PPO."""
     env = make_jax_env(config.env) if isinstance(config.env, str) \
         else config.env
-    spec = RLModuleSpec(obs_dim=env.obs_dim, num_actions=env.num_actions,
-                        hiddens=tuple(config.hiddens))
+    obs_shape = getattr(env, "obs_shape", None)
+    if obs_shape is not None:  # pixel env → CNN trunk
+        spec = RLModuleSpec(obs_shape=tuple(obs_shape),
+                            num_actions=env.num_actions, conv=True)
+    else:
+        spec = RLModuleSpec(obs_dim=env.obs_dim, num_actions=env.num_actions,
+                            hiddens=tuple(config.hiddens))
     module = spec.build()
     tx_parts = []
     if config.grad_clip:
@@ -124,7 +129,9 @@ def make_anakin_ppo(config: AlgorithmConfig):
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
 
         flat = {
-            "obs": obs_t.reshape(batch_total, -1),
+            "obs": (obs_t.reshape(batch_total, *obs_shape)
+                    if obs_shape is not None
+                    else obs_t.reshape(batch_total, -1)),
             "actions": act_t.reshape(batch_total),
             "action_logp": logp_t.reshape(batch_total),
             "advantages": adv.reshape(batch_total),

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.models.mlp import MLP
-from ray_tpu.models.nature_cnn import NatureCNN
+from ray_tpu.models.nature_cnn import MinAtarCNN, NatureCNN
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,7 +37,11 @@ class DiscreteActorCritic(nn.Module):
     def __call__(self, obs) -> Tuple[jax.Array, jax.Array]:
         s = self.spec
         if s.conv:
-            trunk = NatureCNN(out_dim=256, name="trunk")(obs)
+            small = (s.obs_shape is not None
+                     and min(s.obs_shape[0], s.obs_shape[1]) < 32)
+            trunk_net = (MinAtarCNN(out_dim=128) if small
+                         else NatureCNN(out_dim=256))
+            trunk = trunk_net(obs)
             logits = nn.Dense(s.num_actions, name="pi")(trunk)
             value = nn.Dense(1, name="vf")(trunk)[..., 0]
         else:

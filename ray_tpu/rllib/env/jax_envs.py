@@ -123,9 +123,98 @@ class Pendulum:
         return new_state, obs, -cost, done, {}
 
 
+class Breakout:
+    """Atari-class pixel Breakout on a 10x10 board (MinAtar-scale,
+    clean-room re-implementation from the published game description — the
+    reference only wraps full Atari ROMs via gym,
+    rllib/env/wrappers/atari_wrappers.py, which cannot run inside XLA).
+
+    Board: 3 rows of bricks (rows 1-3), paddle on the bottom row, a ball
+    bouncing diagonally.  Actions: 0 noop, 1 left, 2 right.  Reward +1 per
+    brick.  Episode ends when the ball passes the paddle (or at max_steps);
+    clearing all bricks respawns them.  Observation: [10, 10, 4] float
+    channels {paddle, ball, trail, bricks} — fed to a CNN trunk, which is
+    what makes this the honest stand-in for the Atari PPO north star.
+    Fully jittable: state is a flat pytree, all branching via jnp.where.
+    """
+
+    num_actions = 3
+    obs_shape = (10, 10, 4)
+    H = 10
+    W = 10
+    max_steps = 1000
+
+    def reset(self, rng):
+        k1, k2 = jax.random.split(rng)
+        ball_x = jax.random.randint(k1, (), 0, self.W)
+        dx = jnp.where(jax.random.bernoulli(k2), 1, -1).astype(jnp.int32)
+        state = {
+            "paddle_x": jnp.array(self.W // 2, jnp.int32),
+            "ball_x": ball_x.astype(jnp.int32),
+            "ball_y": jnp.array(4, jnp.int32),
+            "dx": dx,
+            "dy": jnp.array(1, jnp.int32),
+            "last_x": ball_x.astype(jnp.int32),
+            "last_y": jnp.array(3, jnp.int32),
+            "bricks": jnp.ones((3, self.W), jnp.bool_),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        return state, self._obs(state)
+
+    def _obs(self, s):
+        obs = jnp.zeros(self.obs_shape, jnp.float32)
+        obs = obs.at[self.H - 1, s["paddle_x"], 0].set(1.0)
+        obs = obs.at[s["ball_y"], s["ball_x"], 1].set(1.0)
+        obs = obs.at[s["last_y"], s["last_x"], 2].set(1.0)
+        obs = obs.at[1:4, :, 3].set(s["bricks"].astype(jnp.float32))
+        return obs
+
+    def step(self, s, action, rng):
+        paddle_x = jnp.clip(
+            s["paddle_x"] - (action == 1) + (action == 2), 0, self.W - 1
+        ).astype(jnp.int32)
+        # Side-wall bounce.
+        dx = jnp.where((s["ball_x"] + s["dx"] < 0)
+                       | (s["ball_x"] + s["dx"] > self.W - 1),
+                       -s["dx"], s["dx"])
+        new_x = s["ball_x"] + dx
+        # Ceiling bounce.
+        dy = jnp.where(s["ball_y"] + s["dy"] < 0, -s["dy"], s["dy"])
+        new_y = s["ball_y"] + dy
+        # Brick hit: remove it, score, bounce back vertically.
+        row = jnp.clip(new_y - 1, 0, 2)
+        hit = (new_y >= 1) & (new_y <= 3) & s["bricks"][row, new_x]
+        bricks = jnp.where(hit,
+                           s["bricks"].at[row, new_x].set(False), s["bricks"])
+        reward = jnp.where(hit, 1.0, 0.0)
+        dy = jnp.where(hit, -dy, dy)
+        new_y = jnp.where(hit, s["ball_y"], new_y)
+        # Paddle row: catch bounces the ball up, a miss ends the episode.
+        at_bottom = new_y >= self.H - 1
+        caught = at_bottom & (new_x == paddle_x)
+        dy = jnp.where(caught, jnp.array(-1, jnp.int32), dy)
+        new_y = jnp.where(caught, self.H - 2, new_y)
+        dead = at_bottom & ~caught
+        # Cleared board respawns the bricks.
+        bricks = jnp.where(bricks.any(), bricks, jnp.ones_like(bricks))
+        t = s["t"] + 1
+        done = dead | (t >= self.max_steps)
+        new_state = {
+            "paddle_x": paddle_x, "ball_x": new_x, "ball_y": new_y,
+            "dx": dx, "dy": dy, "last_x": s["ball_x"], "last_y": s["ball_y"],
+            "bricks": bricks, "t": t,
+        }
+        reset_state, reset_obs = self.reset(rng)
+        out_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, a, b), reset_state, new_state)
+        obs = jnp.where(done, reset_obs, self._obs(new_state))
+        return out_state, obs, reward, done, {}
+
+
 REGISTRY = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
+    "Breakout-MinAtar-v0": Breakout,
 }
 
 
