@@ -39,6 +39,23 @@ def _free_port() -> int:
     return port
 
 
+def _coordinator_address(loopback: bool = False) -> str:
+    """Pick ``ip:port`` for the jax.distributed coordinator.
+
+    MUST run *inside the rank-0 worker process* (the reference allocates the
+    process-group port the same way: get_address_and_port executes on worker
+    0, python/ray/train/_internal/utils.py): the port has to be free on rank
+    0's machine, and the address has to be one the other hosts can route to
+    — neither is true of a port probed on the driver or of the driver's view
+    of rank 0's hostname."""
+    from ray_tpu._private.transfer import routable_ip
+
+    port = _free_port()
+    if loopback:
+        return f"127.0.0.1:{port}"
+    return f"{routable_ip()}:{port}"
+
+
 def force_host_device_count(flags: str, n: int) -> str:
     """Return XLA_FLAGS with --xla_force_host_platform_device_count pinned
     to n, replacing (not merely appending to) any inherited value."""
@@ -147,8 +164,13 @@ def rendezvous(workers: Sequence, platform: Optional[str] = None,
     infos = ray_tpu.get([w.node_info.remote() for w in workers],
                         timeout=timeout)
     hosts = {i["host"] for i in infos}
-    head_host = "127.0.0.1" if len(hosts) == 1 else infos[0]["host"]
-    coordinator = f"{head_host}:{_free_port()}"
+    # Allocate the coordinator ip:port ON rank 0 (not the driver): the port
+    # must be free on rank 0's machine and the ip routable from the other
+    # hosts.  MeshWorker exposes run(); Train's TrainWorker exposes execute().
+    w0 = workers[0]
+    caller = w0.run if hasattr(w0, "run") else w0.execute
+    coordinator = ray_tpu.get(
+        caller.remote(_coordinator_address, len(hosts) == 1), timeout=timeout)
     env = {"RTPU_COORDINATOR": coordinator, "RTPU_WORLD_SIZE": str(world)}
     ray_tpu.get([w.setup_env.remote({**env, "RTPU_RANK": str(rank)})
                  for rank, w in enumerate(workers)], timeout=timeout)

@@ -23,10 +23,22 @@ _LOCAL_GROUPS: Dict[str, "GroupHandle"] = {}
 TIMEOUT_S = 300.0
 
 
+def _now() -> float:
+    import time
+
+    return time.monotonic()
+
+
 @ray_tpu.remote
 class _CollectiveGroupActor:
     """Rendezvous + reduction state for one group (the moral equivalent of
     the reference's NCCLUniqueIDStore named actor, util/collective/util.py:9)."""
+
+    # A slot whose last touch is older than every possible waiter's timeout
+    # window can have no live waiter left; it is garbage from an abandoned
+    # round (some rank timed out and will never call back) and must be
+    # evicted or the actor leaks a slot per timeout, forever.
+    STALE_SLOT_GRACE_S = 60.0
 
     def __init__(self, world_size: int):
         import threading
@@ -36,9 +48,26 @@ class _CollectiveGroupActor:
         self._cv = threading.Condition()
 
     def _slot(self, op_key: str):
+        self._gc_stale_slots()
         if op_key not in self._round:
-            self._round[op_key] = {"values": {}, "result": None, "done": 0}
+            self._round[op_key] = {"values": {}, "result": None, "done": 0,
+                                   "last_touch": _now()}
+        else:
+            self._round[op_key]["last_touch"] = _now()
         return self._round[op_key]
+
+    def _gc_stale_slots(self):
+        """Evict *unfinished* slots untouched for longer than TIMEOUT_S +
+        grace: every active waiter refreshed last_touch when it entered its
+        wait and waits at most TIMEOUT_S, so such slots have no live
+        waiters and the round can never complete.  Slots with a result are
+        kept — put_value stores must serve arbitrarily late consumers
+        (their cleanup is the expected_consumers count)."""
+        ttl = TIMEOUT_S + self.STALE_SLOT_GRACE_S
+        now = _now()
+        for key in [k for k, s in self._round.items()
+                    if s["result"] is None and now - s["last_touch"] > ttl]:
+            self._round.pop(key, None)
 
     def contribute(self, op_key: str, rank: int, value, op: str):
         """Blocks until all ranks contribute; returns the reduced result.
