@@ -23,6 +23,7 @@ from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
 __version__ = "0.1.0"
 
 _head = None
+_remote_driver = None
 _head_lock = threading.RLock()
 
 
@@ -86,17 +87,23 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: int = 2 * 1024**3,
          labels: Optional[dict] = None,
-         ignore_reinit_error: bool = False, **kwargs):
-    """Start a local cluster head + connect this process as the driver.
+         ignore_reinit_error: bool = False,
+         address: Optional[str] = None,
+         _authkey: Optional[bytes] = None, **kwargs):
+    """Start a local cluster head + connect this process as the driver, or —
+    with ``address="host:port"`` — join an existing remote head over TCP.
 
     Reference: ray.init (python/ray/_private/worker.py:1043)."""
-    global _head
+    global _head, _remote_driver
     with _head_lock:
-        if _head is not None:
+        if _head is not None or _remote_driver is not None:
             if ignore_reinit_error:
                 return
             raise RuntimeError("ray_tpu.init() called twice "
                                "(pass ignore_reinit_error=True to allow)")
+        if address is not None:
+            return _connect_remote_driver(address, _authkey,
+                                          kwargs.get("job_config"))
         res = dict(resources or {})
         res["CPU"] = float(num_cpus) if num_cpus is not None else _default_num_cpus()
         ntpu = float(num_tpus) if num_tpus is not None else _detect_num_tpus()
@@ -107,12 +114,35 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
         return _connect_driver(kwargs.get("job_config"))
 
 
+def _connect_remote_driver(address: str, authkey: Optional[bytes],
+                           job_config: Optional[dict]):
+    global _remote_driver
+    import os as _os
+
+    from ray_tpu._private.driver_client import RemoteDriverRuntime
+    from ray_tpu._private.worker import CoreWorker, set_global_worker
+
+    if authkey is None:
+        hexkey = _os.environ.get("RAY_TPU_AUTHKEY")
+        if not hexkey:
+            raise ValueError(
+                "joining a remote head needs its authkey: pass _authkey= "
+                "or set RAY_TPU_AUTHKEY")
+        authkey = bytes.fromhex(hexkey)
+    rt = RemoteDriverRuntime(address, authkey, job_config=job_config)
+    worker = CoreWorker(rt.worker_id, rt.node_id, rt.job_id, rt.transport,
+                        mode="driver")
+    set_global_worker(worker)
+    _remote_driver = rt
+    return worker
+
+
 def is_initialized() -> bool:
-    return _head is not None
+    return _head is not None or _remote_driver is not None
 
 
 def shutdown():
-    global _head
+    global _head, _remote_driver
     from ray_tpu._private.worker import global_worker, set_global_worker
 
     with _head_lock:
@@ -122,6 +152,9 @@ def shutdown():
             except Exception:
                 pass
             set_global_worker(None)
+        if _remote_driver is not None:
+            _remote_driver.shutdown()
+            _remote_driver = None
         if _head is not None:
             _head.shutdown()
             _head = None

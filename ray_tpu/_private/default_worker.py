@@ -21,13 +21,18 @@ from ray_tpu._private.worker import ConnTransport, CoreWorker, set_global_worker
 
 
 def main():
-    socket_path = os.environ["RAY_TPU_HEAD_SOCKET"]
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
 
-    conn = Client(socket_path, family="AF_UNIX", authkey=authkey)
-    transport = ConnTransport(conn)
+    head_addr = os.environ.get("RAY_TPU_HEAD_ADDR")
+    if head_addr:  # worker on a remote node: TCP to the head
+        host, port = head_addr.rsplit(":", 1)
+        conn = Client((host, int(port)), family="AF_INET", authkey=authkey)
+    else:
+        socket_path = os.environ["RAY_TPU_HEAD_SOCKET"]
+        conn = Client(socket_path, family="AF_UNIX", authkey=authkey)
+    transport = ConnTransport(conn, authkey)
     worker = CoreWorker(worker_id, node_id, JobID.nil(), transport, mode="worker")
     set_global_worker(worker)
 

@@ -1,0 +1,105 @@
+"""Remote driver runtime: ``ray_tpu.init(address="host:port")``.
+
+The reference's equivalent is a driver connecting to an existing cluster
+(ray.init(address=...), python/ray/_private/worker.py:1043): the driver
+process talks to the remote GCS/raylet over the network.  Here the driver
+
+- opens one TCP control connection to the head (requests + notifications),
+- embeds a small SharedMemoryStore + ObjectTransferServer so its own puts
+  stay host-local yet remain pullable by the cluster, and
+- registers as an unschedulable pseudo-node (head.add_remote_driver).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from multiprocessing.connection import Client
+from typing import Optional
+
+from ray_tpu._private.ids import JobID, NodeID, ObjectID, WorkerID
+from ray_tpu._private.object_store import SharedMemoryStore
+from ray_tpu._private.transfer import (
+    ObjectTransferServer,
+    wire_store_reporting,
+)
+from ray_tpu._private.worker import ConnTransport
+
+
+class RemoteDriverRuntime:
+    def __init__(self, address: str, authkey: bytes,
+                 store_capacity: int = 512 * 1024**2,
+                 job_config: Optional[dict] = None,
+                 timeout: float = 30.0):
+        host, port = address.rsplit(":", 1)
+        self.authkey = authkey
+        self.worker_id = WorkerID.from_random()
+        self.job_id = JobID.from_random()
+        self.host_key = os.urandom(8).hex()
+        import tempfile
+
+        self._spill_dir = tempfile.mkdtemp(prefix="rtpu_spill_")
+        self.store = SharedMemoryStore(store_capacity,
+                                       spill_dir=self._spill_dir)
+        wire_store_reporting(self.store, lambda m: self.transport.send(m))
+        self.conn = None
+        try:
+            self.xfer = ObjectTransferServer(self.store, authkey)
+            self.conn = Client((host, int(port)), family="AF_INET",
+                               authkey=authkey)
+            self.transport = ConnTransport(self.conn, authkey)
+            self.node_id: Optional[NodeID] = None
+            self._registered = threading.Event()
+            self._reader = threading.Thread(
+                target=self._read_loop, name="rtpu-driver-reader",
+                daemon=True)
+            self._reader.start()
+            self.transport.send({
+                "type": "register_driver",
+                "worker_id": self.worker_id.binary(),
+                "job_id": self.job_id,
+                "job_config": job_config or {},
+                "host_key": self.host_key,
+                "transfer_addr": list(self.xfer.address),
+                "pid": os.getpid(),
+            })
+            if not self._registered.wait(timeout):
+                raise TimeoutError(
+                    f"driver registration with {address} timed out")
+        except BaseException:
+            self.shutdown()
+            raise
+
+    def _read_loop(self):
+        try:
+            while True:
+                msg = self.conn.recv()
+                t = msg.get("type")
+                if t == "reply":
+                    self.transport.on_reply(msg)
+                elif t == "driver_registered":
+                    self.node_id = NodeID(msg["node_id"])
+                    self._registered.set()
+                elif t == "store_adopt":
+                    self.store.adopt(ObjectID(msg["oid"]), msg["size"],
+                                     msg["meta"])
+                elif t == "store_delete":
+                    self.store.delete(ObjectID(msg["oid"]))
+                elif t == "shutdown":
+                    return
+        except (EOFError, OSError, BrokenPipeError):
+            pass
+        finally:
+            self.transport.close()
+
+    def shutdown(self):
+        try:
+            if self.conn is not None:
+                self.conn.close()
+        except Exception:
+            pass
+        if getattr(self, "xfer", None) is not None:
+            self.xfer.shutdown()
+        self.store.shutdown()
+        import shutil
+
+        shutil.rmtree(self._spill_dir, ignore_errors=True)

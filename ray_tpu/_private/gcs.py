@@ -93,7 +93,7 @@ class ObjectEntry:
 
     __slots__ = (
         "object_id", "locations", "inline", "holders", "lineage_task",
-        "size", "spilled_path", "lost",
+        "size", "meta", "spilled_path", "lost",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -103,6 +103,9 @@ class ObjectEntry:
         self.holders: Set[bytes] = set()  # worker ids holding a root reference
         self.lineage_task: Optional[TaskID] = None
         self.size = 0
+        # Serialization metadata, kept directory-side for objects whose store
+        # lives in another process/host (cross-host pull resolutions need it).
+        self.meta: Optional[bytes] = None
         self.spilled_path: Optional[str] = None
         self.lost = False
 
@@ -282,12 +285,15 @@ class GCS:
         return e
 
     def object_sealed(self, oid: ObjectID, node_id: NodeID, size: int,
-                      lineage_task: Optional[TaskID] = None):
+                      lineage_task: Optional[TaskID] = None,
+                      meta: Optional[bytes] = None):
         with self._lock:
             e = self._entry(oid)
             e.locations.add(node_id)
             e.size = size
             e.lost = False
+            if meta is not None:
+                e.meta = meta
             if lineage_task is not None:
                 e.lineage_task = lineage_task
 

@@ -35,7 +35,12 @@ from ray_tpu._private.ids import (
     TaskID,
     WorkerID,
 )
-from ray_tpu._private.raylet import Raylet, WorkerHandle
+from ray_tpu._private.raylet import (
+    Raylet,
+    RemoteRaylet,
+    RemoteStoreProxy,
+    WorkerHandle,
+)
 from ray_tpu._private.scheduler import (
     ClusterScheduler,
     Infeasible,
@@ -81,11 +86,38 @@ class Head:
         self._arena_pending_free: set = set()
         self._cancelled: set = set()  # task ids cancelled while running
         self._shutdown = False
+        # ---- multi-host plane ----
+        # Host identity: object resolutions are host-aware — same host means
+        # "attach the shm segment", different host means "pull over TCP from
+        # the owning store" (reference: object_manager.h:117 push/pull).
+        self.host_key = os.urandom(8).hex()
+        self.node_host: Dict[NodeID, str] = {}       # node -> host key
+        self.node_xfer: Dict[NodeID, tuple] = {}      # node -> (ip, port)
+        self._local_xfer: Dict[NodeID, Any] = {}      # local transfer servers
+        self._driver_hosts: Dict[bytes, str] = {}     # remote driver host keys
+        self._driver_nodes: Dict[bytes, NodeID] = {}  # driver wid -> pseudo node
+        self._has_remote = False
         self._listener = Listener(self.socket_path, family="AF_UNIX",
                                   authkey=self.authkey)
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                name="rtpu-accept", daemon=True)
         self._accept_thread.start()
+        # TCP listener: remote node agents, remote drivers, and workers on
+        # remote nodes all connect here (the networked flank of the same
+        # control protocol the AF_UNIX listener speaks).  Binds loopback by
+        # default — a purely local cluster must not expose its control
+        # plane on external interfaces; set RAY_TPU_TCP_HOST=0.0.0.0 when
+        # remote hosts are expected to join.
+        self.tcp_bind_host = os.environ.get("RAY_TPU_TCP_HOST", "127.0.0.1")
+        self._tcp_listener = Listener((self.tcp_bind_host, 0),
+                                      family="AF_INET", authkey=self.authkey)
+        self.tcp_port = self._tcp_listener.address[1]
+        self._tcp_accept_thread = threading.Thread(
+            target=self._accept_loop,
+            kwargs={"listener": self._tcp_listener,
+                    "thread_name": "rtpu-conn-tcp"},
+            name="rtpu-accept-tcp", daemon=True)
+        self._tcp_accept_thread.start()
         # Health monitor: catches worker processes that die before/without
         # closing their connection (e.g. failed to start at all) — the
         # equivalent of the reference's GCS health checks
@@ -124,27 +156,112 @@ class Head:
                             else:
                                 raylet.try_dispatch()
 
+    @property
+    def tcp_address(self) -> str:
+        if self.tcp_bind_host not in ("0.0.0.0", "::"):
+            return f"{self.tcp_bind_host}:{self.tcp_port}"
+        from ray_tpu._private.transfer import routable_ip
+
+        return f"{routable_ip()}:{self.tcp_port}"
+
     # ================= cluster membership =================
     def add_node(self, resources: Dict[str, float], labels: Optional[dict] = None,
                  store_capacity: int = 2 * 1024**3, max_workers: int = 64) -> NodeID:
         node_id = NodeID.from_random()
         with self._lock:
-            raylet = Raylet(node_id, self, store_capacity, labels, max_workers)
+            raylet = Raylet(node_id, self, store_capacity, labels, max_workers,
+                            tpu_chips=int(resources.get("TPU", 0)))
             raylet.store.evict_callback = (
                 lambda oid, nid=node_id: self._on_object_evicted(oid, nid))
+            # Spill policy: only objects the directory still references are
+            # worth the disk write; the rest just evict (reference:
+            # LocalObjectManager spills pinned/referenced objects,
+            # local_object_manager.h:41).
+            raylet.store.should_spill = self._object_is_referenced
             self.raylets[node_id] = raylet
+            self.node_host[node_id] = self.host_key
             self.scheduler.add_node(node_id, resources, labels)
             self.gcs.register_node(NodeInfo(node_id, resources, labels))
+            if self._has_remote:
+                self._ensure_local_transfer(node_id)
             self._drain_pending()
             self._drive_pending_pgs()
         return node_id
 
+    def add_remote_node(self, msg: dict, conn) -> NodeID:
+        """A node agent registered over TCP: attach its host to the cluster
+        (reference: raylet self-registration with the GCS)."""
+        node_id = NodeID.from_random()
+        resources = dict(msg["resources"])
+        labels = msg.get("labels") or {}
+        with self._lock:
+            raylet = RemoteRaylet(
+                node_id, self, conn, msg["host_key"], msg["transfer_addr"],
+                labels, msg.get("max_workers", 64),
+                tpu_chips=int(resources.get("TPU", 0)))
+            self.raylets[node_id] = raylet
+            self.node_host[node_id] = msg["host_key"]
+            self.node_xfer[node_id] = tuple(msg["transfer_addr"])
+            self._has_remote = True
+            # Local stores must now be pull-servable by remote hosts.
+            for nid in list(self.raylets):
+                self._ensure_local_transfer(nid)
+            self.scheduler.add_node(node_id, resources, labels)
+            self.gcs.register_node(NodeInfo(node_id, resources, labels))
+            self._drain_pending()
+            self._drive_pending_pgs()
+        self._send_on(conn, {"type": "node_registered",
+                             "node_id": node_id.binary()})
+        return node_id
+
+    def add_remote_driver(self, msg: dict, conn) -> NodeID:
+        """A remote driver joined over TCP.  It carries its own embedded
+        store + transfer server (so its puts stay host-local and stay
+        pullable), surfaced here as an unschedulable pseudo-node."""
+        node_id = NodeID.from_random()
+        worker_id = msg["worker_id"]
+        with self._lock:
+            raylet = RemoteRaylet(node_id, self, conn, msg["host_key"],
+                                  msg["transfer_addr"], max_workers=0)
+            self.raylets[node_id] = raylet
+            self.node_host[node_id] = msg["host_key"]
+            self.node_xfer[node_id] = tuple(msg["transfer_addr"])
+            self._has_remote = True
+            for nid in list(self.raylets):
+                self._ensure_local_transfer(nid)
+            self._driver_hosts[worker_id] = msg["host_key"]
+            self._driver_nodes[worker_id] = node_id
+            self.gcs.add_job(msg["job_id"], msg.get("job_config") or {})
+        self._send_on(conn, {"type": "driver_registered",
+                             "node_id": node_id.binary()})
+        return node_id
+
+    def _ensure_local_transfer(self, node_id: NodeID):
+        """Start a transfer server over a local raylet's store (idempotent;
+        only local stores need one here — remote stores bring their own)."""
+        if node_id in self._local_xfer or node_id in self.node_xfer:
+            return
+        raylet = self.raylets.get(node_id)
+        if raylet is None or isinstance(raylet.store, RemoteStoreProxy):
+            return
+        from ray_tpu._private.transfer import ObjectTransferServer
+
+        srv = ObjectTransferServer(raylet.store, self.authkey)
+        self._local_xfer[node_id] = srv
+        self.node_xfer[node_id] = srv.address
+
     def remove_node(self, node_id: NodeID):
-        """Simulated node failure (test fixture / chaos hook)."""
+        """Node failure/departure (simulated for virtual nodes, real for
+        remote agents whose connection dropped)."""
         with self._lock:
             raylet = self.raylets.pop(node_id, None)
             self.scheduler.remove_node(node_id)
             self.gcs.remove_node(node_id)
+            self.node_host.pop(node_id, None)
+            self.node_xfer.pop(node_id, None)
+            srv = self._local_xfer.pop(node_id, None)
+            if srv is not None:
+                srv.shutdown()
             if raylet is None:
                 return
             # All workers on the node die.
@@ -159,18 +276,39 @@ class Head:
             raylet.shutdown()
 
     # ================= worker connections =================
-    def _accept_loop(self):
+    def _accept_loop(self, listener=None, thread_name: str = "rtpu-conn"):
+        listener = listener or self._listener
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
+                conn = listener.accept()
             except (OSError, EOFError):
                 return
             t = threading.Thread(target=self._conn_loop, args=(conn,),
-                                 name="rtpu-conn", daemon=True)
+                                 name=thread_name, daemon=True)
             t.start()
 
+    def _send_on(self, conn, msg) -> bool:
+        """Send on a worker/agent/driver connection under its per-conn lock.
+
+        Multiple head threads write to the same Connection (request
+        replies, execute pushes, store ops to agents); an unserialized
+        multi-chunk send would interleave bytes and corrupt the stream."""
+        lock = getattr(conn, "_rtpu_send_lock", None)
+        try:
+            if lock is not None:
+                with lock:
+                    conn.send(msg)
+            else:
+                conn.send(msg)
+            return True
+        except Exception:
+            return False
+
     def _conn_loop(self, conn):
+        conn._rtpu_send_lock = threading.Lock()
         worker_id: Optional[WorkerID] = None
+        agent_node: Optional[NodeID] = None
+        driver_wid: Optional[bytes] = None
         try:
             while True:
                 msg = conn.recv()
@@ -178,6 +316,32 @@ class Head:
                 if mtype == "register":
                     worker_id = WorkerID(msg["worker_id"])
                     self._on_register(worker_id, NodeID(msg["node_id"]), conn)
+                elif mtype == "register_node":
+                    agent_node = self.add_remote_node(msg, conn)
+                elif mtype == "register_driver":
+                    driver_wid = msg["worker_id"]
+                    worker_id = WorkerID(driver_wid)
+                    self.add_remote_driver(msg, conn)
+                elif mtype == "worker_exit":
+                    if agent_node is not None:
+                        self.on_remote_worker_exit(agent_node, msg)
+                elif mtype == "object_evicted":
+                    nid = agent_node or (driver_wid and
+                                         self._driver_nodes.get(driver_wid))
+                    if nid is not None:
+                        with self._lock:
+                            self._on_object_evicted(ObjectID(msg["oid"]), nid)
+                elif mtype == "object_spilled":
+                    nid = agent_node or (driver_wid and
+                                         self._driver_nodes.get(driver_wid))
+                    if nid is not None:
+                        with self._lock:
+                            raylet = self.raylets.get(nid)
+                            if raylet is not None and isinstance(
+                                    raylet.store, RemoteStoreProxy):
+                                raylet.store.note_spilled(
+                                    ObjectID(msg["oid"]), msg["path"],
+                                    msg["meta"], msg["size"])
                 elif mtype == "task_done":
                     self.on_task_done(msg)
                 elif mtype == "seal":
@@ -193,8 +357,43 @@ class Head:
         except Exception:
             traceback.print_exc()
         finally:
-            if worker_id is not None:
+            if agent_node is not None:
+                self.remove_node(agent_node)
+            elif driver_wid is not None:
+                self.on_driver_disconnected(driver_wid)
+            elif worker_id is not None:
                 self.on_conn_closed(worker_id)
+
+    def on_remote_worker_exit(self, node_id: NodeID, msg: dict):
+        """Agent reported one of its worker subprocesses exited — mirrors
+        the local health-monitor poll path."""
+        with self._lock:
+            raylet = self.raylets.get(node_id)
+            if raylet is None:
+                return
+            h = raylet.workers.get(WorkerID(msg["worker_id"]))
+            if h is None:
+                return
+            h.proc.returncode = msg.get("code", -1)
+            if h.conn is None:
+                raylet.num_starting = max(0, raylet.num_starting - 1)
+                raylet.consecutive_start_failures += 1
+            self._handle_worker_death(
+                h, f"worker process exited with code {msg.get('code')}")
+            raylet.on_worker_lost(h.worker_id)
+            self._conns.pop(h.worker_id, None)
+            raylet.try_dispatch()
+
+    def on_driver_disconnected(self, driver_wid: bytes):
+        with self._lock:
+            self._driver_hosts.pop(driver_wid, None)
+            node_id = self._driver_nodes.pop(driver_wid, None)
+        if node_id is not None:
+            self.remove_node(node_id)
+        freed = self.gcs.remove_all_references(driver_wid)
+        with self._lock:
+            for oid in freed:
+                self._free_object(oid)
 
     def _on_register(self, worker_id: WorkerID, node_id: NodeID, conn):
         with self._lock:
@@ -220,9 +419,7 @@ class Head:
                 self._free_object(oid)
 
     def send_to_worker(self, worker: WorkerHandle, msg: dict):
-        try:
-            worker.conn.send(msg)
-        except Exception:
+        if not self._send_on(worker.conn, msg):
             self.on_conn_closed(worker.worker_id)
 
     # ================= request router =================
@@ -230,12 +427,9 @@ class Head:
         msg_id = msg["msg_id"]
 
         def reply(value=None, error: Optional[BaseException] = None):
-            try:
-                conn.send({"type": "reply", "msg_id": msg_id,
-                           "ok": error is None, "value": value,
-                           "error": error})
-            except Exception:
-                pass
+            self._send_on(conn, {"type": "reply", "msg_id": msg_id,
+                                 "ok": error is None, "value": value,
+                                 "error": error})
 
         try:
             self.handle_request(msg["op"], msg.get("payload") or {}, reply,
@@ -262,8 +456,9 @@ class Head:
         """Resolve an object: reply immediately if available, else defer."""
         oid: ObjectID = payload["oid"]
         timeout = payload.get("timeout")
+        caller_host = self._caller_host(caller)
         with self._lock:
-            resolved = self._resolve_object(oid)
+            resolved = self._resolve_object(oid, caller_host=caller_host)
             if resolved is not None:
                 if resolved.get("kind") == "arena":
                     self._grant_arena_lease(oid, caller)
@@ -277,12 +472,19 @@ class Head:
             cb_list = self._object_waiters[oid]
             record = {"done": False}
 
-            def cb(resolved_msg):
-                if not record["done"]:
-                    record["done"] = True
-                    if resolved_msg.get("kind") == "arena":
-                        self._grant_arena_lease(oid, caller)
-                    reply(resolved_msg)
+            def cb(_ready_oid):
+                if record["done"]:
+                    return
+                # Re-resolve for THIS caller's host: different waiters on
+                # different hosts need different resolutions.
+                resolved_msg = self._resolve_object(oid,
+                                                    caller_host=caller_host)
+                if resolved_msg is None:
+                    return
+                record["done"] = True
+                if resolved_msg.get("kind") == "arena":
+                    self._grant_arena_lease(oid, caller)
+                reply(resolved_msg)
 
             cb_list.append(cb)
         if timeout is not None:
@@ -548,9 +750,7 @@ class Head:
         self.running[spec.task_id] = (spec, info.worker_id)
         self.gcs.update_task_status(spec.task_id, TaskStatus.RUNNING,
                                     worker_id=info.worker_id)
-        try:
-            conn.send({"type": "execute", "spec": spec})
-        except Exception:
+        if not self._send_on(conn, {"type": "execute", "spec": spec}):
             info.pending_calls.append(spec)
 
     def on_task_done(self, msg: dict):
@@ -613,7 +813,7 @@ class Head:
                                    lineage_task=task_id)
         elif res.in_store and node_id is not None:
             self.gcs.object_sealed(res.object_id, node_id, res.size,
-                                   lineage_task=task_id)
+                                   lineage_task=task_id, meta=res.meta)
         self._notify_object(res.object_id)
 
     def _record_error_result(self, oid: ObjectID, error):
@@ -622,7 +822,14 @@ class Head:
 
     def _maybe_retry(self, spec: TaskSpec, msg: dict) -> bool:
         if spec.task_type == TaskType.ACTOR_TASK:
-            return False
+            # App-level exception on a live actor: retry only when asked
+            # (retry_exceptions) and within the method's retry budget
+            # (worker-death replay is handled by the actor FSM instead).
+            if not spec.retry_exceptions or spec.attempt >= spec.max_retries:
+                return False
+            spec.attempt += 1
+            self.submit_actor_task(spec)
+            return True
         crashed = msg.get("crashed", False)
         if not crashed and not spec.retry_exceptions:
             return False
@@ -732,15 +939,31 @@ class Head:
                 self._schedule(spec)
             else:
                 self._fail_task(spec, exc.WorkerCrashedError(cause))
-        # Drop any running actor-task entries bound to this worker.
+        # Collect in-flight actor tasks bound to this worker: the actor FSM
+        # decides whether they replay (max_task_retries across a restart,
+        # reference: task_manager.h actor-task resubmit) or fail.
+        inflight: List[TaskSpec] = []
         for task_id, (tspec, wid) in list(self.running.items()):
             if wid == handle.worker_id:
                 self.running.pop(task_id, None)
-                meta, data = _serialize_error(exc.ActorDiedError(cause))
-                for oid in tspec.return_ids():
-                    self._record_error_result(oid, (meta, data))
+                if tspec.task_type == TaskType.ACTOR_TASK:
+                    inflight.append(tspec)
+                else:
+                    meta, data = _serialize_error(exc.ActorDiedError(cause))
+                    for oid in tspec.return_ids():
+                        self._record_error_result(oid, (meta, data))
         if handle.actor_id is not None:
-            self._on_actor_worker_death(handle.actor_id, cause)
+            self._on_actor_worker_death(handle.actor_id, cause, inflight)
+        else:
+            self._fail_specs(inflight, exc.ActorDiedError(cause))
+
+    def _fail_specs(self, specs, error: BaseException):
+        if not specs:
+            return
+        meta, data = _serialize_error(error)
+        for spec in specs:
+            for oid in spec.return_ids():
+                self._record_error_result(oid, (meta, data))
 
     # ================= actors =================
     def _on_actor_creation_done(self, spec: TaskSpec, worker_id: WorkerID,
@@ -773,9 +996,11 @@ class Head:
             self._notify_actor_waiters(spec.actor_id, error=err)
             self._fail_pending_actor_calls(info, err)
 
-    def _on_actor_worker_death(self, actor_id: ActorID, cause: str):
+    def _on_actor_worker_death(self, actor_id: ActorID, cause: str,
+                               inflight: Optional[List[TaskSpec]] = None):
         info = self.gcs.get_actor_info(actor_id)
         if info is None:
+            self._fail_specs(inflight or [], exc.ActorDiedError(cause))
             return
         creation_spec = info.creation_spec
         if info.resources_held and info.node_id is not None:
@@ -783,11 +1008,24 @@ class Head:
             self.scheduler.return_resources(info.node_id, creation_spec)
         state = self.gcs.actor_failed(actor_id, cause)
         if state == ActorState.RESTARTING:
+            # Replay in-flight calls that still have retry budget, AHEAD of
+            # queued-but-never-started calls (submission order); the rest
+            # fail with the death cause.
+            replay, drop = [], []
+            for t in (inflight or []):
+                if t.attempt < t.max_retries:
+                    t.attempt += 1
+                    replay.append(t)
+                else:
+                    drop.append(t)
+            info.pending_calls[:0] = replay
+            self._fail_specs(drop, exc.ActorDiedError(cause))
             new_spec = creation_spec
             new_spec.attempt += 1
             self._schedule(new_spec)
         else:
             err = exc.ActorDiedError(cause)
+            self._fail_specs(inflight or [], err)
             self._notify_actor_waiters(actor_id, error=err)
             self._fail_pending_actor_calls(info, err)
 
@@ -846,7 +1084,8 @@ class Head:
                     traceback.print_exc()
                     return
             self.gcs.object_sealed(oid, node_id, msg["size"],
-                                   lineage_task=msg.get("lineage_task"))
+                                   lineage_task=msg.get("lineage_task"),
+                                   meta=msg.get("meta"))
             self._notify_object(oid)
 
     def on_arena_sealed(self, msg: dict):
@@ -864,8 +1103,27 @@ class Head:
                                    lineage_task=msg.get("lineage_task"))
             self._notify_object(oid)
 
-    def _resolve_object(self, oid: ObjectID, peek: bool = False) -> Optional[dict]:
-        """Returns a resolution message or None if not yet available."""
+    def _caller_host(self, caller: Optional[WorkerID]) -> str:
+        """Host key of the process asking for an object."""
+        if caller is None:
+            return self.host_key
+        hk = self._driver_hosts.get(caller.binary())
+        if hk is not None:
+            return hk
+        _, handle = self._find_worker(caller)
+        if handle is not None:
+            return self.node_host.get(handle.node_id, self.host_key)
+        return self.host_key
+
+    def _resolve_object(self, oid: ObjectID, peek: bool = False,
+                        caller_host: Optional[str] = None) -> Optional[dict]:
+        """Returns a resolution message or None if not yet available.
+
+        Host-aware: a caller on the same host as a location attaches the
+        shm segment (zero-copy); a caller on a different host gets a "pull"
+        resolution naming the owning store's transfer server (the
+        reference's ownership-based directory + pull manager,
+        ownership_based_object_directory.h, pull_manager.h:52)."""
         entry = self.gcs.object_lookup(oid)
         if entry is None:
             return None
@@ -874,31 +1132,66 @@ class Head:
             if meta.startswith(ERROR_META):
                 return {"kind": "error", "meta": meta[len(ERROR_META):], "data": data}
             return {"kind": "inline", "meta": meta, "data": data}
-        if entry.locations:
-            # Single-host: every process can attach the segment directly.
-            for node_id in entry.locations:
-                raylet = self.raylets.get(node_id)
-                if raylet is not None:
-                    hit = raylet.store.arena_lookup(oid)
-                    if hit is not None:
-                        return hit
-                    meta = raylet.store.meta(oid)
-                    if meta is not None:
-                        return {"kind": "store", "oid": oid, "meta": meta}
+        if not entry.locations:
+            return None
+        ch = caller_host or self.host_key
+        local_misses = 0
+        # Same-host locations first: direct segment attach.
+        for node_id in entry.locations:
+            if self.node_host.get(node_id, self.host_key) != ch:
+                continue
+            raylet = self.raylets.get(node_id)
+            if raylet is None:
+                continue
+            if isinstance(raylet.store, RemoteStoreProxy):
+                # The store lives in the caller's host's agent/driver
+                # process.  A spill record means the segment is gone and
+                # the bytes live in the agent's spill file; otherwise the
+                # segment is attachable by name on that host.
+                hit = raylet.store.spilled_lookup(oid)
+                if hit is not None:
+                    return hit
+                if entry.meta is not None:
+                    return {"kind": "store", "oid": oid, "meta": entry.meta}
+            else:
+                hit = raylet.store.arena_lookup(oid)
+                if hit is not None:
+                    return hit
+                meta = raylet.store.meta(oid)
+                if meta is not None:
+                    return {"kind": "store", "oid": oid, "meta": meta}
+                hit = raylet.store.spilled_lookup(oid)
+                if hit is not None:
+                    return hit
+                local_misses += 1
+        # Cross-host: hand out a pull resolution against any owning store.
+        for node_id in entry.locations:
+            if self.node_host.get(node_id, self.host_key) == ch:
+                continue
+            addr = self.node_xfer.get(node_id)
+            if addr is not None:
+                return {"kind": "pull", "oid": oid, "addr": list(addr),
+                        "size": entry.size}
+        if local_misses == len(entry.locations):
+            # Every location was a local store that no longer has the bytes.
             entry.locations.clear()
             entry.lost = True
-            return None
         return None
 
     def _notify_object(self, oid: ObjectID):
-        resolved = self._resolve_object(oid)
-        if resolved is None:
+        if self._resolve_object(oid) is None:
             return
+        # Callbacks re-resolve per caller host (cross-host waiters need a
+        # pull resolution, same-host waiters a segment attach).
         for cb in self._object_waiters.pop(oid, []):
             try:
-                cb(resolved)
+                cb(oid)
             except Exception:
                 pass
+
+    def _object_is_referenced(self, oid: ObjectID) -> bool:
+        entry = self.gcs.object_lookup(oid)
+        return entry is not None and bool(entry.holders)
 
     def _on_object_evicted(self, oid: ObjectID, node_id: NodeID):
         entry = self.gcs.object_lookup(oid)
@@ -982,10 +1275,14 @@ class Head:
             for raylet in self.raylets.values():
                 raylet.shutdown()
             self.raylets.clear()
-        try:
-            self._listener.close()
-        except Exception:
-            pass
+            for srv in self._local_xfer.values():
+                srv.shutdown()
+            self._local_xfer.clear()
+        for listener in (self._listener, self._tcp_listener):
+            try:
+                listener.close()
+            except Exception:
+                pass
 
 
 def _serialize_error(error: BaseException) -> Tuple[bytes, bytes]:

@@ -1,0 +1,209 @@
+"""Remote-node agent: the per-host raylet process for multi-host clusters.
+
+The reference runs one raylet binary per node (src/ray/raylet/main.cc) that
+owns the node's plasma store, spawns workers, and serves object transfer.
+This agent is that process for ray_tpu: it
+
+- connects to the head over TCP (same authkey-HMAC framing as workers),
+- registers the node (resources, host key, transfer address),
+- owns the host's SharedMemoryStore + an ObjectTransferServer for pulls,
+- spawns/kills worker subprocesses on command from the head's RemoteRaylet
+  proxy (workers connect *directly* to the head over TCP for control; only
+  store ownership and object bytes stay host-local),
+- reports child exits so the head's health monitor sees remote deaths.
+
+Start programmatically (cluster_utils.Cluster.add_remote_node) or:
+    python -m ray_tpu._private.node_agent --address HOST:PORT \
+        --authkey HEX --num-cpus 8 [--num-tpus 4] [--store-capacity BYTES]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from multiprocessing.connection import Client
+from typing import Dict
+
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.object_store import SharedMemoryStore
+from ray_tpu._private.transfer import (
+    ObjectTransferServer,
+    wire_store_reporting,
+)
+
+
+class NodeAgent:
+    def __init__(self, head_addr, authkey: bytes, resources: Dict[str, float],
+                 store_capacity: int = 2 * 1024**3, max_workers: int = 64,
+                 labels=None):
+        self.head_addr = head_addr
+        self.authkey = authkey
+        self.resources = resources
+        self.labels = labels or {}
+        self.max_workers = max_workers
+        self.host_key = os.urandom(8).hex()
+        import tempfile
+
+        self._spill_dir = tempfile.mkdtemp(prefix="rtpu_spill_")
+        self.store = SharedMemoryStore(store_capacity,
+                                       spill_dir=self._spill_dir)
+        # should_spill stays None: without refcount visibility, spilling
+        # everything evicted is the safe default.
+        wire_store_reporting(self.store, self.send)
+        self.xfer = ObjectTransferServer(self.store, authkey)
+        self.conn = Client(tuple(head_addr), family="AF_INET",
+                           authkey=authkey)
+        self._send_lock = threading.Lock()
+        self._children: Dict[bytes, subprocess.Popen] = {}
+        self._children_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self.node_id = None  # assigned by head in register reply
+
+    def send(self, msg: dict):
+        with self._send_lock:
+            self.conn.send(msg)
+
+    def run(self):
+        self.send({
+            "type": "register_node",
+            "resources": self.resources,
+            "labels": self.labels,
+            "host_key": self.host_key,
+            "transfer_addr": list(self.xfer.address),
+            "store_capacity": self.store.capacity,
+            "max_workers": self.max_workers,
+            "pid": os.getpid(),
+        })
+        threading.Thread(target=self._reap_loop, name="rtpu-agent-reap",
+                         daemon=True).start()
+        try:
+            while not self._shutdown.is_set():
+                msg = self.conn.recv()
+                self._handle(msg)
+        except (EOFError, OSError):
+            pass  # head gone: shut down the node
+        finally:
+            self.shutdown()
+
+    def _handle(self, msg: dict):
+        t = msg.get("type")
+        try:
+            if t == "node_registered":
+                self.node_id = NodeID(msg["node_id"])
+            elif t == "spawn_worker":
+                self._spawn_worker(msg)
+            elif t == "kill_worker":
+                self._kill_worker(msg["worker_id"])
+            elif t == "store_adopt":
+                self.store.adopt(ObjectID(msg["oid"]), msg["size"],
+                                 msg["meta"])
+            elif t == "store_delete":
+                self.store.delete(ObjectID(msg["oid"]))
+            elif t == "shutdown":
+                self._shutdown.set()
+        except Exception:
+            traceback.print_exc()
+
+    def _spawn_worker(self, msg: dict):
+        env = dict(os.environ)
+        env.update(msg.get("env") or {})
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # CPU-only worker: skip the site hook's eager accelerator
+            # registration + jax import (see raylet.spawn_worker).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        import ray_tpu as _pkg
+
+        pkg_parent = os.path.dirname(
+            os.path.dirname(os.path.abspath(_pkg.__file__)))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        env["RAY_TPU_HEAD_ADDR"] = f"{self.head_addr[0]}:{self.head_addr[1]}"
+        env.pop("RAY_TPU_HEAD_SOCKET", None)
+        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.default_worker"],
+            env=env)
+        with self._children_lock:
+            self._children[msg["worker_id"]] = proc
+
+    def _kill_worker(self, worker_id: bytes):
+        with self._children_lock:
+            proc = self._children.pop(worker_id, None)
+        if proc is not None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+
+    def _reap_loop(self):
+        """Report child exits so the head can run its death handling even
+        when the worker died before opening its control connection."""
+        while not self._shutdown.is_set():
+            time.sleep(0.5)
+            with self._children_lock:
+                items = list(self._children.items())
+            for wid, proc in items:
+                code = proc.poll()
+                if code is not None:
+                    with self._children_lock:
+                        self._children.pop(wid, None)
+                    try:
+                        self.send({"type": "worker_exit", "worker_id": wid,
+                                   "code": code})
+                    except Exception:
+                        return
+
+    def shutdown(self):
+        self._shutdown.set()
+        with self._children_lock:
+            procs = list(self._children.values())
+            self._children.clear()
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        self.xfer.shutdown()
+        self.store.shutdown()
+        import shutil
+
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True, help="head HOST:PORT")
+    p.add_argument("--authkey", default=None,
+                   help="hex authkey (default: RAY_TPU_AUTHKEY env)")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=0.0)
+    p.add_argument("--resources", default=None,
+                   help='extra resources as JSON, e.g. \'{"nodeA": 1}\'')
+    p.add_argument("--store-capacity", type=int, default=2 * 1024**3)
+    p.add_argument("--max-workers", type=int, default=64)
+    args = p.parse_args(argv)
+    host, port = args.address.rsplit(":", 1)
+    authkey = bytes.fromhex(args.authkey or os.environ["RAY_TPU_AUTHKEY"])
+    ncpu = args.num_cpus if args.num_cpus is not None else os.cpu_count() or 1
+    resources = {"CPU": float(ncpu)}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    if args.resources:
+        import json
+
+        resources.update(json.loads(args.resources))
+    agent = NodeAgent((host, int(port)), authkey, resources,
+                      store_capacity=args.store_capacity,
+                      max_workers=args.max_workers)
+    agent.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -84,7 +84,8 @@ class SharedMemoryStore:
     """
 
     def __init__(self, capacity_bytes: int = 2 * 1024**3,
-                 use_native_arena: bool = True):
+                 use_native_arena: bool = True,
+                 spill_dir: Optional[str] = None):
         self.capacity = capacity_bytes
         self.used = 0
         self._objects: "OrderedDict[ObjectID, PlasmaObject]" = OrderedDict()
@@ -93,6 +94,18 @@ class SharedMemoryStore:
         # Called with the ObjectID when LRU eviction frees an object, so the
         # object directory can mark it lost / trigger lineage reconstruction.
         self.evict_callback = None
+        # Spilling (reference: local_object_manager.h:41): under memory
+        # pressure, evicted objects whose bytes must survive (referenced /
+        # unknown) are written to spill_dir instead of dropped; get()
+        # restores them.  None disables spilling (pre-round-3 behavior).
+        self.spill_dir = spill_dir
+        self._spilled: Dict[ObjectID, Tuple[str, bytes, int]] = {}
+        # Policy hook: should_spill(oid) -> bool.  When unset, every evicted
+        # object spills (safe default for stores that cannot see refcounts,
+        # e.g. on remote node agents); the head wires this to the object
+        # directory so unreferenced objects are simply dropped.
+        self.should_spill = None
+        self.spill_callback = None  # notified with (oid) after a spill
         # Native C++ arena (plasma-core equivalent, ray_tpu/_native): used for
         # owner-process writes (driver puts).  Worker-created objects keep
         # the per-segment zero-round-trip path; both are zero-copy reads.
@@ -203,10 +216,13 @@ class SharedMemoryStore:
             self._objects[object_id] = obj
             self.used += data_size
 
-    def delete(self, object_id: ObjectID, evicted: bool = False):
+    def delete(self, object_id: ObjectID, evicted: bool = False,
+               keep_spilled: bool = False):
         with self._lock:
             if self.arena is not None:
                 self.arena.delete(object_id.binary())
+            if not keep_spilled:
+                self._drop_spill_file(object_id)
             obj = self._objects.pop(object_id, None)
             self._pinned.pop(object_id, None)
             if obj is not None:
@@ -226,7 +242,9 @@ class SharedMemoryStore:
                         pass
 
     def _evict_until(self, needed: int):
-        # Evict unpinned sealed objects, least recently used first.
+        # Evict unpinned sealed objects, least recently used first; objects
+        # the policy says must survive are spilled to disk instead of
+        # dropped (plasma eviction_policy.h + local_object_manager.h:41).
         if self.used + needed <= self.capacity:
             return
         for oid in list(self._objects.keys()):
@@ -234,8 +252,59 @@ class SharedMemoryStore:
                 break
             if oid in self._pinned:
                 continue
-            if self._objects[oid].sealed:
+            if not self._objects[oid].sealed:
+                continue
+            if self.spill_dir is not None and (
+                    self.should_spill is None or self.should_spill(oid)):
+                self._spill(oid)
+            else:
                 self.delete(oid, evicted=True)
+
+    def _spill(self, oid: ObjectID):
+        obj = self._objects.get(oid)
+        if obj is None or not obj.sealed:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, oid.hex() + ".bin")
+        with open(path, "wb") as f:
+            f.write(obj.shm.buf[: obj.data_size])
+        self._spilled[oid] = (path, obj.metadata, obj.data_size)
+        # Free the memory; the spilled record + file survive this delete.
+        self.delete(oid, keep_spilled=True)
+        if self.spill_callback is not None:
+            try:
+                self.spill_callback(oid)
+            except Exception:
+                pass
+
+    def spilled_lookup(self, oid: ObjectID):
+        with self._lock:
+            rec = self._spilled.get(oid)
+            if rec is None:
+                return None
+            path, meta, size = rec
+            return {"kind": "spilled", "path": path, "meta": meta,
+                    "size": size}
+
+    def read_spilled(self, oid: ObjectID) -> Optional[Tuple[bytes, bytes]]:
+        with self._lock:
+            rec = self._spilled.get(oid)
+        if rec is None:
+            return None
+        path, meta, _ = rec
+        try:
+            with open(path, "rb") as f:
+                return meta, f.read()
+        except FileNotFoundError:
+            return None
+
+    def _drop_spill_file(self, oid: ObjectID):
+        rec = self._spilled.pop(oid, None)
+        if rec is not None:
+            try:
+                os.remove(rec[0])
+            except OSError:
+                pass
 
     # -- native arena paths (owner process only) --
     def arena_write(self, object_id: ObjectID, size: int) -> Optional[memoryview]:

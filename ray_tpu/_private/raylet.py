@@ -27,7 +27,7 @@ IDLE_WORKER_TTL_S = 300.0
 
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "busy", "actor_id", "node_id",
-                 "current_task", "idle_since", "tpu_visible")
+                 "current_task", "idle_since", "tpu_visible", "tpu_chips")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -39,6 +39,7 @@ class WorkerHandle:
         self.current_task: Optional[TaskSpec] = None
         self.idle_since = time.monotonic()
         self.tpu_visible = False
+        self.tpu_chips: tuple = ()  # chip indices this worker may touch
 
 
 class Raylet:
@@ -46,10 +47,14 @@ class Raylet:
     lock (all mutation happens under head._lock)."""
 
     def __init__(self, node_id: NodeID, head, store_capacity: int,
-                 labels: Optional[dict] = None, max_workers: int = DEFAULT_MAX_WORKERS):
+                 labels: Optional[dict] = None, max_workers: int = DEFAULT_MAX_WORKERS,
+                 tpu_chips: int = 0):
         self.node_id = node_id
         self.head = head
-        self.store = SharedMemoryStore(store_capacity)
+        self.store = SharedMemoryStore(
+            store_capacity,
+            spill_dir=os.path.join(head.session_dir, "spill",
+                                   node_id.hex()[:12]))
         self.labels = labels or {}
         self.max_workers = max_workers
         self.workers: Dict[WorkerID, WorkerHandle] = {}
@@ -58,28 +63,71 @@ class Raylet:
         self.num_starting = 0
         self.consecutive_start_failures = 0
         self.dead = False
+        # Chip partitioning: libtpu grabs every visible chip exclusively, so
+        # two TPU-visible processes on one host MUST see disjoint chip sets
+        # (TPU_VISIBLE_CHIPS) or the second hangs/fails at backend init.
+        self.tpu_chips_total = int(tpu_chips)
+        self._free_chips = list(range(self.tpu_chips_total))
 
     # ---- worker pool ----
+    @staticmethod
+    def _chips_needed(spec: TaskSpec) -> int:
+        """Exclusive chips for a TPU spec: whole-number requests partition
+        (ceil); fractional requests return 0 = *shared* mode — the worker
+        is TPU-visible with no exclusive chip claim, because sharing is the
+        declared intent and an exclusive grant would deadlock the peers the
+        scheduler co-packed onto the same chip."""
+        req = spec.resources.get("TPU", 0)
+        if req < 1:
+            return 0
+
+        import math
+
+        return int(math.ceil(req))
+
+    @staticmethod
+    def _needs_tpu(spec: TaskSpec) -> bool:
+        return spec.resources.get("TPU", 0) > 0
+
     def ensure_worker(self, spec: Optional[TaskSpec] = None):
         """Spawn a new worker process if needed for `spec` (or any task)."""
-        needs_tpu = spec is not None and spec.resources.get("TPU", 0) > 0
+        needs_tpu = spec is not None and self._needs_tpu(spec)
+        needs_chips = self._chips_needed(spec) if needs_tpu else 0
         if needs_tpu:
-            # TPU tasks need a TPU-visible worker.  A worker that is busy or
-            # permanently pinned to an actor can never serve this spec, so
-            # "some TPU worker exists" is not enough — that silently
-            # deadlocked a second TPU actor on the same node.  Spawn another
-            # as long as none is *available or starting* and the node has
-            # pool headroom (the scheduler already capped concurrent TPU
-            # grants to the node's TPU resource total).
+            # TPU tasks need a TPU-visible worker whose chip share covers
+            # the request.  A worker that is busy or permanently pinned to
+            # an actor can never serve this spec, so "some TPU worker
+            # exists" is not enough — that silently deadlocked a second TPU
+            # actor on the same node.  Spawn another as long as none with
+            # enough chips is *available or starting*; each TPU worker is
+            # spawned onto a disjoint chip partition (TPU_VISIBLE_CHIPS) so
+            # concurrent TPU workers never contend for the exclusive libtpu.
             for w in self.workers.values():
                 if not w.tpu_visible:
+                    continue
+                # With an unknown topology (total == 0) every TPU worker
+                # sees all chips, so chip-count matching is moot (same
+                # guard as _pop_idle); shared-mode specs (needs_chips == 0)
+                # are satisfied by any TPU-visible worker.
+                if self.tpu_chips_total > 0 and len(w.tpu_chips) < needs_chips:
                     continue
                 if w.conn is None:  # still starting — wait for it
                     return
                 if not w.busy and w.actor_id is None:  # idle and claimable
                     return
             if len(self.workers) < self.max_workers:
-                self.spawn_worker(tpu_visible=True)
+                if needs_chips:
+                    chips = self._allocate_chips(needs_chips)
+                    if chips is None:
+                        # No free chips: every chip is held by a live TPU
+                        # worker.  The spec waits until one dies/releases
+                        # (the scheduler already capped grants to the
+                        # node's TPU total, so this only happens while a
+                        # pinned worker is shutting down).
+                        return
+                else:
+                    chips = ()  # shared mode: all chips visible, none owned
+                self.spawn_worker(tpu_visible=True, tpu_chips=chips)
             return
         if self.idle or self.num_starting > 0:
             return
@@ -87,9 +135,53 @@ class Raylet:
             return
         self.spawn_worker()
 
-    def spawn_worker(self, tpu_visible: bool = False) -> WorkerID:
+    def _allocate_chips(self, n: int) -> Optional[tuple]:
+        """Reserve n chip indices for a new TPU worker (None if unavailable).
+        With an unknown topology (tpu_chips_total == 0, e.g. fake-TPU CPU
+        test nodes) partitioning is moot: return an empty share."""
+        if self.tpu_chips_total == 0:
+            return ()
+        if len(self._free_chips) < n:
+            return None
+        chips = tuple(self._free_chips[:n])
+        del self._free_chips[:n]
+        return chips
+
+    def _worker_env(self, worker_id: WorkerID, tpu_visible: bool,
+                    tpu_chips: tuple) -> Dict[str, str]:
+        """Env-var *overlay* every worker needs, local or remote (transport
+        vars are added by the spawner — head socket locally, head TCP on
+        agents; the spawner applies this on top of its inherited environ,
+        then applies the non-TPU JAX_PLATFORMS=cpu setdefault)."""
+        env = {
+            "RAY_TPU_AUTHKEY": self.head.authkey.hex(),
+            "RAY_TPU_NODE_ID": self.node_id.hex(),
+            "RAY_TPU_WORKER_ID": worker_id.hex(),
+        }
+        if tpu_visible and tpu_chips and len(tpu_chips) < self.tpu_chips_total:
+            # Strict-subset chip share: partition via TPU_VISIBLE_CHIPS so
+            # concurrent TPU workers on this host never contend for libtpu.
+            # A worker granted ALL host chips keeps the default env — the
+            # proven whole-host path (and the only case libtpu's default
+            # topology handling needs).
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in tpu_chips)
+            env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"{len(tpu_chips)},1,1"
+        return env
+
+    def spawn_worker(self, tpu_visible: bool = False,
+                     tpu_chips: tuple = ()) -> WorkerID:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        env.update(self._worker_env(worker_id, tpu_visible, tpu_chips))
+        if not tpu_visible:
+            # Workers are CPU-only so they never contend for the (exclusive)
+            # TPU chips; mesh workers are spawned with tpu_visible=True.
+            # Dropping the accelerator-plugin trigger vars also skips the
+            # site hook's eager jax import, cutting worker cold-start by
+            # seconds (the worker can still `import jax` lazily on CPU).
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         # Ensure workers can import ray_tpu even when the driver added it to
         # sys.path manually rather than installing the package.
         import ray_tpu as _pkg
@@ -97,14 +189,7 @@ class Raylet:
         pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__)))
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_HEAD_SOCKET"] = self.head.socket_path
-        env["RAY_TPU_AUTHKEY"] = self.head.authkey.hex()
-        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_SESSION_DIR"] = self.head.session_dir
-        if not tpu_visible:
-            # Workers default to CPU so they never contend for the (exclusive)
-            # TPU chips; mesh workers are spawned with tpu_visible=True.
-            env.setdefault("JAX_PLATFORMS", "cpu")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.default_worker"],
             env=env,
@@ -113,6 +198,7 @@ class Raylet:
         )
         h = WorkerHandle(worker_id, proc, self.node_id)
         h.tpu_visible = tpu_visible
+        h.tpu_chips = tuple(tpu_chips)
         self.workers[worker_id] = h
         self.num_starting += 1
         return worker_id
@@ -136,6 +222,10 @@ class Raylet:
             self.idle.remove(worker_id)
         except ValueError:
             pass
+        if h.tpu_chips:  # return the chip partition to the free pool
+            self._free_chips.extend(h.tpu_chips)
+            self._free_chips.sort()
+            h.tpu_chips = ()
         return h
 
     # ---- dispatch ----
@@ -161,13 +251,17 @@ class Raylet:
                 self.head.send_to_worker(worker, {"type": "execute", "spec": spec})
 
     def _pop_idle(self, spec: TaskSpec) -> Optional[WorkerHandle]:
-        needs_tpu = spec.resources.get("TPU", 0) > 0
+        needs_tpu = self._needs_tpu(spec)
+        needs_chips = self._chips_needed(spec) if needs_tpu else 0
         for _ in range(len(self.idle)):
             wid = self.idle.popleft()
             h = self.workers.get(wid)
             if h is None or h.conn is None:
                 continue
-            if needs_tpu and not h.tpu_visible:
+            if needs_tpu and (
+                    not h.tpu_visible
+                    or (self.tpu_chips_total > 0
+                        and len(h.tpu_chips) < needs_chips)):
                 self.idle.append(wid)
                 continue
             return h
@@ -206,3 +300,146 @@ class Raylet:
                 except Exception:
                     pass
         self.store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Remote nodes (multi-host): head-side proxies for a node agent process
+# ---------------------------------------------------------------------------
+class RemoteStoreProxy:
+    """Head-side handle for a store that lives in a node agent process.
+
+    Mutations are forwarded over the agent connection; reads return None —
+    the head never reads remote bytes, it hands out pull resolutions against
+    the agent's ObjectTransferServer instead (the reference's raylet↔object
+    manager split, src/ray/object_manager/object_manager.h:117)."""
+
+    def __init__(self, raylet: "RemoteRaylet"):
+        self._raylet = raylet
+        self.arena = None
+        self.evict_callback = None  # agents report via "object_evicted" msgs
+        # Spill records reported by the agent ("object_spilled"): lets the
+        # head hand same-host callers a direct spill-file resolution.
+        self._spilled: Dict = {}
+
+    def adopt(self, object_id, data_size: int, metadata: bytes):
+        self._raylet.send_agent({"type": "store_adopt",
+                                 "oid": object_id.binary(),
+                                 "size": data_size, "meta": metadata})
+
+    def delete(self, object_id, evicted: bool = False):
+        self._spilled.pop(object_id, None)
+        self._raylet.send_agent({"type": "store_delete",
+                                 "oid": object_id.binary()})
+
+    def note_spilled(self, object_id, path: str, meta: bytes, size: int):
+        self._spilled[object_id] = (path, meta, size)
+
+    def meta(self, object_id):
+        return None
+
+    def arena_lookup(self, object_id):
+        return None
+
+    def spilled_lookup(self, object_id):
+        rec = self._spilled.get(object_id)
+        if rec is None:
+            return None
+        path, meta, size = rec
+        return {"kind": "spilled", "path": path, "meta": meta, "size": size}
+
+    def get(self, object_id):
+        return None
+
+    def contains(self, object_id):
+        return False
+
+    def pin(self, object_id):
+        pass
+
+    def unpin(self, object_id):
+        pass
+
+    def stats(self):
+        return {}
+
+    def shutdown(self):
+        pass
+
+
+class _RemoteProc:
+    """Popen stand-in for a worker subprocess living on another host.
+    Liveness comes from the agent's worker_exit reports + the worker's own
+    control connection, not from local polling."""
+
+    def __init__(self, raylet: "RemoteRaylet", worker_id: WorkerID):
+        self._raylet = raylet
+        self._worker_id = worker_id
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+    def kill(self):
+        self._raylet.send_agent({"type": "kill_worker",
+                                 "worker_id": self._worker_id.binary()})
+
+
+class RemoteRaylet(Raylet):
+    """A raylet whose store + worker processes live on another host, driven
+    through a NodeAgent connection (reference: the remote raylet the GCS
+    talks to via NodeManagerService, src/ray/raylet/node_manager.h:115)."""
+
+    def __init__(self, node_id: NodeID, head, agent_conn, host_key: str,
+                 transfer_addr, labels: Optional[dict] = None,
+                 max_workers: int = DEFAULT_MAX_WORKERS, tpu_chips: int = 0):
+        # Deliberately NOT calling super().__init__: no local store.
+        self.node_id = node_id
+        self.head = head
+        self.agent_conn = agent_conn
+        self.host_key = host_key
+        self.transfer_addr = tuple(transfer_addr)
+        self.store = RemoteStoreProxy(self)
+        self.labels = labels or {}
+        self.max_workers = max_workers
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self.idle: deque = deque()
+        self.queued: deque = deque()
+        self.num_starting = 0
+        self.consecutive_start_failures = 0
+        self.dead = False
+        self.tpu_chips_total = int(tpu_chips)
+        self._free_chips = list(range(self.tpu_chips_total))
+        self._agent_lock = threading.Lock()
+
+    def send_agent(self, msg: dict):
+        try:
+            with self._agent_lock:
+                self.agent_conn.send(msg)
+        except Exception:
+            pass  # agent death is handled by its conn-close path
+
+    def spawn_worker(self, tpu_visible: bool = False,
+                     tpu_chips: tuple = ()) -> WorkerID:
+        worker_id = WorkerID.from_random()
+        env = self._worker_env(worker_id, tpu_visible, tpu_chips)
+        if not tpu_visible:
+            env["JAX_PLATFORMS"] = "cpu"
+        self.send_agent({"type": "spawn_worker",
+                         "worker_id": worker_id.binary(), "env": env})
+        h = WorkerHandle(worker_id, _RemoteProc(self, worker_id), self.node_id)
+        h.tpu_visible = tpu_visible
+        h.tpu_chips = tuple(tpu_chips)
+        self.workers[worker_id] = h
+        self.num_starting += 1
+        return worker_id
+
+    def shutdown(self):
+        self.dead = True
+        self.send_agent({"type": "shutdown"})
+        try:
+            self.agent_conn.close()
+        except Exception:
+            pass

@@ -42,6 +42,7 @@ class DirectTransport:
     def __init__(self, head, worker_id: WorkerID):
         self.head = head
         self.worker_id = worker_id
+        self.authkey = head.authkey
 
     def request(self, op: str, payload: dict, timeout: Optional[float] = None):
         fut: Future = Future()
@@ -87,8 +88,14 @@ class ConnTransport:
     A reader thread (owned by default_worker) routes replies into
     self._futures; sends are serialized by a lock."""
 
-    def __init__(self, conn):
+    def __init__(self, conn, authkey: Optional[bytes] = None):
         self.conn = conn
+        self.authkey = authkey
+        if self.authkey is None:
+            import os
+
+            hexkey = os.environ.get("RAY_TPU_AUTHKEY")
+            self.authkey = bytes.fromhex(hexkey) if hexkey else None
         self._send_lock = threading.Lock()
         self._futures: Dict[int, Future] = {}
         self._msg_counter = 0
@@ -409,12 +416,98 @@ class CoreWorker:
                 self._release_arena_lease(oid)
             self._cache_value(oid, value)
             return value
+        if kind == "spilled":
+            # Same-host spill file: zero-copy mmap read (reference:
+            # restore-on-get, spilled_object_reader.h).
+            import mmap
+
+            try:
+                with open(msg["path"], "rb") as f:
+                    if msg["size"] > 0:
+                        buf = mmap.mmap(f.fileno(), 0,
+                                        access=mmap.ACCESS_READ)
+                    else:
+                        buf = f.read()
+            except (FileNotFoundError, ValueError):
+                raise exc.ObjectLostError(
+                    f"spilled object {oid} vanished from disk")
+            value, _ = ser.unpack(msg["meta"], memoryview(buf))
+            self._cache_value(oid, value)
+            self._shm_registry[oid] = buf  # keep the mapping alive
+            return value
+        if kind == "pull":
+            return self._pull_and_materialize(oid, msg)
         if kind == "error":
             err, _ = ser.unpack(msg["meta"], memoryview(msg["data"]))
             if isinstance(err, BaseException):
                 raise err
             raise exc.RayTpuError(str(err))
         raise exc.RayTpuError(f"bad resolution kind {kind}")
+
+    def _transfer_client(self):
+        if getattr(self, "_xfer_client", None) is None:
+            from ray_tpu._private.transfer import TransferClient
+
+            self._xfer_client = TransferClient(self.transport.authkey)
+        return self._xfer_client
+
+    def _pull_and_materialize(self, oid: ObjectID, msg: dict):
+        """Cross-host read: stream the object from the owning store's
+        transfer server into THIS node's store, seal the local replica (so
+        the directory learns the new location and neighbors read locally),
+        then materialize zero-copy from the local segment.  Reference:
+        pull_manager.h:52 + chunked push push_manager.h:29."""
+        addr = tuple(msg["addr"])
+        size = msg["size"]
+        client = self._transfer_client()
+        shm = None
+        try:
+            from multiprocessing import shared_memory
+
+            shm = shared_memory.SharedMemory(
+                name=store_mod._segment_name(oid), create=True,
+                size=max(1, size))
+            store_mod.untrack(shm)
+        except FileExistsError:
+            # Another local reader is already landing this object; fall
+            # through to a plain in-memory pull.
+            shm = None
+        except Exception:
+            shm = None
+        try:
+            if shm is not None:
+                view = shm.buf[:size]
+                try:
+                    meta, _ = client.pull(addr, oid, sink=view)
+                finally:
+                    view.release()
+                self.transport.notify({
+                    "type": "seal", "oid": oid.binary(),
+                    "node_id": self.node_id.binary(), "size": size,
+                    "meta": meta})
+                value, _ = ser.unpack(meta, shm.buf[:size])
+                self._cache_value(oid, value)
+                self._shm_registry[oid] = shm
+                return value
+            meta, data = client.pull(addr, oid)
+            value, _ = ser.unpack(meta, memoryview(data))
+            self._cache_value(oid, value)
+            return value
+        except BaseException as e:
+            # ANY failure before the seal (missing object, transport death
+            # mid-stream, unpack error) must unlink the pre-created segment:
+            # nothing owns it yet, and a leaked name permanently poisons the
+            # zero-copy pull path for this object on this host.
+            if shm is not None:
+                try:
+                    shm.unlink()
+                    shm.close()
+                except Exception:
+                    pass
+            if isinstance(e, KeyError):
+                raise exc.ObjectLostError(
+                    f"object {oid} vanished from the remote store: {e}")
+            raise
 
     def _release_arena_lease(self, oid: ObjectID):
         try:

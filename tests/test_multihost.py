@@ -1,0 +1,134 @@
+"""Multi-host plane: remote node agents over TCP, cross-host object pull,
+remote driver join.
+
+The two node-agent processes each carry their own host_key, so even on one
+machine every cross-"host" read MUST go through the real TCP transfer path
+(the reference's equivalent coverage: multi-node object transfer tests over
+ray.cluster_utils.Cluster, python/ray/cluster_utils.py:99 — but those share
+one plasma per node; ours additionally fakes host boundaries)."""
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _wait_for_nodes(head, n, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(head.raylets) >= n:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"cluster never reached {n} nodes")
+
+
+def _spawn_agent(head, extra_resources: str, num_cpus: int = 2):
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_agent",
+         "--address", f"127.0.0.1:{head.tcp_port}",
+         "--authkey", head.authkey.hex(),
+         "--num-cpus", str(num_cpus),
+         "--resources", extra_resources,
+         "--store-capacity", str(256 * 1024 * 1024)],
+        env=None)
+
+
+@pytest.fixture
+def two_host_cluster():
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024**2)
+    import ray_tpu as rt
+
+    head = rt._head
+    agents = [_spawn_agent(head, '{"nodeA": 1}'),
+              _spawn_agent(head, '{"nodeB": 1}')]
+    try:
+        _wait_for_nodes(head, 3)
+        yield head
+    finally:
+        for a in agents:
+            a.kill()
+        ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def produce(n_bytes: int):
+    return np.frombuffer(b"\xab" * n_bytes, dtype=np.uint8).copy()
+
+
+@ray_tpu.remote
+def checksum(arr):
+    return int(arr[:16].sum()), len(arr)
+
+
+def test_cross_host_pull_driver(two_host_cluster):
+    """Driver (head host) gets a 100MB array produced on a remote node:
+    the bytes travel through the agent's ObjectTransferServer."""
+    n = 100 * 1024 * 1024
+    ref = produce.options(resources={"nodeA": 0.1}).remote(n)
+    arr = ray_tpu.get(ref, timeout=120)
+    assert len(arr) == n
+    assert arr[0] == 0xAB and arr[-1] == 0xAB
+
+
+def test_cross_host_pull_between_nodes(two_host_cluster):
+    """Node B consumes an object produced on node A — worker-side pull into
+    B's store, then zero-copy local reads."""
+    n = 8 * 1024 * 1024
+    ref = produce.options(resources={"nodeA": 0.1}).remote(n)
+    s, ln = ray_tpu.get(
+        checksum.options(resources={"nodeB": 0.1}).remote(ref), timeout=120)
+    assert ln == n
+    assert s == 16 * 0xAB
+
+
+def test_task_roundtrip_on_remote_node(two_host_cluster):
+    """Plain remote execution lands on agent-spawned workers over TCP."""
+    refs = [produce.options(resources={"nodeB": 0.1}).remote(1024)
+            for _ in range(3)]
+    for arr in ray_tpu.get(refs, timeout=120):
+        assert len(arr) == 1024
+
+
+_DRIVER_SCRIPT = """
+import sys
+import numpy as np
+import ray_tpu
+
+address, authkey = sys.argv[1], bytes.fromhex(sys.argv[2])
+ray_tpu.init(address=address, _authkey=authkey)
+
+@ray_tpu.remote
+def double(x):
+    return x * 2
+
+# control plane: remote task through the TCP head
+assert ray_tpu.get(double.remote(21), timeout=60) == 42
+# object plane: large put lives in the driver's embedded store, task args
+# resolve via pull; the result comes back the same way
+arr = np.arange(300_000, dtype=np.int64)
+ref = ray_tpu.put(arr)
+out = ray_tpu.get(double.remote(ref), timeout=60)
+assert out.shape == arr.shape and int(out[7]) == 14
+ray_tpu.shutdown()
+print("REMOTE_DRIVER_OK")
+"""
+
+
+def test_remote_driver_join():
+    """ray_tpu.init(address=...) from another process: the driver joins the
+    head over TCP (reference: ray.init(address=...) driver connect,
+    python/ray/_private/worker.py:1043)."""
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024**2)
+    try:
+        head = ray_tpu._head
+        out = subprocess.run(
+            [sys.executable, "-c", _DRIVER_SCRIPT,
+             f"127.0.0.1:{head.tcp_port}", head.authkey.hex()],
+            capture_output=True, text=True, timeout=180)
+        assert "REMOTE_DRIVER_OK" in out.stdout, (
+            f"stdout={out.stdout!r}\nstderr={out.stderr[-2000:]}")
+    finally:
+        ray_tpu.shutdown()

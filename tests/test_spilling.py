@@ -1,0 +1,70 @@
+"""Object spilling under memory pressure (reference:
+src/ray/raylet/local_object_manager.h:41 — referenced objects spill to disk
+instead of failing; gets restore them transparently)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+MB = 1024 * 1024
+
+
+@pytest.fixture
+def small_store_cluster(monkeypatch):
+    # Per-segment store only: the native arena has its own capacity pool and
+    # would absorb the first puts, making the pressure pattern nondeterministic.
+    monkeypatch.setenv("RAY_TPU_NATIVE_STORE", "0")
+    ray_tpu.init(num_cpus=2, object_store_memory=8 * MB)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_put_twice_capacity_then_get_all(small_store_cluster):
+    """2x store capacity of live referenced puts: older objects spill, every
+    get returns correct bytes (the VERDICT's done-criterion)."""
+    refs, expect = [], []
+    for i in range(8):  # 8 x 2MB = 16MB through an 8MB store
+        arr = np.full(2 * MB // 8, i, dtype=np.int64)
+        refs.append(ray_tpu.put(arr))
+        expect.append(arr)
+    head = ray_tpu._head
+    raylet = next(iter(head.raylets.values()))
+    assert raylet.store._spilled, "nothing spilled under 2x pressure"
+    for ref, arr in zip(refs, expect):
+        got = ray_tpu.get(ref, timeout=30)
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_task_returns_spill_and_restore(small_store_cluster):
+    @ray_tpu.remote
+    def make(i):
+        return np.full(2 * MB // 8, i, dtype=np.int64)
+
+    refs = [make.remote(i) for i in range(8)]
+    for i, ref in enumerate(refs):
+        got = ray_tpu.get(ref, timeout=60)
+        assert got[0] == i and got[-1] == i
+
+
+def test_worker_reads_spilled_object(small_store_cluster):
+    @ray_tpu.remote
+    def head_of(arr):
+        return int(arr[0])
+
+    refs = [ray_tpu.put(np.full(2 * MB // 8, i, dtype=np.int64))
+            for i in range(8)]
+    # Consume the OLDEST ref (most likely spilled) from a worker process.
+    assert ray_tpu.get(head_of.remote(refs[0]), timeout=60) == 0
+
+
+def test_unreferenced_objects_do_not_spill(small_store_cluster):
+    for i in range(6):
+        ref = ray_tpu.put(np.zeros(2 * MB // 8, dtype=np.int64))
+        del ref  # release: eviction should drop, not spill
+    head = ray_tpu._head
+    raylet = next(iter(head.raylets.values()))
+    spill_dir = raylet.store.spill_dir
+    n_files = len(os.listdir(spill_dir)) if os.path.isdir(spill_dir) else 0
+    assert n_files == 0
