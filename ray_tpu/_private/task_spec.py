@@ -79,6 +79,10 @@ class TaskSpec:
     # Actor fields
     actor_id: Optional[ActorID] = None
     max_restarts: int = 0
+    # Default max_retries for this actor's method calls (creation spec only;
+    # reference: max_task_retries, src/ray/core_worker/task_manager.h —
+    # actor tasks replay across restarts up to this many times).
+    max_task_retries: int = 0
     max_concurrency: int = 1
     actor_name: Optional[str] = None
     actor_method_names: List[str] = field(default_factory=list)

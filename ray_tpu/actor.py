@@ -40,10 +40,11 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names: List[str],
-                 class_name: str = "Actor"):
+                 class_name: str = "Actor", max_task_retries: int = 0):
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._class_name = class_name
+        self._max_task_retries = max_task_retries
 
     @property
     def actor_id(self) -> ActorID:
@@ -65,6 +66,9 @@ class ActorHandle:
             kwargs=task_kwargs,
             num_returns=options.get("num_returns", 1),
             actor_id=self._actor_id,
+            max_retries=options.get("max_task_retries",
+                                    self._max_task_retries),
+            retry_exceptions=bool(options.get("retry_exceptions", False)),
         )
         refs = global_worker.submit_actor_task(spec)
         if spec.num_returns == 0:
@@ -83,7 +87,8 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_names, self._class_name))
+        return (ActorHandle, (self._actor_id, self._method_names,
+                              self._class_name, self._max_task_retries))
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -155,6 +160,7 @@ class ActorClass:
             max_retries=0,
             actor_id=actor_id,
             max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             actor_name=opts.get("name"),
             actor_method_names=self._method_names,
@@ -165,7 +171,8 @@ class ActorClass:
         spec.owner_worker_id = global_worker.worker_id
         spec.parent_task_id = global_worker.current_task_id()
         global_worker.transport.request("create_actor", {"spec": spec})
-        return ActorHandle(actor_id, self._method_names, self.__name__)
+        return ActorHandle(actor_id, self._method_names, self.__name__,
+                           max_task_retries=spec.max_task_retries)
 
     def __call__(self, *a, **kw):
         raise TypeError(

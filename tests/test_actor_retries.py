@@ -1,0 +1,73 @@
+"""Actor-task retries across restarts (reference semantics:
+max_task_retries on src/ray/core_worker/task_manager.h — in-flight calls
+replay on the restarted actor; retry_exceptions covers app-level errors)."""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024**2)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+
+    def pid(self):
+        return os.getpid()
+
+    def slow_inc(self, delay):
+        time.sleep(delay)
+        self.n += 1
+        return self.n
+
+    def flaky(self):
+        self.n += 1
+        if self.n == 1:
+            raise ValueError("first call fails")
+        return self.n
+
+
+def test_inflight_actor_task_replays_across_restart(cluster):
+    c = Counter.options(max_restarts=1, max_task_retries=2).remote()
+    pid = ray_tpu.get(c.pid.remote(), timeout=30)
+    ref = c.slow_inc.remote(3.0)
+    time.sleep(0.5)  # let the call start executing
+    os.kill(pid, 9)
+    # The call replays on the restarted instance (fresh state -> 1).
+    assert ray_tpu.get(ref, timeout=60) == 1
+    new_pid = ray_tpu.get(c.pid.remote(), timeout=30)
+    assert new_pid != pid
+
+
+def test_inflight_actor_task_fails_without_retry_budget(cluster):
+    c = Counter.options(max_restarts=1).remote()  # max_task_retries=0
+    pid = ray_tpu.get(c.pid.remote(), timeout=30)
+    ref = c.slow_inc.remote(3.0)
+    time.sleep(0.5)
+    os.kill(pid, 9)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(ref, timeout=60)
+    # ...but the actor itself restarted and serves new calls.
+    assert ray_tpu.get(c.slow_inc.remote(0.0), timeout=30) == 1
+
+
+def test_retry_exceptions_on_live_actor(cluster):
+    c = Counter.remote()
+    ref = c.flaky.options(max_task_retries=2, retry_exceptions=True).remote()
+    assert ray_tpu.get(ref, timeout=30) == 2  # second attempt sees n==2
+
+
+def test_app_error_not_retried_by_default(cluster):
+    c = Counter.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(c.flaky.remote(), timeout=30)
