@@ -76,13 +76,16 @@ def gpt2_train_loop(config):
         return optax.apply_updates(params, updates), opt, loss
 
     params, opt, loss = step(params, opt, ids)
-    jax.block_until_ready(loss)  # compile + warmup
+    float(jax.device_get(loss))  # compile + warmup, true host barrier
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     iters = config.get("iters", 20)
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt, loss = step(params, opt, ids)
-    jax.block_until_ready(loss)
+    # device_get is the only trustworthy barrier: block_until_ready can
+    # return before remote execution finishes on tunneled backends, which
+    # silently inflates tokens/s past the chip's physical peak.
+    loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
     tokens_per_s = iters * B * S / dt
     # FLOPs/token: 6*N for fwd+bwd matmuls + 12*L*d*S attention scores/AV
@@ -134,25 +137,45 @@ def bench_ppo_breakout() -> dict:
     from ray_tpu.rllib import PPOConfig
 
     num_devices = max(1, len(jax.devices()))
-    num_envs, unroll = 4096, 64
+    num_envs, unroll = 8192, 64
     algo = (
         PPOConfig()
         .environment("Breakout-MinAtar-v0")
         .anakin(num_envs=num_envs, unroll_length=unroll)
-        .training(num_sgd_iter=2, sgd_minibatch_size=32768, lr=5e-4,
+        .training(num_sgd_iter=2, sgd_minibatch_size=8192, lr=5e-4,
                   entropy_coeff=0.01)
         .debugging(seed=0)
         .build()
     )
-    # Learn phase: gate on a reward floor (random policy scores ~0.14).
+    # Learn phase: the throughput measurement is GATED on reaching the
+    # reward floor (random policy scores ~0.14) — an un-learning pipeline's
+    # steps/s would be meaningless, so it is never measured.
     reward = float("nan")
+    best = float("-inf")
     metrics = algo.train()  # compile + warmup
+    floor_met = False
     for i in range(150):
         metrics = algo.train()
         reward = metrics.get("episode_reward_mean", float("nan"))
-        if i >= 20 and reward >= BREAKOUT_REWARD_FLOOR:
+        if reward == reward:
+            best = max(best, reward)
+        if i >= 10 and reward >= BREAKOUT_REWARD_FLOOR:
+            floor_met = True
             break
-    # Measure phase: steady-state throughput.
+    out = {
+        "metric": "ppo_breakout_pixels_env_steps_per_sec",
+        "unit": "env_steps/s",
+        "episode_reward_mean": round(float(reward), 2),
+        "reward_floor": BREAKOUT_REWARD_FLOOR,
+        "reward_floor_met": floor_met,
+        "num_devices": num_devices,
+    }
+    if not floor_met:
+        out.update({"value": 0, "vs_baseline": 0.0,
+                    "best_reward": round(float(best), 2)})
+        return out
+    # Measure phase (only reached with the floor passed): steady-state
+    # throughput of the exact config that just learned.
     iters = 8
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -160,16 +183,12 @@ def bench_ppo_breakout() -> dict:
     dt = time.perf_counter() - t0
     steps_per_s = iters * num_envs * unroll / dt
     reward = metrics.get("episode_reward_mean", reward)
-    return {
-        "metric": "ppo_breakout_pixels_env_steps_per_sec",
+    out.update({
         "value": round(steps_per_s),
-        "unit": "env_steps/s",
         "vs_baseline": round(steps_per_s / num_devices / 62500.0, 2),
         "episode_reward_mean": round(float(reward), 2),
-        "reward_floor": BREAKOUT_REWARD_FLOOR,
-        "reward_floor_met": bool(reward >= BREAKOUT_REWARD_FLOOR),
-        "num_devices": num_devices,
-    }
+    })
+    return out
 
 
 def main():

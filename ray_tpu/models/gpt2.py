@@ -39,10 +39,22 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     use_flash: Optional[bool] = None  # None = auto by backend
     scan_layers_threshold: int = 24
+    # Mixture-of-Experts: replace every block's dense MLP with a top-k
+    # routed expert MLP (ray_tpu.ops.moe).  The dense-dispatch einsums
+    # partition over the `expert` mesh axis under pjit via the logical
+    # axes below (net-new TPU scope, SURVEY §2.4 EP).
+    moe: Optional[Any] = None  # ops.moe.MoEConfig
 
     @classmethod
     def gpt2_small(cls, **kw):  # 125M
         return cls(**kw)
+
+    @classmethod
+    def moe_tiny(cls, num_experts: int = 8, top_k: int = 2, **kw):
+        from ray_tpu.ops.moe import MoEConfig
+
+        kw.setdefault("moe", MoEConfig(num_experts=num_experts, top_k=top_k))
+        return cls.tiny(**kw)
 
     @classmethod
     def gpt2_medium(cls, **kw):  # 350M
@@ -83,10 +95,27 @@ class Block(nn.Module):
         attn = attn.reshape(b, l, c.hidden_size)
         x = x + nn.Dense(c.hidden_size, dtype=c.dtype, name="attn_proj")(attn)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
-        h = nn.Dense(c.mlp_ratio * c.hidden_size, dtype=c.dtype,
-                     name="mlp_fc")(h)
-        h = gelu(h)
-        x = x + nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_proj")(h)
+        if c.moe is not None:
+            from ray_tpu.ops.moe import moe_apply
+
+            d, f, e = c.hidden_size, c.mlp_ratio * c.hidden_size, \
+                c.moe.num_experts
+            w_router = self.param("moe_router",
+                                  nn.initializers.normal(0.02), (d, e),
+                                  jnp.float32)
+            w_in = self.param("moe_w_in", nn.initializers.normal(0.02),
+                              (e, d, f), jnp.float32)
+            w_out = self.param("moe_w_out", nn.initializers.normal(0.02),
+                               (e, f, d), jnp.float32)
+            bsz, l, _ = h.shape
+            flat = h.reshape(bsz * l, d)
+            out = moe_apply(flat, w_router, w_in, w_out, c.moe)
+            x = x + out.reshape(bsz, l, d).astype(c.dtype)
+        else:
+            h = nn.Dense(c.mlp_ratio * c.hidden_size, dtype=c.dtype,
+                         name="mlp_fc")(h)
+            h = gelu(h)
+            x = x + nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_proj")(h)
         return x
 
 
@@ -114,10 +143,13 @@ class GPT2(nn.Module):
             for i in range(c.num_layers):
                 x = Block(c, self.attn_fn, name=f"h_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        # Tied LM head.
-        logits = jnp.einsum("bld,vd->blv", x.astype(jnp.float32),
-                            wte.astype(jnp.float32))
-        return logits
+        # Tied LM head: the matmul runs at the compute dtype (bf16 doubles
+        # MXU rate on the single biggest matmul in the model); the logits
+        # are promoted to fp32 so the downstream log-softmax keeps full
+        # precision where it matters.
+        logits = jnp.einsum("bld,vd->blv", x.astype(c.dtype),
+                            wte.astype(c.dtype))
+        return logits.astype(jnp.float32)
 
 
 def gpt2_loss_fn(params, apply_fn, batch) -> jax.Array:
@@ -143,6 +175,9 @@ _AXIS_BY_NAME: Dict[str, tuple] = {
     "mlp_fc/bias": ("mlp",),
     "mlp_proj/kernel": ("mlp", "embed_fsdp"),
     "mlp_proj/bias": (None,),
+    "moe_router": ("embed", None),
+    "moe_w_in": ("expert", "embed", "mlp"),
+    "moe_w_out": ("expert", "mlp", "embed_fsdp"),
 }
 
 

@@ -129,12 +129,15 @@ class IMPALA(Algorithm):
         self._steps_per_iter = N * T
 
     def _training_step_anakin(self):
-        prev_sum = float(self._anakin_state.done_return_sum)
-        prev_cnt = float(self._anakin_state.done_count)
         self._anakin_state, metrics = self._train_step(self._anakin_state)
-        metrics = {k: float(v) for k, v in metrics.items()}
-        dsum = metrics.pop("episode_return_sum") - prev_sum
-        dcnt = metrics.pop("episode_count") - prev_cnt
+        # One batched host fetch for all metrics (see ppo.py: per-scalar
+        # float() pays a full transfer round-trip each).
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        prev_sum, prev_cnt = getattr(self, "_prev_counters", (0.0, 0.0))
+        cum_sum = metrics.pop("episode_return_sum")
+        cum_cnt = metrics.pop("episode_count")
+        self._prev_counters = (cum_sum, cum_cnt)
+        dsum, dcnt = cum_sum - prev_sum, cum_cnt - prev_cnt
         if dcnt > 0:
             self._ep_reward_ema = dsum / dcnt
         metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",
@@ -201,6 +204,10 @@ class IMPALA(Algorithm):
                 self.workers.sync_weights(self.learner.get_weights())
                 self._updates_since_broadcast = 0
             self._inflight[worker] = worker.sample_timemajor.remote()
+        if metrics:
+            from ray_tpu.rllib.core.learner import metrics_to_host
+
+            metrics = metrics_to_host(metrics)
         if ep_returns:
             self._ep_reward_ema = float(np.mean(ep_returns))
         metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",

@@ -191,12 +191,17 @@ class PPO(Algorithm):
         self._anakin_state = init_fn(self.config.seed)
 
     def _training_step_anakin(self) -> Dict[str, Any]:
-        prev_sum = float(self._anakin_state.done_return_sum)
-        prev_cnt = float(self._anakin_state.done_count)
         self._anakin_state, metrics = self._train_step(self._anakin_state)
-        metrics = {k: float(v) for k, v in metrics.items()}
-        dsum = metrics.pop("episode_return_sum") - prev_sum
-        dcnt = metrics.pop("episode_count") - prev_cnt
+        # ONE host fetch for every metric: each separate device->host read
+        # costs a full transfer round-trip (~0.1s on some backends), so
+        # per-scalar float() here would dominate the whole train step.  The
+        # previous counter values are remembered host-side from last iter.
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        prev_sum, prev_cnt = getattr(self, "_prev_counters", (0.0, 0.0))
+        cum_sum = metrics.pop("episode_return_sum")
+        cum_cnt = metrics.pop("episode_count")
+        self._prev_counters = (cum_sum, cum_cnt)
+        dsum, dcnt = cum_sum - prev_sum, cum_cnt - prev_cnt
         if dcnt > 0:
             self._ep_reward_ema = dsum / dcnt
         metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",
@@ -242,6 +247,10 @@ class PPO(Algorithm):
             for mb in shuffled.minibatches(
                     min(self.config.sgd_minibatch_size, len(shuffled))):
                 metrics = self.learner.update(dict(mb))
+        if metrics:
+            from ray_tpu.rllib.core.learner import metrics_to_host
+
+            metrics = metrics_to_host(metrics)
         self.workers.sync_weights(self.learner.get_weights())
         if ep_returns:
             self._ep_reward_ema = float(np.mean(ep_returns))

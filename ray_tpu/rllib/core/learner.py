@@ -20,6 +20,14 @@ from ray_tpu.parallel.mesh import MeshSpec, make_mesh
 from ray_tpu.parallel.sharding import batch_sharding, replicated
 
 
+def metrics_to_host(metrics: Dict[str, Any]) -> Dict[str, float]:
+    """One batched device->host fetch of a metrics dict (lazy jax scalars
+    from JaxLearner.update) into plain floats."""
+    host = jax.device_get(metrics)
+    return {k: (float(v) if hasattr(v, "__float__") else v)
+            for k, v in host.items()}
+
+
 class JaxLearner:
     """Holds params + optimizer state on a mesh; `update(batch)` runs one
     jitted SGD pass with in-graph gradient reduction."""
@@ -61,9 +69,11 @@ class JaxLearner:
         batch = {k: place(v) for k, v in batch.items()}
         self.params, self.opt_state, loss, aux = self._update(
             self.params, self.opt_state, batch)
-        out = {"total_loss": float(loss)}
-        out.update({k: float(v) for k, v in aux.items()})
-        return out
+        # Metrics stay ON DEVICE: every device->host read is a full transfer
+        # round-trip (~0.1s on some backends), and callers run this in a
+        # minibatch loop where only the last value matters.  Convert with
+        # metrics_to_host() (one batched fetch) at iteration end.
+        return {"total_loss": loss, **aux}
 
     def get_weights(self):
         return jax.device_get(self.params)
@@ -97,7 +107,9 @@ def _build_learner(state, factory):
 
 
 def _learner_update(state, batch):
-    return state["learner"].update(batch)
+    # Cross-process boundary: results are pickled, so fetch to host here
+    # (one batched transfer per update call).
+    return metrics_to_host(state["learner"].update(batch))
 
 
 def _learner_get_weights(state):

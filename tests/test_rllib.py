@@ -100,24 +100,39 @@ def test_ppo_checkpoint_roundtrip():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_anakin_ppo_breakout_pixels_learns():
-    """Atari-class pixel PPO: Breakout board -> CNN trunk, fully on-device
-    anakin loop.  Gate: reward well above the ~0.14 random-policy floor."""
+def _run_breakout(floor: float, iters: int, **training):
     from ray_tpu.rllib import PPOConfig
 
     algo = (PPOConfig()
             .environment("Breakout-MinAtar-v0")
             .anakin(num_envs=256, unroll_length=32)
-            .training(num_sgd_iter=2, sgd_minibatch_size=2048, lr=5e-4,
-                      entropy_coeff=0.01)
+            .training(**training)
             .debugging(seed=0)
             .build())
     best = 0.0
-    for i in range(45):
+    for i in range(iters):
         m = algo.train()
         r = m.get("episode_reward_mean")
         if r == r:  # not NaN
             best = max(best, r)
-        if best >= 0.8:
+        if best >= floor:
             break
-    assert best >= 0.8, f"no learning on pixel breakout: best={best}"
+    assert best >= floor, f"no learning on pixel breakout: best={best}"
+
+
+def test_anakin_ppo_breakout_pixels_learns():
+    """Atari-class pixel PPO: Breakout board -> CNN trunk, fully on-device
+    anakin loop.  Fast gate: clear 0.5 (random policy scores ~0.14) within
+    ~30s on the 8-dev CPU mesh; the full reference-strength gate is the
+    slow-marked variant below (reference pattern: per-algorithm learning
+    tests, rllib/utils/test_utils.py:57)."""
+    _run_breakout(floor=0.5, iters=40, num_sgd_iter=2,
+                  sgd_minibatch_size=1024, lr=1e-3, entropy_coeff=0.01)
+
+
+@pytest.mark.slow
+def test_anakin_ppo_breakout_pixels_learns_full():
+    """Full-strength learning gate (~6 min on CPU): reward >= 0.8 with the
+    bench-shaped hyperparameters."""
+    _run_breakout(floor=0.8, iters=45, num_sgd_iter=2,
+                  sgd_minibatch_size=2048, lr=5e-4, entropy_coeff=0.01)

@@ -1,0 +1,66 @@
+"""Hyperparameter probe for the headline PPO bench (not shipped in BENCH).
+
+Runs the bench-scale anakin PPO config with candidate hyperparams and logs
+the reward trajectory + steady-state throughput so we can pick a config
+that clears the 3.0 floor without losing env-steps/s.
+"""
+import argparse
+import json
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-envs", type=int, default=4096)
+    p.add_argument("--unroll", type=int, default=64)
+    p.add_argument("--minibatch", type=int, default=8192)
+    p.add_argument("--sgd-iters", type=int, default=2)
+    p.add_argument("--lr", type=float, default=5e-4)
+    p.add_argument("--entropy", type=float, default=0.01)
+    p.add_argument("--iters", type=int, default=150)
+    p.add_argument("--floor", type=float, default=3.0)
+    args = p.parse_args()
+
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("Breakout-MinAtar-v0")
+        .anakin(num_envs=args.num_envs, unroll_length=args.unroll)
+        .training(num_sgd_iter=args.sgd_iters,
+                  sgd_minibatch_size=args.minibatch, lr=args.lr,
+                  entropy_coeff=args.entropy)
+        .debugging(seed=0)
+        .build()
+    )
+    t_compile = time.perf_counter()
+    algo.train()
+    print(f"compile+warmup {time.perf_counter() - t_compile:.1f}s",
+          flush=True)
+    steps_per_iter = args.num_envs * args.unroll
+    hit = None
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        m = algo.train()
+        r = m.get("episode_reward_mean", float("nan"))
+        if i % 5 == 0 or (hit is None and r >= args.floor):
+            dt = time.perf_counter() - t0
+            print(f"iter {i:4d} reward {r:6.2f} ent {m.get('entropy', 0):.3f}"
+                  f" steps/s {steps_per_iter * (i + 1) / dt:,.0f}", flush=True)
+        if hit is None and r >= args.floor:
+            hit = i
+            break
+    # steady-state throughput
+    t0 = time.perf_counter()
+    for _ in range(8):
+        m = algo.train()
+    dt = time.perf_counter() - t0
+    sps = 8 * steps_per_iter / dt
+    print(json.dumps({"cfg": vars(args), "floor_hit_iter": hit,
+                      "final_reward": m.get("episode_reward_mean"),
+                      "steady_steps_per_s": round(sps)}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
