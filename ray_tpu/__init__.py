@@ -101,6 +101,18 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
                 return
             raise RuntimeError("ray_tpu.init() called twice "
                                "(pass ignore_reinit_error=True to allow)")
+        if kwargs.get("_system_config"):
+            from ray_tpu._private.config import CONFIG
+
+            CONFIG.apply_system_config(kwargs["_system_config"])
+        if address == "auto":
+            # Reference: ray.init(address="auto") — resolve from the env
+            # the job manager / CLI sets for entrypoint subprocesses.
+            address = os.environ.get("RAY_TPU_ADDRESS")
+            if not address:
+                raise RuntimeError(
+                    'init(address="auto") needs RAY_TPU_ADDRESS in the env '
+                    "(set by the job manager / ray_tpu CLI)")
         if address is not None:
             return _connect_remote_driver(address, _authkey,
                                           kwargs.get("job_config"))
@@ -111,7 +123,12 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
             res["TPU"] = ntpu
         res.setdefault("memory", float(object_store_memory))
         _boot_head(res, labels, store_capacity=object_store_memory)
-        return _connect_driver(kwargs.get("job_config"))
+        worker = _connect_driver(kwargs.get("job_config"))
+        if kwargs.get("log_to_driver", True):
+            from ray_tpu._private.log_monitor import attach_driver_echo
+
+            attach_driver_echo(_head.gcs)
+        return worker
 
 
 def _connect_remote_driver(address: str, authkey: Optional[bytes],

@@ -1,0 +1,143 @@
+"""Typed config-flag registry with env-var overrides.
+
+Reference: the RAY_CONFIG x-macro registry (src/ray/common/ray_config_def.h
+:17-22, 189 flags, overridable per-process via RAY_<name> env vars and the
+_system_config dict passed to ray.init).  Same contract here: every
+tunable the runtime consults is DECLARED in one table with a type and
+default, overridable via ``RAY_TPU_<NAME>`` env vars or
+``ray_tpu.init(_system_config={...})`` — ad-hoc os.environ.get calls are
+the anti-pattern this replaces.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, type_: type, default, doc: str):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+    def parse(self, raw: str):
+        if self.type is bool:
+            return _parse_bool(raw)
+        return self.type(raw)
+
+
+class RayTpuConfig:
+    """Singleton flag table (reference: RayConfig, ray_config.h).
+
+    Resolution order per flag: _system_config override > RAY_TPU_<NAME>
+    env var > declared default.  Values are cached after first read;
+    ``reset()`` clears the cache (tests)."""
+
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._overrides: Dict[str, Any] = {}
+        self._cache: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def declare(self, name: str, type_: type, default, doc: str = ""):
+        self._flags[name] = _Flag(name, type_, default, doc)
+        return self
+
+    def get(self, name: str):
+        with self._lock:
+            if name in self._cache:
+                return self._cache[name]
+            flag = self._flags.get(name)
+            if flag is None:
+                raise KeyError(f"undeclared config flag {name!r}")
+            if name in self._overrides:
+                ov = self._overrides[name]
+                if isinstance(ov, str):
+                    # Strings go through the flag parser — bool('0') would
+                    # silently flip a disable into an enable.
+                    value = flag.parse(ov)
+                elif isinstance(ov, flag.type):
+                    value = ov
+                else:
+                    value = flag.type(ov)
+            else:
+                raw = os.environ.get(_ENV_PREFIX + name.upper())
+                value = flag.parse(raw) if raw is not None else flag.default
+            self._cache[name] = value
+            return value
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+    def apply_system_config(self, overrides: Optional[Dict[str, Any]]):
+        if not overrides:
+            return
+        with self._lock:
+            for k, v in overrides.items():
+                if k not in self._flags:
+                    raise KeyError(f"unknown _system_config flag {k!r}")
+                self._overrides[k] = v
+            self._cache.clear()
+
+    def reset(self):
+        with self._lock:
+            self._overrides.clear()
+            self._cache.clear()
+
+    def dump(self) -> Dict[str, Any]:
+        """Current value of every declared flag (state API / debugging)."""
+        return {name: self.get(name) for name in sorted(self._flags)}
+
+    def doc(self, name: str) -> str:
+        return self._flags[name].doc
+
+
+CONFIG = RayTpuConfig()
+
+# ---- the registry (one declaration per tunable; grep for CONFIG.<name>
+# to find the consumer) ----
+CONFIG \
+    .declare("native_store", bool, True,
+             "Use the C++ shared-memory arena for driver puts.") \
+    .declare("worker_idle_ttl_s", float, 300.0,
+             "Idle pooled workers are reaped after this long.") \
+    .declare("max_workers_per_node", int, 64,
+             "Worker-process cap per node.") \
+    .declare("health_check_period_s", float, 0.5,
+             "Worker liveness poll interval in the head monitor.") \
+    .declare("spawn_failure_limit", int, 3,
+             "Consecutive worker spawn failures before queued work fails.") \
+    .declare("object_store_memory", int, 2 * 1024**3,
+             "Default per-node store capacity in bytes.") \
+    .declare("inline_object_threshold", int, 100 * 1024,
+             "Objects <= this many bytes inline in replies/directory.") \
+    .declare("transfer_chunk_bytes", int, 4 * 1024 * 1024,
+             "Cross-host object transfer chunk size.") \
+    .declare("spill_enabled", bool, True,
+             "Spill referenced objects to disk under memory pressure.") \
+    .declare("collective_timeout_s", float, 300.0,
+             "Actor-collective rendezvous timeout.") \
+    .declare("serve_control_interval_s", float, 1.0,
+             "Serve controller reconcile period.") \
+    .declare("tcp_host", str, "127.0.0.1",
+             "Head TCP bind host (0.0.0.0 to accept remote nodes).") \
+    .declare("chaos_delay_us", int, 0,
+             "Chaos: max random delay injected at instrumented points.") \
+    .declare("scheduler_spread_threshold", float, 0.5,
+             "Hybrid policy: node load ratio above which tasks spread.") \
+    .declare("task_event_buffer_size", int, 10000,
+             "Max task events retained for the state API.") \
+    .declare("gcs_snapshot_period_s", float, 0.0,
+             "Persist GCS tables every N seconds (0 = disabled).")

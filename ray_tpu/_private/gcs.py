@@ -148,6 +148,53 @@ class GCS:
         # Pubsub (reference: src/ray/pubsub) — in-process callback channels.
         self._subscribers: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
 
+    # ---------------- persistence ----------------
+    def snapshot(self) -> dict:
+        """Durable cluster state (reference: gcs_table_storage.h over
+        RedisStoreClient, redis_store_client.h:28 — here a picklable dict
+        written to the session dir).  Scope: the tables that outlive
+        processes — KV (function/class exports, workflow state), jobs, and
+        detached-actor name registrations; live sockets/workers/objects are
+        process state and rebuild on restart."""
+        with self._lock:
+            return {
+                "kv": {ns: dict(t) for ns, t in self.kv.items()},
+                "jobs": dict(self.jobs),
+                "named_actors": dict(self.named_actors),
+            }
+
+    def restore(self, snap: dict):
+        with self._lock:
+            for ns, t in snap.get("kv", {}).items():
+                self.kv[ns].update(t)
+            self.jobs.update(snap.get("jobs", {}))
+            # Only re-register names whose actor record is live in THIS
+            # process — the actors table is process state and is not
+            # snapshotted, so a restored dangling name would poison lookups
+            # (get_actor would crash) and block re-creation forever.
+            for key, actor_id in snap.get("named_actors", {}).items():
+                if actor_id in self.actors:
+                    self.named_actors.setdefault(key, actor_id)
+
+    def save_snapshot(self, path: str):
+        import os
+        import pickle
+
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self.snapshot(), f)
+        os.replace(tmp, path)  # atomic: readers never see a torn file
+
+    def load_snapshot(self, path: str) -> bool:
+        import pickle
+
+        try:
+            with open(path, "rb") as f:
+                self.restore(pickle.load(f))
+            return True
+        except (FileNotFoundError, EOFError, pickle.UnpicklingError):
+            return False
+
     # ---------------- pubsub ----------------
     def subscribe(self, channel: str, callback: Callable[[Any], None]):
         with self._lock:

@@ -108,7 +108,9 @@ class Head:
         # default — a purely local cluster must not expose its control
         # plane on external interfaces; set RAY_TPU_TCP_HOST=0.0.0.0 when
         # remote hosts are expected to join.
-        self.tcp_bind_host = os.environ.get("RAY_TPU_TCP_HOST", "127.0.0.1")
+        from ray_tpu._private.config import CONFIG
+
+        self.tcp_bind_host = CONFIG.tcp_host
         self._tcp_listener = Listener((self.tcp_bind_host, 0),
                                       family="AF_INET", authkey=self.authkey)
         self.tcp_port = self._tcp_listener.address[1]
@@ -125,6 +127,33 @@ class Head:
         self._monitor_thread = threading.Thread(target=self._monitor_loop,
                                                 name="rtpu-monitor", daemon=True)
         self._monitor_thread.start()
+        # Worker log capture → GCS pubsub → driver echo (reference:
+        # log_monitor.py:104).
+        from ray_tpu._private.log_monitor import LogMonitor
+
+        self.log_monitor = LogMonitor(os.path.join(self.session_dir, "logs"),
+                                      self.gcs)
+        # GCS persistence (reference: RedisStoreClient-backed GCS FT,
+        # redis_store_client.h:28): restore durable tables from a prior
+        # snapshot in this session dir, and re-snapshot periodically when
+        # gcs_snapshot_period_s > 0.
+        self.gcs_snapshot_path = os.path.join(self.session_dir,
+                                              "gcs_snapshot.pkl")
+        self.gcs.load_snapshot(self.gcs_snapshot_path)
+        period = CONFIG.gcs_snapshot_period_s
+        if period > 0:
+            def snapshot_loop():
+                import time as _time
+
+                while not self._shutdown:
+                    _time.sleep(period)
+                    try:
+                        self.gcs.save_snapshot(self.gcs_snapshot_path)
+                    except Exception:
+                        pass
+
+            threading.Thread(target=snapshot_loop, name="rtpu-gcs-snap",
+                             daemon=True).start()
 
     def _monitor_loop(self):
         import time as _time
@@ -674,6 +703,19 @@ class Head:
             reply(error=ValueError(f"cannot list {what!r}"))
         else:
             reply(fn())
+
+    def req_object_info(self, payload, reply, caller):
+        """Directory metadata for an object (size, locations) — used by the
+        streaming data executor to convert a store byte budget into an
+        in-flight block bound."""
+        with self._lock:
+            entry = self.gcs.object_lookup(payload["oid"])
+            if entry is None:
+                reply(None)
+                return
+            reply({"size": entry.size,
+                   "inline": entry.inline is not None,
+                   "num_locations": len(entry.locations)})
 
     def req_cluster_resources(self, payload, reply, caller):
         if payload.get("available"):
@@ -1270,6 +1312,7 @@ class Head:
 
     # ================= shutdown =================
     def shutdown(self):
+        self.log_monitor.stop()
         with self._lock:
             self._shutdown = True
             for raylet in self.raylets.values():

@@ -110,7 +110,9 @@ class SharedMemoryStore:
         # owner-process writes (driver puts).  Worker-created objects keep
         # the per-segment zero-round-trip path; both are zero-copy reads.
         self.arena = None
-        if use_native_arena and os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+        from ray_tpu._private.config import CONFIG
+
+        if use_native_arena and CONFIG.native_store:
             try:
                 from ray_tpu import _native
 

@@ -190,12 +190,23 @@ class Raylet:
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
         env["RAY_TPU_HEAD_SOCKET"] = self.head.socket_path
         env["RAY_TPU_SESSION_DIR"] = self.head.session_dir
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.default_worker"],
-            env=env,
-            stdout=None,
-            stderr=None,
-        )
+        # Per-worker log files, tailed by the head's LogMonitor and echoed
+        # to the driver (reference: log_monitor.py:104).
+        logs_dir = os.path.join(self.head.session_dir, "logs")
+        os.makedirs(logs_dir, exist_ok=True)
+        stem = os.path.join(logs_dir, f"worker-{worker_id.hex()[:16]}")
+        out_f = open(stem + ".out", "ab")
+        err_f = open(stem + ".err", "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.default_worker"],
+                env=env,
+                stdout=out_f,
+                stderr=err_f,
+            )
+        finally:
+            out_f.close()
+            err_f.close()
         h = WorkerHandle(worker_id, proc, self.node_id)
         h.tpu_visible = tpu_visible
         h.tpu_chips = tuple(tpu_chips)

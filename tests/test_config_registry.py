@@ -1,0 +1,59 @@
+"""Config-flag registry (reference: RAY_CONFIG x-macro table,
+src/ray/common/ray_config_def.h:17-22 — typed defaults, RAY_<name> env
+overrides, _system_config overrides)."""
+import pytest
+
+from ray_tpu._private.config import CONFIG
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    CONFIG.reset()
+    yield
+    CONFIG.reset()
+
+
+def test_defaults_and_attr_access():
+    assert CONFIG.native_store is True
+    assert CONFIG.max_workers_per_node == 64
+    assert CONFIG.get("transfer_chunk_bytes") == 4 * 1024 * 1024
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MAX_WORKERS_PER_NODE", "7")
+    monkeypatch.setenv("RAY_TPU_SPILL_ENABLED", "false")
+    CONFIG.reset()
+    assert CONFIG.max_workers_per_node == 7
+    assert CONFIG.spill_enabled is False
+
+
+def test_system_config_override_beats_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKER_IDLE_TTL_S", "11")
+    CONFIG.reset()
+    CONFIG.apply_system_config({"worker_idle_ttl_s": 42.0})
+    assert CONFIG.worker_idle_ttl_s == 42.0
+
+
+def test_undeclared_flag_rejected():
+    with pytest.raises(KeyError):
+        CONFIG.get("no_such_flag")
+    with pytest.raises(KeyError):
+        CONFIG.apply_system_config({"no_such_flag": 1})
+
+
+def test_dump_lists_every_flag():
+    d = CONFIG.dump()
+    assert "native_store" in d and "gcs_snapshot_period_s" in d
+    assert len(d) >= 15
+
+
+def test_system_config_string_bool_goes_through_parser():
+    """'0'/'false' strings must disable a bool flag — bool('0') is True,
+    which would silently invert the user's intent."""
+    CONFIG.reset()
+    CONFIG.apply_system_config({"native_store": "0"})
+    assert CONFIG.native_store is False
+    CONFIG.reset()
+    CONFIG.apply_system_config({"native_store": "true"})
+    assert CONFIG.native_store is True
+    CONFIG.reset()

@@ -1,0 +1,56 @@
+"""Worker log capture + driver echo (reference: log_monitor.py:104)."""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024**2,
+                 log_to_driver=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_worker_prints_reach_driver_subscription(cluster):
+    records = []
+    head = ray_tpu._head
+    head.gcs.subscribe("LOG", records.append)
+
+    @ray_tpu.remote
+    def noisy():
+        print("hello-from-worker-stdout")
+        import sys
+
+        print("warn-from-worker-stderr", file=sys.stderr)
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        lines = [r["line"] for r in records]
+        if any("hello-from-worker-stdout" in ln for ln in lines) and \
+                any("warn-from-worker-stderr" in ln for ln in lines):
+            break
+        time.sleep(0.2)
+    lines = [r["line"] for r in records]
+    assert any("hello-from-worker-stdout" in ln for ln in lines), lines
+    assert any("warn-from-worker-stderr" in ln for ln in lines), lines
+    streams = {r["stream"] for r in records
+               if "from-worker" in r["line"]}
+    assert streams == {"out", "err"}
+
+
+def test_driver_echo_prefixes(cluster):
+    import io
+
+    from ray_tpu._private.log_monitor import attach_driver_echo
+
+    buf = io.StringIO()
+    head = ray_tpu._head
+    attach_driver_echo(head.gcs, out=buf)
+    head.gcs.publish("LOG", {"source": "abcdef1234567890", "stream": "out",
+                             "line": "probe-line"})
+    assert "(abcdef123456 out) probe-line" in buf.getvalue()

@@ -15,10 +15,14 @@ MB = 1024 * 1024
 def small_store_cluster(monkeypatch):
     # Per-segment store only: the native arena has its own capacity pool and
     # would absorb the first puts, making the pressure pattern nondeterministic.
+    from ray_tpu._private.config import CONFIG
+
     monkeypatch.setenv("RAY_TPU_NATIVE_STORE", "0")
+    CONFIG.reset()  # drop cached flag values so the env override applies
     ray_tpu.init(num_cpus=2, object_store_memory=8 * MB)
     yield
     ray_tpu.shutdown()
+    CONFIG.reset()
 
 
 def test_put_twice_capacity_then_get_all(small_store_cluster):
