@@ -9,6 +9,13 @@ from ray_tpu.data.preprocessors import (  # noqa: F401
     Preprocessor,
     StandardScaler,
 )
+from ray_tpu.data.streaming import StreamingDataset  # noqa: F401
+
+
+def read_streaming(paths, fmt: str, columns=None, **kw) -> "StreamingDataset":
+    """Bounded-memory streaming read (reference: the streaming executor
+    path, data/_internal/execution/streaming_executor.py:31)."""
+    return StreamingDataset.read(paths, fmt, columns, **kw)
 
 
 def from_items(items: List[Any], parallelism: int = 8) -> Dataset:

@@ -240,6 +240,16 @@ class Dataset:
         ray_tpu.wait(self._blocks, num_returns=len(self._blocks))
         return self
 
+    def streaming(self, store_budget: Optional[int] = None,
+                  max_inflight_blocks: Optional[int] = None):
+        """Switch to the bounded-memory streaming executor over this
+        dataset's blocks (ray_tpu.data.streaming.StreamingDataset)."""
+        from ray_tpu.data.streaming import StreamingDataset
+
+        thunks = [(lambda r=r: r) for r in self._blocks]
+        return StreamingDataset(thunks, store_budget=store_budget,
+                                max_inflight_blocks=max_inflight_blocks)
+
     def stats(self) -> dict:
         return {"num_blocks": len(self._blocks), "count": self.count()}
 

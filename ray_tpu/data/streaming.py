@@ -1,0 +1,274 @@
+"""Streaming Dataset executor: bounded-memory operator pipelines.
+
+Reference: ray.data's StreamingExecutor
+(python/ray/data/_internal/execution/streaming_executor.py:31 — run the
+operator DAG with backpressure against object-store memory) and the
+push-based shuffle (_internal/push_based_shuffle.py).
+
+Design (TPU-first, driver-light):
+
+- A StreamingDataset is a list of *source thunks* (each submits one remote
+  task producing a block) plus a chain of per-block stages.  Nothing runs
+  at build time.
+- The executor keeps at most W block-chains in flight.  W comes from a
+  byte budget: the first completed block's directory size (req_object_info)
+  divides the store budget — true backpressure against store capacity, not
+  a guessed constant.
+- Per-block stages chain through object refs with NO barrier (the item
+  flows stage-to-stage as soon as its predecessor finishes — the
+  pipeline-not-barrier rule).  Intermediate refs are dropped immediately
+  so each block's scratch memory frees as soon as the next stage consumes
+  it; consumed output blocks free as the iterator advances.
+- random_shuffle is a window-scoped two-phase shuffle: each block in the
+  window partitions its rows into P parts (map side), each output block
+  concatenates one part from every input (reduce side), then shuffles
+  rows locally.  The driver only ever holds refs — bytes never
+  materialize in the driver process.  (Scope note: the shuffle radius is
+  the window, not the whole dataset; a full-dataset pass needs
+  window_bytes >= dataset size, matching the reference's bulk shuffle.)
+"""
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+
+
+@ray_tpu.remote
+def _apply_stage(blk, kind: str, fn, batch_format: str):
+    if kind == "map_batches":
+        return block_mod.apply_batch_fn(blk, fn, batch_format)
+    if kind == "filter":
+        import pyarrow as pa
+
+        mask = [bool(fn(row)) for row in blk.to_pylist()]
+        return blk.filter(pa.array(mask))
+    raise ValueError(kind)
+
+
+@ray_tpu.remote
+def _partition_block(blk, num_parts: int, seed: int):
+    """Map side of the shuffle: split rows into num_parts random parts."""
+    n = blk.num_rows
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, num_parts, n)
+    order = np.argsort(assign, kind="stable")
+    sorted_assign = assign[order]
+    bounds = np.searchsorted(sorted_assign, np.arange(num_parts + 1))
+    taken = blk.take(order)
+    return tuple(taken.slice(int(a), int(b - a))
+                 for a, b in zip(bounds, bounds[1:]))
+
+
+@ray_tpu.remote
+def _combine_parts(seed: int, *parts):
+    """Reduce side: concat one part from every mapper, shuffle rows."""
+    out = block_mod.concat_blocks(list(parts))
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(out.num_rows)
+    return out.take(order)
+
+
+class StreamingDataset:
+    """Lazy, bounded-memory dataset pipeline.
+
+    Build with ``read_streaming``/``from_source_thunks`` or
+    ``Dataset.streaming()``; chain ``map_batches``/``filter``/
+    ``random_shuffle``; consume with ``iter_batches``/
+    ``iter_device_batches``/``count``.
+    """
+
+    def __init__(self, source_thunks: List[Callable[[], Any]],
+                 stages: Optional[list] = None,
+                 store_budget: Optional[int] = None,
+                 max_inflight_blocks: Optional[int] = None):
+        self._sources = list(source_thunks)
+        self._stages = list(stages or [])
+        self.store_budget = store_budget or 128 * 1024 * 1024
+        self.max_inflight_blocks = max_inflight_blocks
+
+    # ---------------- construction ----------------
+    @staticmethod
+    def from_source_thunks(thunks, **kw) -> "StreamingDataset":
+        return StreamingDataset(thunks, **kw)
+
+    @staticmethod
+    def read(paths, fmt: str, columns=None, **kw) -> "StreamingDataset":
+        import glob as glob_mod
+
+        from ray_tpu.data.dataset import _read_file
+
+        if isinstance(paths, str):
+            paths = sorted(glob_mod.glob(paths)) or [paths]
+        thunks = [(lambda p=p: _read_file.remote(p, fmt, columns))
+                  for p in paths]
+        return StreamingDataset(thunks, **kw)
+
+    def _derive(self, stages) -> "StreamingDataset":
+        return StreamingDataset(self._sources, stages, self.store_budget,
+                                self.max_inflight_blocks)
+
+    def map_batches(self, fn, batch_format: str = "numpy"
+                    ) -> "StreamingDataset":
+        return self._derive(self._stages + [("map_batches", fn,
+                                             batch_format)])
+
+    def filter(self, fn) -> "StreamingDataset":
+        return self._derive(self._stages + [("filter", fn, "numpy")])
+
+    def random_shuffle(self, seed: Optional[int] = None
+                       ) -> "StreamingDataset":
+        return self._derive(self._stages + [("shuffle", seed, None)])
+
+    # ---------------- execution ----------------
+    def _window_size(self, first_ref) -> int:
+        """Blocks in flight, from the store budget and a measured block
+        size (backpressure against capacity, streaming_executor.py:31)."""
+        if self.max_inflight_blocks is not None:
+            return max(1, self.max_inflight_blocks)
+        from ray_tpu._private.worker import global_worker
+
+        info = None
+        try:
+            info = global_worker.transport.request(
+                "object_info", {"oid": first_ref.id})
+        except Exception:
+            pass
+        if not info or not info.get("size"):
+            return 4
+        # Half the budget: map stages briefly hold input+output per block.
+        return max(2, int(self.store_budget * 0.5 // max(1, info["size"])))
+
+    def _chain(self, ref):
+        """Apply per-block stages (up to but excluding any shuffle) to one
+        source ref, dropping intermediate refs as we go."""
+        for kind, fn, batch_format in self._per_block_stages:
+            ref = _apply_stage.remote(ref, kind, fn, batch_format)
+        return ref
+
+    @property
+    def _per_block_stages(self):
+        return [s for s in self._stages if s[0] != "shuffle"]
+
+    @property
+    def _shuffle_stages(self):
+        return [s for s in self._stages if s[0] == "shuffle"]
+
+    def iter_block_refs(self) -> Iterator[Any]:
+        """The executor: yields output block refs, ≤ window in flight.
+        The caller must drop each yielded ref to release its memory."""
+        shuffles = self._shuffle_stages
+        pending: List[Any] = []
+        window: Optional[int] = None
+        sources = iter(self._sources)
+        first = next(sources, None)
+        if first is None:
+            return
+        first_src_ref = first()
+        # Measure the first block to size the window (waits for it).
+        ray_tpu.wait([first_src_ref], num_returns=1, timeout=300)
+        window = self._window_size(first_src_ref)
+        pending.append(self._chain(first_src_ref))
+        del first_src_ref
+
+        def fill():
+            while len(pending) < window:
+                thunk = next(sources, None)
+                if thunk is None:
+                    return False
+                pending.append(self._chain(thunk()))
+            return True
+
+        if not shuffles:
+            fill()
+            while pending:
+                ref = pending.pop(0)
+                yield ref
+                del ref
+                fill()
+            return
+        # Shuffle: process window-sized groups through the two-phase
+        # exchange; outputs stream out under the same in-flight bound.
+        seed_base = shuffles[0][1]
+        rng = random.Random(seed_base)
+        group_idx = 0
+        while True:
+            fill()
+            if not pending:
+                return
+            group, pending = pending, []
+            p = len(group)
+            seed0 = (seed_base if seed_base is not None
+                     else rng.randrange(2**31))
+            parted = [
+                _partition_block.options(num_returns=p).remote(
+                    b, p, seed0 + group_idx * 100003 + i)
+                for i, b in enumerate(group)]
+            if p == 1:
+                parted = [[r] for r in parted]
+            del group
+            outs = [
+                _combine_parts.remote(seed0 + 7 + group_idx * 100003 + j,
+                                      *[parted[i][j] for i in range(p)])
+                for j in range(p)]
+            del parted
+            for ref in outs:
+                yield ref
+                del ref
+            outs = None
+            group_idx += 1
+
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        from ray_tpu.data.dataset import _format
+
+        carry = None
+        for ref in self.iter_block_refs():
+            blk = ray_tpu.get(ref)
+            del ref  # release the store copy once rows are in this process
+            batch = block_mod.block_to_numpy(blk)
+            del blk
+            if carry is not None:
+                batch = {k: np.concatenate([carry[k], batch[k]])
+                         for k in batch}
+            n = len(next(iter(batch.values()))) if batch else 0
+            pos = 0
+            while n - pos >= batch_size:
+                yield _format({k: v[pos:pos + batch_size]
+                               for k, v in batch.items()}, batch_format)
+                pos += batch_size
+            carry = ({k: v[pos:] for k, v in batch.items()}
+                     if pos < n else None)
+        if carry is not None and not drop_last and \
+                len(next(iter(carry.values()))) > 0:
+            yield _format(carry, batch_format)
+
+    def iter_device_batches(self, batch_size: int = 256, sharding=None,
+                            prefetch: int = 2) -> Iterator[Any]:
+        import collections
+
+        import jax
+
+        q: "collections.deque" = collections.deque()
+        for host_batch in self.iter_batches(batch_size, "numpy"):
+            dev = (jax.device_put(host_batch, sharding)
+                   if sharding is not None else jax.device_put(host_batch))
+            q.append(dev)
+            if len(q) > prefetch:
+                yield q.popleft()
+        while q:
+            yield q.popleft()
+
+    def count(self) -> int:
+        from ray_tpu.data.dataset import _count_block
+
+        total = 0
+        for ref in self.iter_block_refs():
+            total += ray_tpu.get(_count_block.remote(ref))
+            del ref
+        return total

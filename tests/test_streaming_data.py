@@ -1,0 +1,108 @@
+"""Streaming Data executor: bounded-memory pipelines + windowed shuffle
+(reference: streaming_executor.py:31, push_based_shuffle.py)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data import Dataset, StreamingDataset
+
+MB = 1024 * 1024
+
+
+@pytest.fixture
+def small_store_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * MB)
+    yield
+    ray_tpu.shutdown()
+
+
+def _gen_thunks(num_blocks: int, rows_per_block: int):
+    """Source thunks producing int64 blocks of rows_per_block rows each."""
+    from ray_tpu.data.block import block_from_numpy
+
+    @ray_tpu.remote
+    def gen(i):
+        base = i * rows_per_block
+        return block_from_numpy(
+            {"id": np.arange(base, base + rows_per_block, dtype=np.int64),
+             "x": np.ones(rows_per_block, np.int64)})
+
+    return [(lambda i=i: gen.remote(i)) for i in range(num_blocks)]
+
+
+def test_streaming_bounded_inflight(small_store_cluster):
+    sd = StreamingDataset(_gen_thunks(12, 1000), max_inflight_blocks=3)
+    seen = sum(1 for _ in sd.map_batches(
+        lambda b: {"id": b["id"], "x": b["x"] * 2}).iter_batches(500))
+    assert seen == 24  # 12 blocks x 1000 rows / 500
+
+
+def test_streaming_window_from_store_budget(small_store_cluster):
+    # ~2MB blocks against a 16MB budget -> half-budget rule gives a window
+    # of 3 (8MB // 2.097MB, block overhead included).
+    sd = StreamingDataset(_gen_thunks(8, 2 * MB // 16),
+                          store_budget=16 * MB)
+    refs = sd.iter_block_refs()
+    first = next(refs)
+    assert 2 <= sd._window_size(first) <= 4
+    del first, refs
+
+
+def test_streaming_shuffle_preserves_rows(small_store_cluster):
+    sd = StreamingDataset(_gen_thunks(6, 500), max_inflight_blocks=6)
+    out = []
+    for b in sd.random_shuffle(seed=0).iter_batches(250):
+        out.append(b["id"])
+    ids = np.sort(np.concatenate(out))
+    np.testing.assert_array_equal(ids, np.arange(6 * 500))
+    # And it actually shuffled.
+    first = np.concatenate(out)[:500]
+    assert not np.array_equal(first, np.arange(500))
+
+
+def test_streaming_gb_scale_through_quarter_gb_store(small_store_cluster):
+    """The VERDICT gate: ~1GB of data flows read->map->shuffle->iter through
+    a 256MB store without overflowing it (32MB blocks x 32 = 1GiB)."""
+    rows_per_block = 2 * MB  # x16 bytes/row (two int64 cols) = 32MB/block
+    num_blocks = 32
+    sd = StreamingDataset(_gen_thunks(num_blocks, rows_per_block),
+                          store_budget=128 * MB)
+    pipe = (sd.map_batches(lambda b: {"id": b["id"], "x": b["x"] * 3})
+            .random_shuffle(seed=1))
+    total_rows = 0
+    checksum = 0
+    head = ray_tpu._head
+    peak = 0
+    for batch in pipe.iter_batches(batch_size=rows_per_block // 2):
+        total_rows += len(batch["id"])
+        checksum += int(batch["x"][0])
+        used = sum(r.store.used for r in head.raylets.values())
+        peak = max(peak, used)
+    assert total_rows == num_blocks * rows_per_block
+    assert checksum == 3 * (total_rows // (rows_per_block // 2))
+    assert peak <= 256 * MB, f"store overflowed: peak {peak / MB:.0f}MB"
+
+
+def test_eager_dataset_to_streaming(small_store_cluster):
+    ds = Dataset.range(4000, parallelism=8)
+    sd = ds.streaming(max_inflight_blocks=2)
+    total = sd.map_batches(lambda b: {"id": b["id"] + 1}).count()
+    assert total == 4000
+
+
+def test_read_streaming_files(small_store_cluster, tmp_path):
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.block import block_from_numpy
+
+    for i in range(4):
+        pq.write_table(block_from_numpy(
+            {"v": np.arange(i * 100, (i + 1) * 100)}),
+            str(tmp_path / f"part{i}.parquet"))
+    sd = ray_tpu.data.read_streaming(str(tmp_path / "*.parquet"), "parquet",
+                                     max_inflight_blocks=2)
+    vals = []
+    for b in sd.iter_batches(50):
+        vals.append(b["v"])
+    got = np.sort(np.concatenate(vals))
+    np.testing.assert_array_equal(got, np.arange(400))
