@@ -24,3 +24,4 @@ from ray_tpu.serve.api import (  # noqa: F401
     start_http_proxy,
 )
 from ray_tpu.serve.autoscaling import calculate_desired_num_replicas  # noqa: F401
+from ray_tpu.serve.batching import batch  # noqa: F401

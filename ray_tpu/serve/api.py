@@ -43,36 +43,51 @@ class _Replica:
 
 
 class DeploymentHandle:
-    """Round-robin router over replica actors with an in-flight cap
-    (reference: Router.assign_replica, serve/_private/router.py:221)."""
+    """Router over a *mutable* replica set: least-loaded assignment with an
+    in-flight cap, live queue metrics for the controller, and dynamic
+    add/remove so autoscaling reconfigures in place (reference:
+    Router/ReplicaSet, serve/_private/router.py:62,221)."""
 
     def __init__(self, name: str, replicas: List[Any],
                  max_in_flight_per_replica: int = 8):
         self.name = name
-        self._replicas = replicas
-        self._rr = itertools.cycle(range(len(replicas)))
-        self._in_flight: Dict[int, int] = {i: 0 for i in range(len(replicas))}
+        self._replicas: List[Any] = list(replicas)
+        self._in_flight: Dict[Any, int] = {r: 0 for r in self._replicas}
+        self._rr = 0
         self._cap = max_in_flight_per_replica
         self._lock = threading.Lock()
 
     def remote(self, *args, _method: str = "__call__", **kwargs):
         with self._lock:
-            for _ in range(len(self._replicas)):
-                i = next(self._rr)
-                if self._in_flight[i] < self._cap:
+            if not self._replicas:
+                raise RuntimeError(f"deployment {self.name} has no replicas")
+            # Round-robin start, pick the first under-cap replica; when all
+            # are saturated take the least loaded (requests queue in the
+            # actor's mailbox — that queue depth is the autoscaling signal).
+            n = len(self._replicas)
+            pick = None
+            for k in range(n):
+                r = self._replicas[(self._rr + k) % n]
+                if self._in_flight[r] < self._cap:
+                    pick = r
                     break
-            self._in_flight[i] += 1
-        ref = self._replicas[i].handle_request.remote(_method, args, kwargs)
+            if pick is None:
+                pick = min(self._replicas, key=lambda r: self._in_flight[r])
+            self._rr = (self._rr + 1) % max(1, n)
+            self._in_flight[pick] += 1
+        ref = pick.handle_request.remote(_method, args, kwargs)
 
         def done(_f):
             with self._lock:
-                self._in_flight[i] -= 1
+                if pick in self._in_flight:
+                    self._in_flight[pick] -= 1
 
         try:
             ref.future().add_done_callback(done)
         except Exception:
             with self._lock:
-                self._in_flight[i] -= 1
+                if pick in self._in_flight:
+                    self._in_flight[pick] -= 1
         return ref
 
     def method(self, name: str):
@@ -84,9 +99,47 @@ class DeploymentHandle:
 
         return _M()
 
+    # ---- controller surface ----
+    def queue_stats(self) -> Dict[str, float]:
+        """Total and per-replica in-flight load (the metric the reference's
+        replicas push to the controller, serve/_private/autoscaling_metrics)."""
+        with self._lock:
+            total = sum(self._in_flight.values())
+            n = max(1, len(self._replicas))
+            return {"total_in_flight": float(total),
+                    "avg_per_replica": total / n,
+                    "num_replicas": len(self._replicas)}
+
+    def add_replica(self, replica):
+        with self._lock:
+            self._replicas.append(replica)
+            self._in_flight[replica] = 0
+
+    def pop_replica(self):
+        """Remove (and return) the least-loaded replica, or None at size 1.
+
+        Routing stops immediately, but the in-flight counter entry is KEPT
+        so outstanding requests keep decrementing it — the controller
+        drains on in_flight_of() before killing, then forget_replica()."""
+        with self._lock:
+            if len(self._replicas) <= 1:
+                return None
+            r = min(self._replicas, key=lambda x: self._in_flight[x])
+            self._replicas.remove(r)
+            return r
+
+    def in_flight_of(self, replica) -> int:
+        with self._lock:
+            return self._in_flight.get(replica, 0)
+
+    def forget_replica(self, replica):
+        with self._lock:
+            self._in_flight.pop(replica, None)
+
     @property
     def num_replicas(self):
-        return len(self._replicas)
+        with self._lock:
+            return len(self._replicas)
 
 
 class Deployment:
@@ -114,26 +167,44 @@ class Deployment:
         import copy
 
         d = copy.copy(self)
+        # The shallow copy must not alias the replica list — a teardown of
+        # one deployment would otherwise kill its siblings' replicas.
+        d._replicas = []
+        d.handle = None
         for k, v in kw.items():
             setattr(d, k, v)
         return d
 
-    # ---- lifecycle (controller-lite reconciliation) ----
-    def _deploy(self) -> DeploymentHandle:
+    # ---- lifecycle ----
+    def _make_replica(self):
         opts = dict(self.ray_actor_options)
         opts.setdefault("max_concurrency", 8)
-        self._replicas = [
-            _Replica.options(**opts).remote(self._func, self._init_args,
+        r = _Replica.options(**opts).remote(self._func, self._init_args,
                                             self._init_kwargs)
-            for _ in range(self.num_replicas)
-        ]
         if self.user_config is not None:
-            ray_tpu.get([r.reconfigure.remote(self.user_config)
-                         for r in self._replicas])
-        self.handle = DeploymentHandle(self.name, self._replicas)
+            ray_tpu.get(r.reconfigure.remote(self.user_config))
+        self._replicas.append(r)
+        return r
+
+    def _deploy(self) -> DeploymentHandle:
+        self._replicas = []
+        start = self.num_replicas
+        if self.autoscaling_config:
+            start = max(int(self.autoscaling_config.get("min_replicas", 1)),
+                        min(start, int(self.autoscaling_config.get(
+                            "max_replicas", start))))
+        replicas = [self._make_replica() for _ in range(start)]
+        self.handle = DeploymentHandle(self.name, replicas)
+        if self.autoscaling_config:
+            from ray_tpu.serve.controller import get_controller
+
+            get_controller().watch(self)
         return self.handle
 
     def _teardown(self):
+        from ray_tpu.serve.controller import get_controller
+
+        get_controller().unwatch(self)
         for r in self._replicas:
             try:
                 ray_tpu.kill(r)
@@ -186,6 +257,9 @@ def shutdown():
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
+    from ray_tpu.serve.controller import reset_controller
+
+    reset_controller()
 
 
 class _HttpProxy:

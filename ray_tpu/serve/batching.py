@@ -1,0 +1,126 @@
+"""@serve.batch — adaptive request batching inside a replica.
+
+Reference: python/ray/serve/batching.py (@serve.batch collects concurrent
+calls into one invocation of the underlying function).  TPU-critical: a
+replica hosting a pjit-compiled model turns N concurrent single requests
+into ONE batched device call, which is the only way the MXU sees a real
+batch dimension from a request/response workload.
+
+Mechanics: requests enqueue (item, Future) and block on the future; a
+lazily-started batcher thread drains the queue — first item blocking, then
+up to max_batch_size or until batch_wait_timeout_s passes — and calls the
+wrapped function once with the list of items, distributing results (or the
+exception) back.  Works on plain functions and methods (descriptor
+protocol keeps one batcher per bound instance).
+"""
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="rtpu-serve-batcher", daemon=True)
+                self._thread.start()
+
+    def submit(self, item) -> Any:
+        fut: Future = Future()
+        self._queue.put((item, fut))
+        self._ensure_thread()
+        return fut.result()
+
+    def _loop(self):
+        import time
+
+        while True:
+            item, fut = self._queue.get()
+            batch = [(item, fut)]
+            deadline = time.monotonic() + self.batch_wait_timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            items = [b[0] for b in batch]
+            try:
+                results = self.fn(items)
+                if results is None or len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function must return one result per "
+                        f"input ({len(items)} in, "
+                        f"{None if results is None else len(results)} out)")
+                for (_, f), r in zip(batch, results):
+                    f.set_result(r)
+            except BaseException as e:  # noqa: BLE001 — delivered to callers
+                for _, f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+class _BatchDescriptor:
+    """Function/method wrapper installing per-instance batchers."""
+
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        self._free_batcher: Optional[_Batcher] = None
+        functools.update_wrapper(self, fn)
+
+    # plain-function use
+    def __call__(self, item):
+        if self._free_batcher is None:
+            self._free_batcher = _Batcher(self._fn, self._max, self._wait)
+        return self._free_batcher.submit(item)
+
+    # method use: one batcher per instance, created on first access
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        attr = "__rtpu_batcher_" + self._fn.__name__
+        batcher = getattr(obj, attr, None)
+        if batcher is None:
+            bound = self._fn.__get__(obj, objtype)
+            batcher = _Batcher(bound, self._max, self._wait)
+            try:
+                object.__setattr__(obj, attr, batcher)
+            except AttributeError:
+                pass  # __slots__: fall back to a fresh batcher per access
+
+        def call(item):
+            return batcher.submit(item)
+
+        functools.update_wrapper(call, self._fn)
+        return call
+
+
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: fn(list_of_items) -> list_of_results, called with
+    auto-collected batches of concurrent single-item requests."""
+
+    def deco(fn):
+        return _BatchDescriptor(fn, max_batch_size, batch_wait_timeout_s)
+
+    if _func is not None:
+        return deco(_func)
+    return deco

@@ -1,0 +1,120 @@
+"""Serve control plane: autoscaling reconciliation + adaptive batching
+(reference: serve/_private/autoscaling_policy.py:10-49 applied by the
+controller's DeploymentState loop; serve/batching.py)."""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SERVE_CONTROL_INTERVAL_S", "0.2")
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.serve.controller import reset_controller
+
+    CONFIG.reset()  # drop cached flag values so the env override applies
+    reset_controller()
+    ray_tpu.init(num_cpus=8, object_store_memory=128 * 1024**2)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_autoscales_up_under_load_and_back_down(cluster):
+    @serve.deployment(name="slow", autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_num_ongoing_requests_per_replica": 1.0,
+        "look_back_polls": 1})
+    def slow(x):
+        time.sleep(0.4)
+        return x
+
+    handle = serve.run(slow.bind())
+    assert handle.num_replicas == 1
+    # Sustained load: keep ~8 requests in flight for a few seconds.
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            try:
+                ray_tpu.get(handle.remote(1), timeout=30)
+            except Exception:
+                return
+
+    threads = [threading.Thread(target=pound, daemon=True) for _ in range(8)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and handle.num_replicas < 2:
+        time.sleep(0.2)
+    scaled_up = handle.num_replicas
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert scaled_up >= 2, "controller never scaled up under load"
+    # Idle: scale back down to min_replicas.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and handle.num_replicas > 1:
+        time.sleep(0.2)
+    assert handle.num_replicas == 1, "controller never scaled back down"
+
+
+def test_adaptive_batching_groups_concurrent_requests(cluster):
+    class Model:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def predict(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 2 for x in items]
+
+        def __call__(self, x):
+            return self.predict(x)
+
+        def seen(self, _=None):
+            return list(self.batch_sizes)
+
+    dep = serve.deployment(Model, name="batched")
+    handle = serve.run(dep.bind())
+    refs = [handle.remote(i) for i in range(8)]
+    out = sorted(ray_tpu.get(refs, timeout=60))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+    sizes = ray_tpu.get(handle.method("seen").remote(), timeout=30)
+    assert max(sizes) > 1, f"requests were never batched: {sizes}"
+
+
+def test_batch_decorator_plain_function():
+    calls = []
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.1)
+    def double(items):
+        calls.append(len(items))
+        return [x * 2 for x in items]
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(8) as pool:
+        out = sorted(pool.map(double, range(8)))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+    assert max(calls) > 1
+
+
+def test_options_copies_do_not_share_replicas(cluster):
+    """Deployment.options() must not alias the replica list: tearing one
+    deployment down would otherwise kill its sibling's replicas."""
+    @serve.deployment
+    def model(x):
+        return x * 2
+
+    a = serve.run(model.options(), name="opt_a")
+    b = serve.run(model.options(), name="opt_b")
+    assert ray_tpu.get(a.remote(2)) == 4
+    assert ray_tpu.get(b.remote(3)) == 6
+    serve.delete("opt_a")
+    # b's replicas must still be alive and serving.
+    assert ray_tpu.get(b.remote(5)) == 10
