@@ -1,0 +1,142 @@
+"""Core microbenchmarks (reference: python/ray/_private/ray_perf.py:93 and
+release/microbenchmark/ — tasks/s, actor calls/s, put/get throughput).
+
+Run:  python perf.py [--out PERF.json]
+Emits one JSON object with every metric; the reference's published envelope
+(release/benchmarks/README.md:5-31) is the comparison bar.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+MB = 1024 * 1024
+
+
+def timed(n, fn):
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    return n / dt, dt
+
+
+def bench_tasks(ray_tpu, n=200):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote())  # warm the worker pool
+
+    def run():
+        ray_tpu.get([nop.remote() for _ in range(n)])
+
+    return timed(n, run)
+
+
+def bench_actor_calls(ray_tpu, n=500):
+    @ray_tpu.remote
+    class A:
+        def nop(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.nop.remote())
+
+    def run():
+        ray_tpu.get([a.nop.remote() for _ in range(n)])
+
+    return timed(n, run)
+
+
+def bench_actor_calls_async(ray_tpu, n=500):
+    """Pipelined submission depth via max_concurrency (the reference's
+    '1:1 async actor calls' workload)."""
+    @ray_tpu.remote
+    class A:
+        def nop(self):
+            return None
+
+    a = A.options(max_concurrency=8).remote()
+    ray_tpu.get(a.nop.remote())
+
+    def run():
+        ray_tpu.get([a.nop.remote() for _ in range(n)])
+
+    return timed(n, run)
+
+
+def bench_put_gbps(ray_tpu, size=64 * MB, n=8):
+    data = np.random.randint(0, 255, size, dtype=np.uint8)
+
+    def run():
+        refs = [ray_tpu.put(data) for _ in range(n)]
+        del refs
+
+    rate, dt = timed(n, run)
+    return n * size / dt / 1e9, dt
+
+
+def bench_get_gbps(ray_tpu, size=64 * MB, n=8):
+    data = np.random.randint(0, 255, size, dtype=np.uint8)
+    refs = [ray_tpu.put(data) for _ in range(n)]
+    # Drop the driver-side value cache so get() actually resolves.
+    from ray_tpu._private.worker import global_worker
+
+    def run():
+        for r in refs:
+            global_worker._value_cache.clear()
+            ray_tpu.get(r)
+
+    rate, dt = timed(n, run)
+    return n * size / dt / 1e9, dt
+
+
+def bench_put_small(ray_tpu, n=2000):
+    def run():
+        for i in range(n):
+            ray_tpu.put(i)
+
+    return timed(n, run)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None)
+    p.add_argument("--native-arena", default="1",
+                   help="RAY_TPU_NATIVE_STORE value (1=arena, 0=segments)")
+    args = p.parse_args()
+    import os
+
+    os.environ["RAY_TPU_NATIVE_STORE"] = args.native_arena
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=1024 * MB)
+    out = {}
+    try:
+        out["tasks_per_s"], _ = bench_tasks(ray_tpu)
+        out["actor_calls_per_s"], _ = bench_actor_calls(ray_tpu)
+        out["async_actor_calls_per_s"], _ = bench_actor_calls_async(ray_tpu)
+        out["put_small_per_s"], _ = bench_put_small(ray_tpu)
+        out["put_gb_per_s"], _ = bench_put_gbps(ray_tpu)
+        out["get_gb_per_s"], _ = bench_get_gbps(ray_tpu)
+        out = {k: round(v, 2) for k, v in out.items()}
+        out["store"] = "arena" if args.native_arena == "1" else "segments"
+        # Reference envelope for eyeballing (single node, release/
+        # benchmarks/README.md: cluster-wide numbers; ray_perf.py runs
+        # are per-process like these).
+        out["reference_note"] = (
+            "ray_perf.py-style single-process workloads; reference "
+            "envelope: ~1k-10k tasks/s, ~5-10k actor calls/s per core, "
+            "plasma put/get multiple GB/s")
+    finally:
+        ray_tpu.shutdown()
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -159,7 +159,10 @@ def pack_into(serialized: SerializedObject, dest: memoryview) -> bytes:
     meta = pickle.dumps({"inband_len": len(serialized.inband), "buffers": offsets})
     dest[: len(serialized.inband)] = serialized.inband
     for b, (off, n) in zip(serialized.buffers, offsets):
-        dest[off : off + n] = memoryview(b).cast("B")
+        # numpy's copy is a real memcpy; CPython's memoryview slice
+        # assignment takes a bytewise path ~4x slower on large buffers.
+        np.frombuffer(dest[off:off + n], np.uint8)[:] = np.frombuffer(
+            memoryview(b).cast("B"), np.uint8)
     return meta
 
 
