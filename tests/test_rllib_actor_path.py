@@ -14,6 +14,31 @@ def ray_cluster():
     ray_tpu.shutdown()
 
 
+def test_ppo_actor_mode_learns_cartpole(ray_cluster):
+    """Learning gate for the reference-shaped path (reference pattern:
+    per-algorithm learning tests with a reward floor,
+    rllib/utils/test_utils.py:57 — CartPole floor 100)."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                      rollout_fragment_length=128)
+            .training(num_sgd_iter=6, sgd_minibatch_size=256, lr=3e-4,
+                      entropy_coeff=0.0)
+            .build())
+    best = 0.0
+    for _ in range(40):
+        m = algo.train()
+        r = m.get("episode_reward_mean", 0.0)
+        if r == r:
+            best = max(best, r)
+        if best >= 100.0:
+            break
+    algo.stop()
+    assert best >= 100.0, f"actor-path PPO failed to learn: best={best}"
+
+
 def test_ppo_actor_mode_runs(ray_cluster):
     from ray_tpu.rllib import PPOConfig
 
