@@ -1,0 +1,250 @@
+"""Cluster dashboard: an HTTP server over the state API, metrics, logs,
+and job submission.
+
+Reference: dashboard/head.py (the aiohttp DashboardHead hosting module
+routes) + dashboard/modules/{node,actor,job,metrics}. The TPU redesign
+collapses the reference's multi-process dashboard (head process + per-node
+agents + grpc datapath) into one stdlib ThreadingHTTPServer embedded in
+the head process: the head already holds cluster state in-process, so
+routes read it directly instead of fanning out RPCs.
+
+Routes (JSON unless noted):
+  GET  /api/cluster            — total + available resources, node count
+  GET  /api/nodes|actors|tasks|objects|jobs|named_actors
+  GET  /api/summary            — task/actor/object rollups
+  GET  /api/logs               — index of worker/job log files
+  GET  /api/logs/<name>        — tail of one log file (text; ?lines=N)
+  GET  /metrics                — Prometheus text (user + runtime metrics)
+  GET  /                       — minimal human-readable HTML overview
+  POST /api/jobs               — submit {entrypoint, ...} (job_submission)
+  GET  /api/jobs/<id>          — job status
+  POST /api/jobs/<id>/stop     — request stop
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+_dashboard: Optional["Dashboard"] = None
+
+
+class Dashboard:
+    def __init__(self, head, host: str = "127.0.0.1", port: int = 0):
+        self.head = head
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # stdlib logs every request to stderr by default — silence.
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def _send(self, code: int, body, ctype="application/json"):
+                if isinstance(body, (dict, list)):
+                    body = json.dumps(body, default=str)
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    dash._route_get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(n) if n else b"{}"
+                    dash._route_post(self, json.loads(raw or b"{}"))
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="rtpu-dashboard", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ---------------- routing ----------------
+    def _state(self, what: str):
+        out = []
+        self.head.req_state({"what": what}, out.append, None)
+        return out[0]
+
+    def _route_get(self, req):
+        parsed = urlparse(req.path)
+        path, q = parsed.path.rstrip("/") or "/", parse_qs(parsed.query)
+        if path == "/":
+            return req._send(200, self._overview_html(), "text/html")
+        if path == "/metrics":
+            from ray_tpu.util.metrics import prometheus_text
+
+            return req._send(200, prometheus_text(), "text/plain")
+        if path == "/api/cluster":
+            total, avail = [], []
+            self.head.req_cluster_resources({}, total.append, None)
+            self.head.req_cluster_resources({"available": True},
+                                            avail.append, None)
+            return req._send(200, {
+                "resources_total": total[0],
+                "resources_available": avail[0],
+                "num_nodes": len(self._state("nodes")),
+            })
+        if path == "/api/summary":
+            tasks = self._state("tasks")
+            actors = self._state("actors")
+            objs = self._state("objects")
+            by_status: dict = {}
+            for t in tasks:
+                by_status[t["status"]] = by_status.get(t["status"], 0) + 1
+            by_state: dict = {}
+            for a in actors:
+                by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+            return req._send(200, {
+                "tasks": {"total": len(tasks), "by_status": by_status},
+                "actors": {"total": len(actors), "by_state": by_state},
+                "objects": {"total": len(objs),
+                            "total_bytes": sum(o["size"] for o in objs)},
+            })
+        if path in ("/api/nodes", "/api/actors", "/api/tasks",
+                    "/api/objects", "/api/jobs", "/api/named_actors"):
+            what = path.rsplit("/", 1)[1]
+            if what == "jobs":
+                from ray_tpu.job_submission import _manager
+
+                mgr = _manager(self.head, create=False)
+                listed = self._state("jobs")
+                if mgr is not None:
+                    known = {j["job_id"] for j in listed}
+                    listed += [j for j in mgr.list_jobs()
+                               if j["job_id"] not in known]
+                return req._send(200, listed)
+            return req._send(200, self._state(what))
+        if path == "/api/logs":
+            return req._send(200, self._log_index())
+        if path.startswith("/api/logs/"):
+            name = os.path.basename(path[len("/api/logs/"):])
+            lines = int(q.get("lines", ["200"])[0])
+            logs_dir = os.path.join(self.head.session_dir, "logs")
+            fp = os.path.join(logs_dir, name)
+            if not os.path.exists(fp):
+                return req._send(404, {"error": f"no such log: {name}"})
+            return req._send(200, _tail(fp, lines), "text/plain")
+        if path.startswith("/api/jobs/"):
+            from ray_tpu.job_submission import _manager
+
+            job_id = path.split("/")[3]
+            mgr = _manager(self.head, create=False)
+            info = mgr.get_job(job_id) if mgr else None
+            if info is None:
+                return req._send(404, {"error": f"no such job: {job_id}"})
+            if path.endswith("/logs"):
+                return req._send(200, mgr.get_logs(job_id), "text/plain")
+            return req._send(200, info)
+        return req._send(404, {"error": f"no route: {path}"})
+
+    def _route_post(self, req, payload):
+        path = urlparse(req.path).path.rstrip("/")
+        from ray_tpu.job_submission import _manager
+
+        if path == "/api/jobs":
+            mgr = _manager(self.head, create=True)
+            job_id = mgr.submit(
+                payload["entrypoint"],
+                submission_id=payload.get("submission_id"),
+                runtime_env=payload.get("runtime_env"),
+                metadata=payload.get("metadata"))
+            return req._send(200, {"job_id": job_id})
+        if path.startswith("/api/jobs/") and path.endswith("/stop"):
+            job_id = path.split("/")[3]
+            mgr = _manager(self.head, create=True)
+            ok = mgr.stop(job_id)
+            return req._send(200, {"stopped": ok})
+        return req._send(404, {"error": f"no route: {path}"})
+
+    # ---------------- views ----------------
+    def _log_index(self):
+        logs_dir = os.path.join(self.head.session_dir, "logs")
+        if not os.path.isdir(logs_dir):
+            return []
+        out = []
+        for name in sorted(os.listdir(logs_dir)):
+            fp = os.path.join(logs_dir, name)
+            out.append({"name": name, "size": os.path.getsize(fp)})
+        return out
+
+    def _overview_html(self) -> str:
+        total, avail = [], []
+        self.head.req_cluster_resources({}, total.append, None)
+        self.head.req_cluster_resources({"available": True}, avail.append,
+                                        None)
+        nodes = self._state("nodes")
+        actors = self._state("actors")
+        buf = io.StringIO()
+        buf.write("<html><head><title>ray_tpu dashboard</title></head>"
+                  "<body style='font-family:monospace'>")
+        buf.write("<h2>ray_tpu cluster</h2>")
+        buf.write(f"<p>nodes: {len(nodes)} &middot; actors: {len(actors)}"
+                  "</p><h3>resources</h3><table border=1 cellpadding=4>"
+                  "<tr><th>resource</th><th>available</th><th>total</th>"
+                  "</tr>")
+        for k, v in sorted(total[0].items()):
+            buf.write(f"<tr><td>{k}</td><td>{avail[0].get(k, 0):g}</td>"
+                      f"<td>{v:g}</td></tr>")
+        buf.write("</table><p>JSON API: /api/cluster /api/nodes /api/actors "
+                  "/api/tasks /api/objects /api/jobs /api/summary /api/logs "
+                  "/metrics</p></body></html>")
+        return buf.getvalue()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _tail(path: str, lines: int) -> str:
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - 256 * 1024))
+        data = f.read().decode(errors="replace")
+    return "\n".join(data.splitlines()[-lines:])
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    """Start the dashboard against the in-process head (requires
+    ray_tpu.init() to have booted a local head)."""
+    global _dashboard
+    import ray_tpu
+
+    if _dashboard is not None:
+        return _dashboard
+    head = ray_tpu._head
+    if head is None:
+        raise RuntimeError("start_dashboard() requires a local head; call "
+                           "ray_tpu.init() first")
+    _dashboard = Dashboard(head, host, port)
+    return _dashboard
+
+
+def stop_dashboard():
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

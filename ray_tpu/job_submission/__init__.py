@@ -1,0 +1,222 @@
+"""Job submission: run driver scripts against the cluster and track them.
+
+Reference: dashboard/modules/job/job_manager.py:490 (JobManager driving
+entrypoint subprocesses with status + log capture) and
+python/ray/dashboard/modules/job/sdk.py (JobSubmissionClient over the
+dashboard's REST API).  Same split here: a ``JobManager`` embedded in the
+head process spawns ``sh -c entrypoint`` subprocesses whose env carries
+the head's TCP address + authkey (so the entrypoint's
+``ray_tpu.init(address=...)`` joins this cluster), logs go to the session
+log dir (tailed by the dashboard), and ``JobSubmissionClient`` talks
+either directly to the in-process manager or over HTTP to a remote
+dashboard.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+from urllib.request import Request, urlopen
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class JobManager:
+    def __init__(self, head):
+        self.head = head
+        self._jobs: Dict[str, dict] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self.logs_dir = os.path.join(head.session_dir, "logs")
+        os.makedirs(self.logs_dir, exist_ok=True)
+
+    def submit(self, entrypoint: str, submission_id: Optional[str] = None,
+               runtime_env: Optional[dict] = None,
+               metadata: Optional[dict] = None) -> str:
+        job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id} already exists")
+            self._jobs[job_id] = {
+                "job_id": job_id, "entrypoint": entrypoint,
+                "status": JobStatus.PENDING, "metadata": metadata or {},
+                "start_time": time.time(), "end_time": None,
+                "message": "", "log_file": f"job-{job_id}.log",
+            }
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = f"127.0.0.1:{self.head.tcp_port}"
+        env["RAY_TPU_AUTHKEY"] = self.head.authkey.hex()
+        env["RAY_TPU_JOB_ID"] = job_id
+        for k, v in ((runtime_env or {}).get("env_vars") or {}).items():
+            env[k] = str(v)
+        log_path = os.path.join(self.logs_dir, f"job-{job_id}.log")
+        log_f = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                ["/bin/sh", "-c", entrypoint],
+                env=env,
+                cwd=(runtime_env or {}).get("working_dir") or os.getcwd(),
+                stdout=log_f, stderr=subprocess.STDOUT)
+        except OSError as e:
+            with self._lock:
+                self._jobs[job_id].update(status=JobStatus.FAILED,
+                                          message=str(e),
+                                          end_time=time.time())
+            return job_id
+        finally:
+            log_f.close()
+        with self._lock:
+            self._jobs[job_id]["status"] = JobStatus.RUNNING
+            self._procs[job_id] = proc
+        threading.Thread(target=self._wait, args=(job_id, proc),
+                         name=f"rtpu-job-{job_id}", daemon=True).start()
+        return job_id
+
+    def _wait(self, job_id: str, proc: subprocess.Popen):
+        rc = proc.wait()
+        with self._lock:
+            info = self._jobs[job_id]
+            if info["status"] == JobStatus.STOPPED:
+                pass  # stop() already finalized
+            elif rc == 0:
+                info["status"] = JobStatus.SUCCEEDED
+            else:
+                info["status"] = JobStatus.FAILED
+                info["message"] = f"entrypoint exited with code {rc}"
+            info["end_time"] = time.time()
+            self._procs.pop(job_id, None)
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(job_id)
+            if proc is None:
+                return False
+            self._jobs[job_id]["status"] = JobStatus.STOPPED
+            self._jobs[job_id]["message"] = "stopped by user"
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return True
+
+    def get_job(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            return dict(info) if info else None
+
+    def list_jobs(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._jobs.values()]
+
+    def get_logs(self, job_id: str) -> str:
+        info = self.get_job(job_id)
+        if info is None:
+            return ""
+        path = os.path.join(self.logs_dir, info["log_file"])
+        if not os.path.exists(path):
+            return ""
+        with open(path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+
+def _manager(head, create: bool = True) -> Optional[JobManager]:
+    """The per-head JobManager singleton (attached lazily)."""
+    mgr = getattr(head, "_job_manager", None)
+    if mgr is None and create:
+        mgr = JobManager(head)
+        head._job_manager = mgr
+    return mgr
+
+
+class JobSubmissionClient:
+    """Submit/inspect jobs. ``address=None`` drives the in-process head;
+    ``address="http://host:port"`` talks to a remote dashboard's REST API
+    (reference: job sdk over the dashboard agent)."""
+
+    def __init__(self, address: Optional[str] = None):
+        self.address = address.rstrip("/") if address else None
+        if self.address is None:
+            import ray_tpu
+
+            if ray_tpu._head is None:
+                raise RuntimeError(
+                    "JobSubmissionClient() without address requires a local "
+                    "head; call ray_tpu.init() or pass the dashboard URL")
+            self._mgr = _manager(ray_tpu._head)
+
+    def _http(self, method: str, path: str, payload: Optional[dict] = None):
+        data = json.dumps(payload or {}).encode() if method == "POST" else None
+        req = Request(self.address + path, data=data, method=method,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=30) as resp:
+            body = resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+        return json.loads(body) if "json" in ctype else body.decode()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        if self.address:
+            return self._http("POST", "/api/jobs", {
+                "entrypoint": entrypoint, "submission_id": submission_id,
+                "runtime_env": runtime_env, "metadata": metadata,
+            })["job_id"]
+        return self._mgr.submit(entrypoint, submission_id, runtime_env,
+                                metadata)
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        if self.address:
+            return self._http("GET", f"/api/jobs/{job_id}")
+        info = self._mgr.get_job(job_id)
+        if info is None:
+            raise ValueError(f"no such job: {job_id}")
+        return info
+
+    def get_job_logs(self, job_id: str) -> str:
+        if self.address:
+            return self._http("GET", f"/api/jobs/{job_id}/logs")
+        return self._mgr.get_logs(job_id)
+
+    def list_jobs(self) -> List[dict]:
+        if self.address:
+            return self._http("GET", "/api/jobs")
+        return self._mgr.list_jobs()
+
+    def stop_job(self, job_id: str) -> bool:
+        if self.address:
+            return self._http("POST", f"/api/jobs/{job_id}/stop")["stopped"]
+        return self._mgr.stop(job_id)
+
+    def tail_job_logs(self, job_id: str, timeout: float = 300.0,
+                      poll: float = 0.5):
+        """Generator yielding log increments until the job finishes."""
+        seen = 0
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            logs = self.get_job_logs(job_id)
+            if len(logs) > seen:
+                yield logs[seen:]
+                seen = len(logs)
+            status = self.get_job_status(job_id)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                          JobStatus.STOPPED):
+                rest = self.get_job_logs(job_id)
+                if len(rest) > seen:
+                    yield rest[seen:]
+                return
+            time.sleep(poll)

@@ -1,0 +1,217 @@
+"""``python -m ray_tpu`` command line.
+
+Reference: python/ray/scripts/scripts.py (the ``ray`` click CLI: start,
+stop, status, job submit/status/logs, memory, summary).  Here one argparse
+tree; ``start --head`` runs a persistent head process with its TCP
+listener exposed and writes a connect file other commands read.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+CONNECT_FILE = "/tmp/ray_tpu_head.json"
+
+
+def _write_connect_file(head, dashboard_url=None):
+    info = {"address": f"127.0.0.1:{head.tcp_port}",
+            "authkey": head.authkey.hex(),
+            "session_dir": head.session_dir,
+            "dashboard_url": dashboard_url,
+            "pid": os.getpid()}
+    with open(CONNECT_FILE, "w") as f:
+        json.dump(info, f)
+    return info
+
+
+def _read_connect_file():
+    try:
+        with open(CONNECT_FILE) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        print(f"no running head (connect file {CONNECT_FILE} missing); "
+              "start one with: python -m ray_tpu start --head",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def _connect():
+    import ray_tpu
+
+    info = _read_connect_file()
+    os.environ.setdefault("RAY_TPU_AUTHKEY", info["authkey"])
+    ray_tpu.init(address=info["address"])
+    return info
+
+
+def cmd_start(args):
+    import ray_tpu
+
+    if not args.head:
+        print("worker-node join runs via the node agent: "
+              "python -m ray_tpu._private.node_agent --address host:port",
+              file=sys.stderr)
+        return 1
+    os.environ.setdefault("RAY_TPU_TCP_HOST", args.host)
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                 object_store_memory=args.object_store_memory)
+    url = None
+    if args.dashboard:
+        from ray_tpu.dashboard import start_dashboard
+
+        url = start_dashboard(port=args.dashboard_port).url
+    info = _write_connect_file(ray_tpu._head, url)
+    print(json.dumps(info))
+    print(f"head up at {info['address']}"
+          + (f", dashboard at {url}" if url else ""), file=sys.stderr)
+    if args.block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            ray_tpu.shutdown()
+    return 0
+
+
+def cmd_status(args):
+    import ray_tpu
+    from ray_tpu import state
+
+    _connect()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    nodes = state.list_nodes()
+    print(f"nodes: {len(nodes)}")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0):g}/{total[k]:g}")
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_summary(args):
+    from ray_tpu import state
+    import ray_tpu
+
+    _connect()
+    print(json.dumps({"tasks": state.summarize_tasks(),
+                      "actors": state.summarize_actors(),
+                      "objects": state.summarize_objects()}, indent=2))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_memory(args):
+    from ray_tpu import state
+    import ray_tpu
+
+    _connect()
+    objs = state.list_objects()
+    objs.sort(key=lambda o: -o["size"])
+    for o in objs[:args.limit]:
+        print(f"{o['object_id'][:16]:>18} {o['size']:>12} {o.get('status', '')}")
+    print(f"total: {len(objs)} objects, "
+          f"{sum(o['size'] for o in objs)} bytes")
+    ray_tpu.shutdown()
+    return 0
+
+
+def _job_client():
+    info = _read_connect_file()
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    if not info.get("dashboard_url"):
+        print("job commands need the head started with --dashboard",
+              file=sys.stderr)
+        sys.exit(1)
+    return JobSubmissionClient(info["dashboard_url"])
+
+
+def cmd_job(args):
+    client = _job_client()
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+        print(job_id)
+        if args.wait:
+            for chunk in client.tail_job_logs(job_id):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+            print(f"status: {client.get_job_status(job_id)}", file=sys.stderr)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j['job_id']}  {j['status']:>10}  {j['entrypoint']}")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+    return 0
+
+
+def cmd_stop(args):
+    import signal
+
+    info = _read_connect_file()
+    try:
+        os.kill(info["pid"], signal.SIGINT)
+        print(f"sent SIGINT to head pid {info['pid']}")
+    except ProcessLookupError:
+        print("head already gone")
+    try:
+        os.unlink(CONNECT_FILE)
+    except FileNotFoundError:
+        pass
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start a cluster head")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--num-cpus", type=float, default=None)
+    s.add_argument("--num-tpus", type=float, default=None)
+    s.add_argument("--object-store-memory", type=int, default=2 * 1024**3)
+    s.add_argument("--dashboard", action="store_true")
+    s.add_argument("--dashboard-port", type=int, default=0)
+    s.add_argument("--block", action="store_true", default=True)
+    s.add_argument("--no-block", dest="block", action="store_false")
+    s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("status", help="cluster resources")
+    s.set_defaults(fn=cmd_status)
+    s = sub.add_parser("summary", help="task/actor/object summary")
+    s.set_defaults(fn=cmd_summary)
+    s = sub.add_parser("memory", help="object store contents")
+    s.add_argument("--limit", type=int, default=20)
+    s.set_defaults(fn=cmd_memory)
+    s = sub.add_parser("stop", help="stop the head")
+    s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("job", help="job submission")
+    jsub = s.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    js = jsub.add_parser("status")
+    js.add_argument("job_id")
+    js = jsub.add_parser("logs")
+    js.add_argument("job_id")
+    jsub.add_parser("list")
+    js = jsub.add_parser("stop")
+    js.add_argument("job_id")
+    s.set_defaults(fn=cmd_job)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
