@@ -1,0 +1,6 @@
+"""``python -m ray_tpu`` → the cluster CLI (ray_tpu/scripts.py)."""
+import sys
+
+from ray_tpu.scripts import main
+
+sys.exit(main())

@@ -115,11 +115,9 @@ class NodeAgent:
             # CPU-only worker: skip the site hook's eager accelerator
             # registration + jax import (see raylet.spawn_worker).
             env.pop("PALLAS_AXON_POOL_IPS", None)
-        import ray_tpu as _pkg
+        from ray_tpu._private import inject_pkg_pythonpath
 
-        pkg_parent = os.path.dirname(
-            os.path.dirname(os.path.abspath(_pkg.__file__)))
-        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        inject_pkg_pythonpath(env)
         env["RAY_TPU_HEAD_ADDR"] = f"{self.head_addr[0]}:{self.head_addr[1]}"
         env.pop("RAY_TPU_HEAD_SOCKET", None)
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()

@@ -182,12 +182,9 @@ class Raylet:
             # seconds (the worker can still `import jax` lazily on CPU).
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
-        # Ensure workers can import ray_tpu even when the driver added it to
-        # sys.path manually rather than installing the package.
-        import ray_tpu as _pkg
+        from ray_tpu._private import inject_pkg_pythonpath
 
-        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(_pkg.__file__)))
-        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        inject_pkg_pythonpath(env)
         env["RAY_TPU_HEAD_SOCKET"] = self.head.socket_path
         env["RAY_TPU_SESSION_DIR"] = self.head.session_dir
         # Per-worker log files, tailed by the head's LogMonitor and echoed

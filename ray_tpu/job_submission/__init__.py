@@ -54,6 +54,9 @@ class JobManager:
                 "message": "", "log_file": f"job-{job_id}.log",
             }
         env = dict(os.environ)
+        from ray_tpu._private import inject_pkg_pythonpath
+
+        inject_pkg_pythonpath(env)
         env["RAY_TPU_ADDRESS"] = f"127.0.0.1:{self.head.tcp_port}"
         env["RAY_TPU_AUTHKEY"] = self.head.authkey.hex()
         env["RAY_TPU_JOB_ID"] = job_id

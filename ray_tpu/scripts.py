@@ -147,7 +147,10 @@ def cmd_job(args):
         sys.stdout.write(client.get_job_logs(args.job_id))
     elif args.job_cmd == "list":
         for j in client.list_jobs():
-            print(f"{j['job_id']}  {j['status']:>10}  {j['entrypoint']}")
+            # Driver-connected jobs from the GCS table carry no entrypoint;
+            # only submitted jobs do.
+            print(f"{j.get('job_id', '?')}  {j.get('status', ''):>10}  "
+                  f"{j.get('entrypoint', '')}")
     elif args.job_cmd == "stop":
         print(client.stop_job(args.job_id))
     return 0
