@@ -2,9 +2,12 @@
 from ray_tpu.tune.schedulers import (  # noqa: F401
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.search.tpe import Searcher, TPESearch  # noqa: F401
 from ray_tpu.tune.search.sample import (  # noqa: F401
     choice,
     grid_search,

@@ -79,7 +79,9 @@ class TrialRunner:
                  max_concurrent: Optional[int] = None,
                  max_failures: int = 0,
                  stop: Optional[Dict[str, Any]] = None,
-                 metric: Optional[str] = None, mode: str = "max"):
+                 metric: Optional[str] = None, mode: str = "max",
+                 searcher=None, num_samples: int = 0,
+                 on_trial_terminal: Optional[Callable] = None):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or FIFOScheduler()
@@ -88,6 +90,38 @@ class TrialRunner:
         self.stop_criteria = stop or {}
         self.metric = metric
         self.mode = mode
+        # Feedback-driven search: trials are created lazily from
+        # searcher.suggest() as slots free up, so later suggestions see
+        # earlier results (reference: SearchGenerator,
+        # tune/search/search_generator.py).
+        self.searcher = searcher
+        self.num_samples = num_samples
+        self.on_trial_terminal = on_trial_terminal
+
+    def _next_suggested_trial(self) -> Optional[Trial]:
+        if self.searcher is None or self.num_samples <= 0:
+            return None
+        trial_id = f"t{len(self.trials):04d}"
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None:
+            self.num_samples = 0
+            return None
+        self.num_samples -= 1
+        t = Trial(cfg, trial_id=trial_id)
+        self.trials.append(t)
+        return t
+
+    def _notify_terminal(self, trial: Trial):
+        if self.searcher is not None:
+            try:
+                self.searcher.on_trial_complete(trial.id, trial.last_result)
+            except Exception:
+                traceback.print_exc()
+        if self.on_trial_terminal is not None:
+            try:
+                self.on_trial_terminal(trial)
+            except Exception:
+                traceback.print_exc()
 
     # ---- PBT hook ----
     def exploit(self, trial: Trial, source: Trial, new_config: dict):
@@ -123,10 +157,15 @@ class TrialRunner:
             fut = trial.actor.next_result.remote(timeout=600.0)
             active[fut] = (trial, trial.actor)
 
-        while pending or active:
-            while pending and len({t[0].id for t in active.values()}) \
+        while pending or active or (self.searcher and self.num_samples > 0):
+            while len({t[0].id for t in active.values()}) \
                     < self.max_concurrent:
-                t = pending.pop(0)
+                if pending:
+                    t = pending.pop(0)
+                else:
+                    t = self._next_suggested_trial()
+                    if t is None:
+                        break
                 self._launch(t)
                 poll(t)
             if not active:
@@ -162,6 +201,7 @@ class TrialRunner:
                 self.scheduler.on_trial_complete(self, trial,
                                                  trial.last_result)
                 self._stop_actor(trial)
+                self._notify_terminal(trial)
             elif kind == "error":
                 self._on_trial_error(
                     trial, payload if isinstance(payload, BaseException)
@@ -174,6 +214,7 @@ class TrialRunner:
         trial.status = TERMINATED
         self.scheduler.on_trial_complete(self, trial, trial.last_result)
         self._stop_actor(trial)
+        self._notify_terminal(trial)
 
     def _on_trial_error(self, trial: Trial, error: BaseException,
                         pending: List[Trial]):
@@ -185,6 +226,7 @@ class TrialRunner:
         else:
             trial.status = ERROR
             trial.error = error
+            self._notify_terminal(trial)
 
     def _hit_stop_criteria(self, result: Dict[str, Any]) -> bool:
         return any(result.get(k) is not None and result[k] >= v

@@ -68,6 +68,92 @@ class AsyncHyperBandScheduler(TrialScheduler):
         return CONTINUE
 
 
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand: multiple successive-halving brackets with staggered
+    grace periods, so some brackets explore many short trials while others
+    give fewer trials a longer runway (reference:
+    python/ray/tune/schedulers/hyperband.py — realized here as async
+    brackets sharing the ASHA rung rule, the same relaxation the reference
+    recommends via ASHA for distributed use)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "score", mode: str = "max",
+                 max_t: int = 81, reduction_factor: float = 3.0):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # Integer multiply-loop, not int(log/log): float truncation would
+        # drop the deepest bracket exactly when max_t is a power of rf.
+        s_max, t = 0, reduction_factor
+        while t <= max_t:
+            s_max += 1
+            t *= reduction_factor
+        s_max = max(1, s_max)
+        self.brackets = [
+            AsyncHyperBandScheduler(
+                time_attr=time_attr, metric=metric, mode=mode, max_t=max_t,
+                grace_period=max(1, int(reduction_factor ** s)),
+                reduction_factor=reduction_factor)
+            for s in range(s_max)
+        ]
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def _bracket_of(self, trial):
+        b = self._assignment.get(trial.id)
+        if b is None:
+            b = self._next % len(self.brackets)
+            self._assignment[trial.id] = b
+            self._next += 1
+        return self.brackets[b]
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        return self._bracket_of(trial).on_trial_result(runner, trial, result)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' running averages at the same time step (reference:
+    python/ray/tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = "score", mode: str = "max",
+                 grace_period: int = 5, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        # trial.id -> (sum, count) of reported metric values.
+        self._running: Dict[str, tuple] = {}
+
+    def _avg(self, trial_id) -> Optional[float]:
+        s = self._running.get(trial_id)
+        return s[0] / s[1] if s and s[1] else None
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = result.get(self.time_attr)
+        score = result.get(self.metric)
+        if t is None or score is None:
+            return CONTINUE
+        sign = 1.0 if self.mode == "max" else -1.0
+        s, c = self._running.get(trial.id, (0.0, 0))
+        self._running[trial.id] = (s + sign * score, c + 1)
+        if t < self.grace:
+            return CONTINUE
+        others = [self._avg(tr.id) for tr in runner.trials
+                  if tr.id != trial.id]
+        others = [o for o in others if o is not None]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        median = sorted(others)[len(others) // 2]
+        if self._avg(trial.id) < median:
+            return STOP
+        return CONTINUE
+
+
 class PopulationBasedTraining(TrialScheduler):
     """PBT: bottom-quantile trials clone a top trial's checkpoint and mutate
     hyperparameters.  Requires trials to report checkpoints."""

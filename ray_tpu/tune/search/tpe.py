@@ -1,0 +1,222 @@
+"""Native TPE (tree-structured Parzen estimator) searcher — the
+Bayesian-optimization-class search algorithm.
+
+Reference surface: ray.tune.search.Searcher (searcher.py:41, the
+suggest/on_trial_complete contract) and the BayesOpt/HyperOpt wrappers
+(python/ray/tune/search/hyperopt/hyperopt_search.py) — the reference
+delegates the actual model to external libraries; here the estimator is
+implemented directly (numpy only), per Bergstra et al., "Algorithms for
+Hyper-Parameter Optimization" (NeurIPS 2011):
+
+- split observed configs into good/bad by a metric quantile (gamma),
+- model each as a Parzen window (per-dimension KDE / smoothed categorical),
+- sample candidates from the good model l(x) and keep the candidate
+  maximizing l(x)/g(x).
+
+Dimensions are modeled independently (the "tree" is flat here — nested
+search spaces flatten to paths), which matches hyperopt's default.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.search.basic_variant import _set_path, _split_space
+from ray_tpu.tune.search.sample import Choice, Domain, LogUniform, Randint, \
+    Uniform
+
+
+class Searcher:
+    """Feedback-driven config suggestion (reference: searcher.py:41)."""
+
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]):
+        # Fill only what the searcher's constructor left unset — never
+        # clobber an explicit metric/mode (the reference contract refuses
+        # overwrites of already-set properties).
+        if metric and self.metric is None:
+            self.metric = metric
+        if mode and self.mode is None:
+            self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None):
+        pass
+
+    def add_evaluated_point(self, config: Dict[str, Any],
+                            result: Dict[str, Any]):
+        """Feed a finished (config, result) pair from outside the
+        suggest flow — used by experiment resume to re-arm the model."""
+
+
+def _flatten(space: Dict[str, Any]) -> List[Tuple[tuple, Any]]:
+    """Leaves of the search space as (path, domain-or-const); grid axes
+    degrade to categorical choices under TPE.  Built on the same traversal
+    the variant generator uses so the two cannot drift."""
+    out = []
+    for path, (kind, v) in _split_space(space or {}):
+        out.append((path, Choice(v) if kind == "grid" else v))
+    return out
+
+
+class _NumericDim:
+    """Parzen window over a (possibly log- or integer-) numeric domain."""
+
+    def __init__(self, domain: Domain):
+        self.domain = domain
+        if isinstance(domain, LogUniform):
+            self.lo, self.hi, self.log, self.int = domain.lo, domain.hi, \
+                True, False
+        elif isinstance(domain, Uniform):
+            self.lo, self.hi, self.log, self.int = domain.low, domain.high, \
+                False, False
+        elif isinstance(domain, Randint):
+            self.lo, self.hi, self.log, self.int = domain.low, \
+                domain.high - 1, False, True
+        else:
+            raise TypeError(domain)
+
+    def to_unit(self, value: float) -> float:
+        v = math.log(value) if self.log else float(value)
+        return (v - self.lo) / max(self.hi - self.lo, 1e-12)
+
+    def from_unit(self, u: float):
+        v = self.lo + u * (self.hi - self.lo)
+        v = math.exp(v) if self.log else v
+        return int(round(v)) if self.int else v
+
+    def sample_kde(self, rng: np.random.Generator,
+                   obs: np.ndarray, n: int) -> np.ndarray:
+        """Draw from a Parzen window over unit-space observations."""
+        if obs.size == 0:
+            return rng.uniform(0.0, 1.0, size=n)
+        # Scott-ish bandwidth, floored so early rounds keep exploring.
+        bw = max(obs.std() * (obs.size ** -0.2), 0.08)
+        centers = obs[rng.integers(0, obs.size, size=n)]
+        return np.clip(centers + rng.normal(0.0, bw, size=n), 0.0, 1.0)
+
+    @staticmethod
+    def logpdf(x: np.ndarray, obs: np.ndarray) -> np.ndarray:
+        """log Parzen density of x under observations (unit space)."""
+        if obs.size == 0:
+            return np.zeros_like(x)  # uniform on [0,1]
+        bw = max(obs.std() * (obs.size ** -0.2), 0.08)
+        d = (x[:, None] - obs[None, :]) / bw
+        comp = -0.5 * d * d - math.log(bw * math.sqrt(2 * math.pi))
+        return np.logaddexp.reduce(comp, axis=1) - math.log(obs.size)
+
+
+class TPESearch(Searcher):
+    def __init__(self, space: Dict[str, Any],
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 n_initial_points: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        self.metric, self.mode = metric, mode
+        self.gamma = gamma
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self._rng = np.random.default_rng(seed)
+        self._pyrng = random.Random(seed)
+        self._dims: List[Tuple[tuple, Any]] = _flatten(space or {})
+        self._live: Dict[str, Dict[str, Any]] = {}
+        # Completed observations: (flat unit/categorical values, score).
+        self._obs: List[Tuple[Dict[tuple, Any], float]] = []
+
+    # ---- suggest ----
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        flat: Dict[tuple, Any] = {}
+        if len(self._obs) < self.n_initial:
+            for path, dom in self._dims:
+                flat[path] = dom.sample(self._pyrng) \
+                    if isinstance(dom, Domain) else dom
+        else:
+            good, bad = self._split()
+            for path, dom in self._dims:
+                if not isinstance(dom, Domain):
+                    flat[path] = dom
+                elif isinstance(dom, Choice):
+                    flat[path] = self._suggest_choice(dom, path, good, bad)
+                else:
+                    flat[path] = self._suggest_numeric(dom, path, good, bad)
+        self._live[trial_id] = {"flat": flat}
+        cfg: Dict[str, Any] = {}
+        for path, v in flat.items():
+            _set_path(cfg, path, v)
+        return cfg
+
+    def _split(self):
+        scores = np.array([s for _, s in self._obs])
+        n_good = max(1, int(math.ceil(self.gamma * len(scores))))
+        order = np.argsort(-scores)  # maximize internal score
+        good_idx = set(order[:n_good].tolist())
+        good = [self._obs[i][0] for i in range(len(self._obs))
+                if i in good_idx]
+        bad = [self._obs[i][0] for i in range(len(self._obs))
+               if i not in good_idx]
+        return good, bad
+
+    def _suggest_numeric(self, dom, path, good, bad):
+        nd = _NumericDim(dom)
+        g = np.array([nd.to_unit(o[path]) for o in good if path in o])
+        b = np.array([nd.to_unit(o[path]) for o in bad if path in o])
+        cand = nd.sample_kde(self._rng, g, self.n_candidates)
+        ei = nd.logpdf(cand, g) - nd.logpdf(cand, b)
+        return nd.from_unit(float(cand[int(np.argmax(ei))]))
+
+    def _suggest_choice(self, dom: Choice, path, good, bad):
+        cats = dom.categories
+
+        def weights(obs_list):
+            w = np.ones(len(cats))  # Laplace smoothing
+            for o in obs_list:
+                if path in o:
+                    try:
+                        w[cats.index(o[path])] += 1.0
+                    except ValueError:
+                        pass
+            return w / w.sum()
+
+        ratio = weights(good) / weights(bad)
+        cand_idx = self._rng.choice(
+            len(cats), size=min(self.n_candidates, len(cats)),
+            p=weights(good), replace=True)
+        best = max(cand_idx.tolist(), key=lambda i: ratio[i])
+        return cats[best]
+
+    # ---- feedback ----
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None):
+        live = self._live.pop(trial_id, None)
+        if live is None or not result or self.metric not in result:
+            return
+        self._record(live["flat"], float(result[self.metric]))
+
+    def _record(self, flat: Dict[tuple, Any], score: float):
+        if (self.mode or "max") == "min":
+            score = -score
+        self._obs.append((flat, score))
+
+    def add_evaluated_point(self, config: Dict[str, Any],
+                            result: Dict[str, Any]):
+        if not result or self.metric not in result:
+            return
+        flat: Dict[tuple, Any] = {}
+
+        def walk(d, prefix=()):
+            for k, v in d.items():
+                if isinstance(v, dict):
+                    walk(v, prefix + (k,))
+                else:
+                    flat[prefix + (k,)] = v
+
+        walk(config)
+        self._record(flat, float(result[self.metric]))

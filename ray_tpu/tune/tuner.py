@@ -81,10 +81,34 @@ class Tuner:
         self.run_config = run_config
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.tune.search.tpe import Searcher
+
         tc = self.tune_config
-        gen = tc.search_alg or BasicVariantGenerator(
-            self.param_space, tc.num_samples, tc.seed)
-        trials = [Trial(cfg) for cfg in gen]
+        searcher = None
+        num_samples = 0
+        if getattr(self, "_restored_trials", None) is not None:
+            # Experiment resume: finished trials keep their results,
+            # unfinished ones re-enter the pending queue (from their last
+            # checkpoint, if any).
+            trials = self._restored_trials
+            if isinstance(tc.search_alg, Searcher):
+                # Re-arm the searcher: replay finished observations into
+                # its model and restore the remaining suggestion budget.
+                searcher = tc.search_alg
+                searcher.set_search_properties(tc.metric, tc.mode)
+                for t in trials:
+                    if t.status == TERMINATED and t.last_result:
+                        searcher.add_evaluated_point(t.config, t.last_result)
+                num_samples = max(0, tc.num_samples - len(trials))
+        elif isinstance(tc.search_alg, Searcher):
+            searcher = tc.search_alg
+            searcher.set_search_properties(tc.metric, tc.mode)
+            num_samples = tc.num_samples
+            trials = []
+        else:
+            gen = tc.search_alg or BasicVariantGenerator(
+                self.param_space, tc.num_samples, tc.seed)
+            trials = [Trial(cfg) for cfg in gen]
         stop = getattr(self.run_config, "stop", None) if self.run_config else None
         failure = getattr(self.run_config, "failure_config", None) \
             if self.run_config else None
@@ -92,24 +116,91 @@ class Tuner:
             self.trainable, trials, scheduler=tc.scheduler,
             max_concurrent=tc.max_concurrent_trials,
             max_failures=failure.max_failures if failure else 0,
-            stop=stop, metric=tc.metric, mode=tc.mode)
+            stop=stop, metric=tc.metric, mode=tc.mode,
+            searcher=searcher, num_samples=num_samples,
+            on_trial_terminal=lambda _t: self._save_experiment_state(trials))
         runner.run()
-        self._save_experiment_state(trials)
+        self._save_experiment_state(trials, final=True)
         return ResultGrid(trials, tc.metric, tc.mode)
 
-    def _save_experiment_state(self, trials: List[Trial]):
+    # ---- experiment durability (reference: experiment checkpointing +
+    # Tuner.restore, python/ray/tune/impl/tuner_internal.py:227) ----
+    def _experiment_dir(self) -> Optional[str]:
         run = self.run_config
         path = getattr(run, "storage_path", None) if run else None
         if not path:
-            return
+            return None
         name = getattr(run, "name", None) or "experiment"
-        os.makedirs(os.path.join(path, name), exist_ok=True)
-        state = [{
-            "id": t.id, "config": t.config, "status": t.status,
-            "last_result": t.last_result, "error": repr(t.error) if t.error else None,
-        } for t in trials]
-        with open(os.path.join(path, name, "experiment_state.pkl"), "wb") as f:
+        return os.path.join(path, name)
+
+    def _save_experiment_state(self, trials: List[Trial], final: bool = False):
+        exp_dir = self._experiment_dir()
+        if not exp_dir:
+            return
+        os.makedirs(exp_dir, exist_ok=True)
+        state = {
+            "trials": [{
+                "id": t.id, "config": t.config, "status": t.status,
+                "last_result": t.last_result,
+                "metrics_history": t.metrics_history,
+                "checkpoint": t.checkpoint.to_dict() if t.checkpoint else None,
+                "error": repr(t.error) if t.error else None,
+            } for t in trials],
+            "final": final,
+        }
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
             pickle.dump(state, f)
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
+        # The trainable is immutable during a run — serialize it once, not
+        # on every per-trial save (it can close over large objects).
+        tpath = os.path.join(exp_dir, "trainable.pkl")
+        if not os.path.exists(tpath):
+            try:  # rides along so restore() can rebuild alone
+                import cloudpickle
+
+                blob = cloudpickle.dumps(self.trainable)
+                with open(tpath, "wb") as f:
+                    f.write(blob)
+            except Exception:
+                pass  # restore() then requires trainable= to be passed
+
+    @classmethod
+    def restore(cls, path: str, trainable: Optional[Callable] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config=None) -> "Tuner":
+        """Resume an experiment from its storage dir: TERMINATED trials keep
+        their results without re-running; unfinished/errored trials are
+        re-queued from their last checkpoint."""
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        with open(os.path.join(path, "experiment_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        if trainable is None:
+            import cloudpickle
+
+            with open(os.path.join(path, "trainable.pkl"), "rb") as f:
+                trainable = cloudpickle.loads(f.read())
+        if run_config is None:
+            from ray_tpu.air.config import RunConfig
+
+            run_config = RunConfig(storage_path=os.path.dirname(path),
+                                   name=os.path.basename(path))
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=run_config)
+        trials = []
+        for ts in state["trials"]:
+            t = Trial(ts["config"], trial_id=ts["id"])
+            t.last_result = ts["last_result"]
+            t.metrics_history = ts["metrics_history"]
+            if ts["checkpoint"] is not None:
+                t.checkpoint = Checkpoint.from_dict(ts["checkpoint"])
+            if ts["status"] == TERMINATED:
+                t.status = TERMINATED
+            # PENDING is Trial's initial status: RUNNING/ERROR re-queue too.
+            trials.append(t)
+        tuner._restored_trials = trials
+        return tuner
 
 
 def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,

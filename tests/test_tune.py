@@ -122,3 +122,135 @@ def test_tuner_over_trainer(ray_start_regular):
                        tune_config=tune.TuneConfig(metric="value", mode="max"))
     results = tuner.fit()
     assert results.get_best_result().metrics["value"] == 6
+
+
+def test_tpe_searcher_beats_random_on_quadratic(ray_start_regular):
+    """TPE should concentrate samples near the optimum of a smooth 1-D
+    objective once past its random warmup (reference bar: the
+    suggest/observe contract of tune.search.Searcher + hyperopt TPE)."""
+    def objective(config):
+        session.report({"score": -(config["x"] - 2.0) ** 2,
+                        "training_iteration": 1})
+
+    searcher = tune.TPESearch({"x": tune.uniform(-10, 10)},
+                              n_initial_points=8, seed=0)
+    tuner = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=40, search_alg=searcher,
+                                    max_concurrent_trials=1))
+    results = tuner.fit()
+    assert len(results.trials) == 40
+    # The post-warmup suggestions should cluster near x=2: their median
+    # |x-2| must be well under the uniform-random expectation (~5).
+    late = [t.config["x"] for t in results.trials[8:]]
+    errs = sorted(abs(x - 2.0) for x in late)
+    assert errs[len(errs) // 2] < 2.5, f"median err {errs[len(errs)//2]}"
+    assert results.get_best_result().metrics["score"] > -0.5
+
+
+def test_tpe_categorical_and_modes():
+    s = tune.TPESearch({"opt": tune.choice(["good", "bad"]),
+                        "lr": tune.loguniform(1e-5, 1e-1)},
+                       metric="loss", mode="min", n_initial_points=4, seed=1)
+    for i in range(30):
+        cfg = s.suggest(f"t{i}")
+        loss = (0.1 if cfg["opt"] == "good" else 1.0) + abs(
+            __import__("math").log10(cfg["lr"]) + 3) * 0.1
+        s.on_trial_complete(f"t{i}", {"loss": loss})
+    tail = [s.suggest(f"x{i}") for i in range(10)]
+    good_frac = sum(c["opt"] == "good" for c in tail) / 10
+    assert good_frac >= 0.6, f"TPE ignored the categorical signal: {good_frac}"
+
+
+def test_median_stopping_rule_stops_laggard(ray_start_regular):
+    def objective(config):
+        for i in range(20):
+            session.report({"score": config["quality"],
+                            "training_iteration": i + 1})
+
+    sched = tune.MedianStoppingRule(metric="score", mode="max",
+                                    grace_period=3, min_samples_required=2)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"quality": tune.grid_search([1.0, 1.0, 1.0, 0.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=4))
+    results = tuner.fit()
+    laggard = [t for t in results.trials if t.config["quality"] == 0.0][0]
+    assert len(laggard.metrics_history) < 20  # stopped early
+
+
+def test_hyperband_brackets_assign_round_robin(ray_start_regular):
+    sched = tune.HyperBandScheduler(metric="score", mode="max", max_t=9,
+                                    reduction_factor=3.0)
+    assert len(sched.brackets) == 2
+    assert sched.brackets[0].milestones[0] == 1
+    assert sched.brackets[1].milestones[0] == 3
+
+    def objective(config):
+        for i in range(9):
+            session.report({"score": config["q"] * (i + 1),
+                            "training_iteration": i + 1})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([1.0, 0.9, 0.5, 0.1, 0.05, 0.01])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=6))
+    results = tuner.fit()
+    iters = {t.config["q"]: len(t.metrics_history) for t in results.trials}
+    assert iters[1.0] == 9              # a winner survives to max_t
+    assert min(iters.values()) < 9      # some laggard was halved
+
+
+def test_tuner_experiment_resume(ray_start_regular, tmp_path):
+    """Experiment-level durability: a second fit() after a partial run
+    re-runs only unfinished trials and keeps finished results
+    (reference: Tuner.restore, tune/impl/tuner_internal.py:227)."""
+    marker = str(tmp_path / "ran")
+
+    def objective(config):
+        if config["x"] == 99:  # poison trial fails on the first pass
+            import os
+
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("boom")
+        session.report({"score": config["x"], "training_iteration": 1})
+
+    run_cfg = RunConfig(storage_path=str(tmp_path), name="exp")
+    tuner = tune.Tuner(
+        objective, param_space={"x": tune.grid_search([1, 2, 99])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=run_cfg)
+    r1 = tuner.fit()
+    assert len(r1.errors) == 1
+
+    restored = tune.Tuner.restore(str(tmp_path / "exp"))
+    r2 = restored.fit()
+    assert not r2.errors
+    scores = sorted(t.last_result["score"] for t in r2.trials)
+    assert scores == [1, 2, 99]
+    # Finished trials weren't re-run: their single report is intact.
+    assert all(len(t.metrics_history) == 1 for t in r2.trials)
+
+
+def test_searcher_mode_not_clobbered_by_default():
+    """TuneConfig's default mode='max' must not overwrite a searcher's
+    explicit mode='min' (that would anti-optimize silently)."""
+    s = tune.TPESearch({"x": tune.uniform(0, 1)}, metric="loss", mode="min")
+    s.set_search_properties(None, "max")  # what fit() passes by default
+    assert s.mode == "min"
+    s2 = tune.TPESearch({"x": tune.uniform(0, 1)})
+    s2.set_search_properties("score", "max")
+    assert s2.metric == "score" and s2.mode == "max"
+
+
+def test_hyperband_power_of_rf_keeps_deepest_bracket():
+    sched = tune.HyperBandScheduler(metric="s", mode="max", max_t=243,
+                                    reduction_factor=3.0)
+    graces = [b.milestones[0] for b in sched.brackets]
+    assert graces == [1, 3, 9, 27, 81]
