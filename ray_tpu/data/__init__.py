@@ -9,6 +9,8 @@ from ray_tpu.data.preprocessors import (  # noqa: F401
     Preprocessor,
     StandardScaler,
 )
+from ray_tpu.data.datasource import register_datasource  # noqa: F401
+from ray_tpu.data.grouped import GroupedData  # noqa: F401
 from ray_tpu.data.streaming import StreamingDataset  # noqa: F401
 
 
@@ -47,3 +49,24 @@ def read_json(paths) -> Dataset:
 
 def read_numpy(paths) -> Dataset:
     return Dataset.read(paths, "numpy")
+
+
+def read_text(paths) -> Dataset:
+    return Dataset.read(paths, "text")
+
+
+def read_binary_files(paths) -> Dataset:
+    return Dataset.read(paths, "binary")
+
+
+def read_images(paths) -> Dataset:
+    return Dataset.read(paths, "images")
+
+
+def read_tfrecords(paths, columns=None) -> Dataset:
+    return Dataset.read(paths, "tfrecord", columns)
+
+
+def read_datasource(fmt: str, paths, columns=None) -> Dataset:
+    """Read through a registered plugin format (register_datasource)."""
+    return Dataset.read(paths, fmt, columns)

@@ -10,7 +10,6 @@ iter_batches with device prefetch — is complete here.
 """
 from __future__ import annotations
 
-import glob as glob_mod
 from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 import numpy as np
@@ -56,22 +55,10 @@ def _slice_block(blk, start, end):
 
 
 @ray_tpu.remote
-def _read_file(path: str, fmt: str, columns=None):
-    import pyarrow as pa
-    import pyarrow.csv as pcsv
-    import pyarrow.json as pjson
-    import pyarrow.parquet as pq
-
-    if fmt == "parquet":
-        return pq.read_table(path, columns=columns)
-    if fmt == "csv":
-        return pcsv.read_csv(path)
-    if fmt == "json":
-        return pjson.read_json(path)
-    if fmt == "numpy":
-        arr = np.load(path)
-        return block_from_numpy({"data": arr})
-    raise ValueError(fmt)
+def _read_file(reader, path: str, columns=None):
+    # `reader` is resolved driver-side and ships with the task — worker
+    # processes never see driver-local register_datasource() calls.
+    return reader(path, columns)
 
 
 class Dataset:
@@ -109,9 +96,11 @@ class Dataset:
     @staticmethod
     def read(paths: Union[str, List[str]], fmt: str,
              columns=None) -> "Dataset":
-        if isinstance(paths, str):
-            paths = sorted(glob_mod.glob(paths)) or [paths]
-        return Dataset([_read_file.remote(p, fmt, columns) for p in paths])
+        from ray_tpu.data.datasource import expand_paths, resolve_datasource
+
+        reader = resolve_datasource(fmt)
+        return Dataset([_read_file.remote(reader, p, columns)
+                        for p in expand_paths(paths)])
 
     # ---------------- transforms ----------------
     def map_batches(self, fn: Callable, batch_format: str = "numpy"
@@ -159,6 +148,20 @@ class Dataset:
             end = (i + 1) * per if (equal or i < n - 1) else total
             out.append(Dataset([_slice_block.remote(whole, start, end)]))
         return out
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed sample-sort (reference: Dataset.sort →
+        _internal/sort.py two-phase range partition)."""
+        from ray_tpu.data.grouped import sort_impl
+
+        return Dataset(sort_impl(self._blocks, key, descending))
+
+    def groupby(self, key: str, num_partitions: Optional[int] = None):
+        """Hash-shuffle groupby (reference: Dataset.groupby →
+        grouped_data.py)."""
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key, num_partitions)
 
     def zip(self, other: "Dataset") -> "Dataset":
         a = concat_blocks(ray_tpu.get(self._blocks))

@@ -1,0 +1,162 @@
+"""Distributed sort + groupby over dataset blocks.
+
+Reference: python/ray/data/dataset.py (Dataset.sort, Dataset.groupby),
+_internal/sort.py (sample → boundaries → range-partition → per-partition
+merge) and grouped_data.py (GroupedData.count/sum/mean/min/max/std via a
+hash shuffle + per-partition combine).  Same two-phase shape here, all
+block-parallel remote tasks — the driver only routes refs:
+
+  sort:    sample each block → positional boundaries → every block range-
+           partitions itself (num_returns=P) → output block i concatenates
+           part i of every input and sorts locally.
+  groupby: every block hash-partitions itself by key → output partition i
+           concatenates its parts and aggregates with pyarrow group_by.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+
+
+def _mask_filter(blk, mask: np.ndarray):
+    import pyarrow as pa
+
+    return blk.filter(pa.array(mask.astype(bool)))
+
+
+@ray_tpu.remote
+def _sample_keys(blk, key: str, n: int):
+    col = blk.column(key).to_numpy(zero_copy_only=False)
+    if len(col) == 0:
+        return col
+    idx = np.random.default_rng(0).integers(0, len(col), size=min(n, len(col)))
+    return col[idx]
+
+
+@ray_tpu.remote
+def _range_partition(blk, key: str, boundaries, descending: bool):
+    col = blk.column(key).to_numpy(zero_copy_only=False)
+    part = np.searchsorted(np.asarray(boundaries), col, side="right")
+    n_parts = len(boundaries) + 1
+    if descending:
+        part = (n_parts - 1) - part
+    return tuple(_mask_filter(blk, part == i) for i in range(n_parts))
+
+
+@ray_tpu.remote
+def _merge_sorted(key: str, descending: bool, *parts):
+    t = block_mod.concat_blocks(list(parts))
+    col = t.column(key).to_numpy(zero_copy_only=False)
+    order = np.argsort(col, kind="stable")
+    if descending:
+        order = order[::-1]
+    return t.take(order)
+
+
+@ray_tpu.remote
+def _hash_partition(blk, key: str, num_parts: int):
+    import zlib
+
+    col = blk.column(key).to_numpy(zero_copy_only=False)
+    # Stable per-value hashing (python's str hash is salted per-process,
+    # which would send equal keys to different partitions across workers).
+    h = np.array([zlib.crc32(repr(v).encode()) for v in col],
+                 dtype=np.uint64)
+    part = h % num_parts
+    return tuple(_mask_filter(blk, part == i) for i in range(num_parts))
+
+
+@ray_tpu.remote
+def _agg_partition(key: str, aggs, *parts):
+    """aggs: list of (column, pyarrow aggregate name) — output columns get
+    pyarrow's '{col}_{fn}' naming."""
+    t = block_mod.concat_blocks(list(parts))
+    # Empty partitions still go through group_by: it returns zero rows
+    # with the AGGREGATED schema, keeping every output block consistent.
+    return t.group_by([key]).aggregate(list(aggs))
+
+
+def sort_impl(blocks: List, key: str, descending: bool = False,
+              samples_per_block: int = 64) -> List:
+    if not blocks:
+        return blocks
+    samples = np.concatenate(
+        ray_tpu.get([_sample_keys.remote(b, key, samples_per_block)
+                     for b in blocks]))
+    if samples.size == 0:
+        return blocks
+    n_out = len(blocks)
+    # Positional boundaries from the sorted sample — works for any
+    # orderable dtype (strings included), unlike np.quantile.
+    samples = np.sort(samples, kind="stable")
+    if n_out > 1:
+        pos = np.linspace(0, len(samples) - 1, n_out + 1)[1:-1]
+        boundaries = samples[pos.astype(int)]
+    else:
+        boundaries = samples[:0]
+    part_lists = [
+        _range_partition.options(num_returns=n_out).remote(
+            b, key, boundaries, descending)
+        for b in blocks
+    ]
+    if n_out == 1:
+        part_lists = [[p] for p in part_lists]
+    return [
+        _merge_sorted.remote(key, descending,
+                             *[parts[i] for parts in part_lists])
+        for i in range(n_out)
+    ]
+
+
+class GroupedData:
+    """ds.groupby(key) → aggregations (reference: grouped_data.py)."""
+
+    def __init__(self, dataset, key: str,
+                 num_partitions: Optional[int] = None):
+        self._ds = dataset
+        self._key = key
+        self._parts = num_partitions or max(
+            1, min(8, len(dataset._blocks)))
+
+    def _aggregate(self, aggs):
+        from ray_tpu.data.dataset import Dataset
+
+        blocks = self._ds._blocks
+        part_lists = [
+            _hash_partition.options(num_returns=self._parts).remote(
+                b, self._key, self._parts)
+            for b in blocks
+        ]
+        if self._parts == 1:
+            part_lists = [[p] for p in part_lists]
+        return Dataset([
+            _agg_partition.remote(self._key, aggs,
+                                  *[parts[i] for parts in part_lists])
+            for i in range(self._parts)
+        ])
+
+    def count(self):
+        return self._aggregate([(self._key, "count")])
+
+    def sum(self, col: str):
+        return self._aggregate([(col, "sum")])
+
+    def mean(self, col: str):
+        return self._aggregate([(col, "mean")])
+
+    def min(self, col: str):
+        return self._aggregate([(col, "min")])
+
+    def max(self, col: str):
+        return self._aggregate([(col, "max")])
+
+    def std(self, col: str):
+        return self._aggregate([(col, "stddev")])
+
+    def aggregate(self, *aggs):
+        """aggs: (column, pyarrow_agg_name) pairs, e.g. ("v", "sum")."""
+        return self._aggregate(list(aggs))

@@ -98,14 +98,12 @@ class StreamingDataset:
 
     @staticmethod
     def read(paths, fmt: str, columns=None, **kw) -> "StreamingDataset":
-        import glob as glob_mod
-
         from ray_tpu.data.dataset import _read_file
+        from ray_tpu.data.datasource import expand_paths, resolve_datasource
 
-        if isinstance(paths, str):
-            paths = sorted(glob_mod.glob(paths)) or [paths]
-        thunks = [(lambda p=p: _read_file.remote(p, fmt, columns))
-                  for p in paths]
+        reader = resolve_datasource(fmt)
+        thunks = [(lambda p=p: _read_file.remote(reader, p, columns))
+                  for p in expand_paths(paths)]
         return StreamingDataset(thunks, **kw)
 
     def _derive(self, stages) -> "StreamingDataset":

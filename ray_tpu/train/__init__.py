@@ -10,3 +10,8 @@ from ray_tpu.train._internal.backend_executor import (  # noqa: F401
     TrainingWorkerError,
 )
 from ray_tpu.train._internal.worker_group import WorkerGroup  # noqa: F401
+from ray_tpu.train.predictor import (  # noqa: F401
+    BatchPredictor,
+    JaxPredictor,
+    Predictor,
+)
