@@ -66,6 +66,24 @@ class Algorithm:
             self.learner.set_weights(params)
             self.workers.sync_weights(params)
 
+    # ---- shared helpers ----
+    def _episode_counter_metrics(self, metrics: Dict[str, Any]
+                                 ) -> Dict[str, Any]:
+        """Convert the cumulative on-device episode counters
+        (episode_return_sum/episode_count) into a per-iter
+        episode_reward_mean.  Stateful delta tracking shared by the
+        replay-family algorithms (DQN, SAC)."""
+        prev_sum, prev_cnt = getattr(self, "_prev_counters", (0.0, 0.0))
+        cum_sum = metrics.pop("episode_return_sum")
+        cum_cnt = metrics.pop("episode_count")
+        self._prev_counters = (cum_sum, cum_cnt)
+        dsum, dcnt = cum_sum - prev_sum, cum_cnt - prev_cnt
+        if dcnt > 0:
+            self._ep_reward_ema = dsum / dcnt
+        metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",
+                                                 float("nan"))
+        return metrics
+
     # hooks provided by concrete algorithms
     def _setup_anakin(self):
         raise NotImplementedError(f"{type(self).__name__} has no anakin mode")

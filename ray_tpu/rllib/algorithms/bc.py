@@ -1,0 +1,142 @@
+"""BC (behavior cloning): supervised policy learning from offline data.
+
+Reference: rllib/algorithms/bc/bc.py (BC = MARWIL with beta=0 — maximize
+the policy log-likelihood of dataset actions; no env interaction during
+training).  Here the dataset loads once into device memory and the whole
+epoch — shuffle, minibatch sweep, SGD — is one jitted step; evaluation
+runs the greedy policy in a jitted env rollout.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.lr = 1e-3
+        self.offline_input = None     # path readable by JsonReader
+        self.bc_minibatch_size = 256
+        self.num_sgd_per_iter = 32
+
+    def offline_data(self, input_=None):
+        if input_ is not None:
+            self.offline_input = input_
+        return self
+
+
+class BCState(NamedTuple):
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+class BC(Algorithm):
+    _default_config_cls = BCConfig
+
+    def setup(self):
+        from ray_tpu.rllib.offline import JsonReader
+
+        config = self.config
+        env = make_jax_env(config.env) if isinstance(config.env, str) \
+            else config.env
+        self._env = env
+        spec = RLModuleSpec(obs_dim=env.obs_dim,
+                            num_actions=env.num_actions,
+                            hiddens=tuple(config.hiddens))
+        self.module = spec.build()
+        if config.offline_input is None:
+            raise ValueError("BC requires config.offline_data(input_=path)")
+        data = JsonReader(config.offline_input).read_all()
+        self._obs = jnp.asarray(np.asarray(data["obs"], np.float32))
+        self._actions = jnp.asarray(np.asarray(data["actions"], np.int32))
+        n = self._obs.shape[0]
+        mb = min(config.bc_minibatch_size, n)
+
+        tx_parts = []
+        if config.grad_clip:
+            tx_parts.append(optax.clip_by_global_norm(config.grad_clip))
+        tx_parts.append(optax.adam(config.lr))
+        tx = optax.chain(*tx_parts)
+
+        def loss_fn(params, obs, actions):
+            logp, _value, _ent = self.module.forward_train(
+                params, obs, actions)
+            return -jnp.mean(logp)
+
+        obs_all, act_all = self._obs, self._actions
+
+        def train_step(state: BCState):
+            def one_update(carry, key):
+                params, opt_state = carry
+                idx = jax.random.randint(key, (mb,), 0, n)
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, obs_all[idx], act_all[idx])
+                updates, opt_state = tx.update(grads, opt_state)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            rng, k = jax.random.split(state.rng)
+            keys = jax.random.split(k, config.num_sgd_per_iter)
+            (params, opt_state), losses = jax.lax.scan(
+                one_update, (state.params, state.opt_state), keys)
+            return BCState(params, opt_state, rng), losses.mean()
+
+        rng = jax.random.PRNGKey(config.seed)
+        rng, k_init = jax.random.split(rng)
+        params = self.module.init(k_init, self._obs[:1])
+        self._anakin_state = BCState(params, tx.init(params), rng)
+        self._train_step = jax.jit(train_step)
+
+        num_eval_envs = 16
+
+        def eval_rollout(params, key, num_steps: int):
+            """Greedy rollout; returns mean completed-episode return."""
+            k_env, k_run = jax.random.split(key)
+            env_states, obs = vector_reset(env, k_env, num_eval_envs)
+
+            def step(carry, _):
+                env_states, obs, rng, ep_ret, dsum, dcnt = carry
+                rng, k_s = jax.random.split(rng)
+                action = self.module.forward_inference(params, obs)
+                env_states, obs, reward, done, _ = vector_step(
+                    env, env_states, action, k_s)
+                ep_ret = ep_ret + reward
+                dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+                dcnt = dcnt + jnp.sum(done)
+                ep_ret = jnp.where(done, 0.0, ep_ret)
+                return (env_states, obs, rng, ep_ret, dsum, dcnt), None
+
+            carry = (env_states, obs, k_run, jnp.zeros(num_eval_envs),
+                     jnp.zeros(()), jnp.zeros(()))
+            carry, _ = jax.lax.scan(step, carry, None, length=num_steps)
+            _env_states, _obs, _rng, _ep, dsum, dcnt = carry
+            return dsum / jnp.maximum(dcnt, 1.0)
+
+        self._eval_rollout = jax.jit(eval_rollout, static_argnums=2)
+        self._eval_key = rng
+
+    def train(self) -> Dict[str, Any]:
+        import time
+
+        t0 = time.perf_counter()
+        self._anakin_state, loss = self._train_step(self._anakin_state)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "bc_loss": float(loss),
+                "time_this_iter_s": time.perf_counter() - t0}
+
+    def evaluate(self, num_steps: int = 1000) -> Dict[str, float]:
+        self._eval_key, k = jax.random.split(self._eval_key)
+        r = self._eval_rollout(self._anakin_state.params, k, num_steps)
+        return {"episode_reward_mean": float(r)}

@@ -80,6 +80,25 @@ class DQNState(NamedTuple):
     done_count: jax.Array
 
 
+def make_replay_state(buffer_size: int, n_insert: int, obs_dim: int,
+                      action_shape: Tuple[int, ...] = (),
+                      action_dtype=jnp.int32) -> ReplayState:
+    """Device replay buffer sized to a multiple of the per-iter insert so
+    wrap inserts stay slice-aligned (dynamic_update_slice never clamps).
+    Shared by the replay-family algorithms (DQN, SAC)."""
+    cap = max(buffer_size, n_insert)
+    cap = ((cap + n_insert - 1) // n_insert) * n_insert
+    return ReplayState(
+        obs=jnp.zeros((cap, obs_dim), jnp.float32),
+        actions=jnp.zeros((cap,) + tuple(action_shape), action_dtype),
+        rewards=jnp.zeros((cap,), jnp.float32),
+        next_obs=jnp.zeros((cap, obs_dim), jnp.float32),
+        dones=jnp.zeros((cap,), jnp.float32),
+        insert_pos=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+    )
+
+
 def _replay_insert(replay: ReplayState, batch: Dict[str, jax.Array]
                    ) -> ReplayState:
     """Insert [N] transitions at the circular cursor (N divides capacity)."""
@@ -114,28 +133,14 @@ def make_anakin_dqn(config: DQNConfig):
     tx = optax.chain(*tx_parts)
 
     N, T = config.num_envs, config.unroll_length
-    # Round capacity up to a multiple of the per-iter insert size N*T:
-    # wrap inserts stay slice-aligned, so dynamic_update_slice never clamps
-    # (a clamped start would silently overwrite the freshest transitions
-    # while insert_pos advanced past slots that were never written).
     n_insert = N * T
-    cap = max(config.buffer_size, n_insert)
-    cap = ((cap + n_insert - 1) // n_insert) * n_insert
 
     def init_fn(seed: int = 0) -> DQNState:
         rng = jax.random.PRNGKey(seed)
         rng, k_init, k_env = jax.random.split(rng, 3)
         env_states, obs = vector_reset(env, k_env, N)
         params = net.init(k_init, obs)
-        replay = ReplayState(
-            obs=jnp.zeros((cap, env.obs_dim), jnp.float32),
-            actions=jnp.zeros((cap,), jnp.int32),
-            rewards=jnp.zeros((cap,), jnp.float32),
-            next_obs=jnp.zeros((cap, env.obs_dim), jnp.float32),
-            dones=jnp.zeros((cap,), jnp.float32),
-            insert_pos=jnp.zeros((), jnp.int32),
-            size=jnp.zeros((), jnp.int32),
-        )
+        replay = make_replay_state(config.buffer_size, n_insert, env.obs_dim)
         return DQNState(params, params, tx.init(params), env_states, obs,
                         rng, replay, jnp.zeros((), jnp.int32),
                         jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
@@ -259,15 +264,7 @@ class DQN(Algorithm):
     def _training_step_anakin(self) -> Dict[str, Any]:
         self._anakin_state, metrics = self._train_step(self._anakin_state)
         metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
-        prev_sum, prev_cnt = getattr(self, "_prev_counters", (0.0, 0.0))
-        cum_sum = metrics.pop("episode_return_sum")
-        cum_cnt = metrics.pop("episode_count")
-        self._prev_counters = (cum_sum, cum_cnt)
-        dsum, dcnt = cum_sum - prev_sum, cum_cnt - prev_cnt
-        if dcnt > 0:
-            self._ep_reward_ema = dsum / dcnt
-        metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",
-                                                 float("nan"))
+        metrics = self._episode_counter_metrics(metrics)
         metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
         return metrics
 

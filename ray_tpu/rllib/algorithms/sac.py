@@ -1,0 +1,298 @@
+"""SAC (soft actor-critic), anakin-style: continuous control with the
+whole loop — env stepping, HBM replay buffer, twin-Q updates, squashed-
+Gaussian policy, automatic entropy temperature — inside ONE jitted step.
+
+Reference: rllib/algorithms/sac/ (config surface: twin_q, target entropy
+'auto', tau, initial_alpha; loss structure sac_torch_policy.py
+actor/critic/alpha losses).  The TPU redesign mirrors DQN's: transitions
+live in a [capacity, ...] device buffer via dynamic_update_slice under
+lax.scan, polyak target sync replaces hard copies, and the alpha update is
+a plain adam step on log_alpha — no data-dependent control flow under jit.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.mlp import MLP
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayState, make_replay_state
+from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.lr = 3e-4
+        self.buffer_size = 100_000
+        self.learning_starts = 1_000
+        self.tau = 0.005
+        self.initial_alpha = 1.0
+        self.target_entropy = "auto"  # -action_dim
+        self.num_updates_per_iter = 8
+        self.sac_batch_size = 256
+
+
+class SquashedGaussianPolicy:
+    """MLP → (mu, log_std); tanh squash scaled to the action bounds."""
+
+    def __init__(self, obs_dim: int, action_dim: int, hiddens, low, high):
+        self.net = MLP(tuple(hiddens), 2 * action_dim, name="pi")
+        self.action_dim = action_dim
+        self.scale = (high - low) / 2.0
+        self.center = (high + low) / 2.0
+
+    def init(self, key, obs):
+        return self.net.init(key, obs)
+
+    def dist_params(self, params, obs):
+        out = self.net.apply(params, obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample(self, params, obs, key):
+        """Reparameterized sample + log-prob with the tanh correction."""
+        mu, log_std = self.dist_params(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre = mu + std * eps
+        # Gaussian logp minus the tanh change-of-variables term
+        # (numerically stable form: log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))).
+        logp = jnp.sum(
+            -0.5 * (eps ** 2) - log_std - 0.5 * jnp.log(2 * jnp.pi),
+            axis=-1)
+        logp = logp - jnp.sum(
+            2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)),
+            axis=-1)
+        # Affine change of variables for the bound scaling: without the
+        # -log(scale) term the density is off by log(scale) per action dim,
+        # which skews the alpha controller's entropy target.
+        logp = logp - jnp.sum(
+            jnp.broadcast_to(jnp.log(self.scale), (self.action_dim,)))
+        action = jnp.tanh(pre) * self.scale + self.center
+        return action, logp
+
+    def mode(self, params, obs):
+        mu, _ = self.dist_params(params, obs)
+        return jnp.tanh(mu) * self.scale + self.center
+
+
+class TwinQ:
+    """Two independent Q(s, a) heads (reference: twin_q=True)."""
+
+    def __init__(self, hiddens):
+        self.q1 = MLP(tuple(hiddens), 1, name="q1")
+        self.q2 = MLP(tuple(hiddens), 1, name="q2")
+
+    def init(self, key, obs, action):
+        k1, k2 = jax.random.split(key)
+        x = jnp.concatenate([obs, action], axis=-1)
+        return {"q1": self.q1.init(k1, x), "q2": self.q2.init(k2, x)}
+
+    def apply(self, params, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return (self.q1.apply(params["q1"], x)[..., 0],
+                self.q2.apply(params["q2"], x)[..., 0])
+
+
+class SACState(NamedTuple):
+    pi_params: Any
+    q_params: Any
+    q_target: Any
+    log_alpha: jax.Array
+    pi_opt: Any
+    q_opt: Any
+    a_opt: Any
+    env_states: Any
+    obs: jax.Array
+    rng: jax.Array
+    replay: ReplayState
+    ep_return: jax.Array
+    done_return_sum: jax.Array
+    done_count: jax.Array
+
+
+def make_anakin_sac(config: SACConfig):
+    env = make_jax_env(config.env) if isinstance(config.env, str) \
+        else config.env
+    adim = env.action_dim
+    low = jnp.asarray(env.action_low, jnp.float32)
+    high = jnp.asarray(env.action_high, jnp.float32)
+    pi = SquashedGaussianPolicy(env.obs_dim, adim, config.hiddens, low, high)
+    q = TwinQ(config.hiddens)
+    target_entropy = (-float(adim) if config.target_entropy == "auto"
+                      else float(config.target_entropy))
+    def make_tx():
+        parts = []
+        if config.grad_clip:
+            parts.append(optax.clip_by_global_norm(config.grad_clip))
+        parts.append(optax.adam(config.lr))
+        return optax.chain(*parts)
+
+    pi_tx, q_tx, a_tx = make_tx(), make_tx(), make_tx()
+
+    N, T = config.num_envs, config.unroll_length
+    n_insert = N * T
+
+    def init_fn(seed: int = 0) -> SACState:
+        rng = jax.random.PRNGKey(seed)
+        rng, k_pi, k_q, k_env = jax.random.split(rng, 4)
+        env_states, obs = vector_reset(env, k_env, N)
+        pi_params = pi.init(k_pi, obs)
+        a0 = jnp.zeros((N, adim))
+        q_params = q.init(k_q, obs, a0)
+        replay = make_replay_state(config.buffer_size, n_insert,
+                                   env.obs_dim, action_shape=(adim,),
+                                   action_dtype=jnp.float32)
+        return SACState(
+            pi_params, q_params, q_params,
+            jnp.log(jnp.asarray(config.initial_alpha, jnp.float32)),
+            pi_tx.init(pi_params), q_tx.init(q_params),
+            a_tx.init(jnp.zeros(())), env_states, obs, rng, replay,
+            jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
+
+    from ray_tpu.rllib.algorithms.dqn import _replay_insert
+
+    def rollout_step(carry, _):
+        pi_params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+        rng, k_act, k_step = jax.random.split(rng, 3)
+        action, _ = pi.sample(pi_params, obs, k_act)
+        env_states, next_obs, reward, done, _ = vector_step(
+            env, env_states, action, k_step)
+        ep_ret = ep_ret + reward
+        dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        dcnt = dcnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        out = {"obs": obs, "actions": action, "rewards": reward,
+               "next_obs": next_obs, "dones": done.astype(jnp.float32)}
+        return (pi_params, env_states, next_obs, rng, ep_ret, dsum,
+                dcnt), out
+
+    def q_loss(q_params, q_target, pi_params, log_alpha, batch, key):
+        next_a, next_logp = pi.sample(pi_params, batch["next_obs"], key)
+        tq1, tq2 = q.apply(q_target, batch["next_obs"], next_a)
+        alpha = jnp.exp(log_alpha)
+        target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+        target = batch["rewards"] + config.gamma * (1 - batch["dones"]) \
+            * jax.lax.stop_gradient(target_v)
+        q1, q2 = q.apply(q_params, batch["obs"], batch["actions"])
+        return jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+    def pi_loss(pi_params, q_params, log_alpha, batch, key):
+        a, logp = pi.sample(pi_params, batch["obs"], key)
+        q1, q2 = q.apply(q_params, batch["obs"], a)
+        alpha = jnp.exp(log_alpha)
+        return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+    def alpha_loss(log_alpha, logp):
+        return -jnp.mean(log_alpha
+                         * jax.lax.stop_gradient(logp + target_entropy))
+
+    def train_step(state: SACState) -> Tuple[SACState, Dict[str, jax.Array]]:
+        carry = (state.pi_params, state.env_states, state.obs, state.rng,
+                 state.ep_return, state.done_return_sum, state.done_count)
+        carry, traj = jax.lax.scan(rollout_step, carry, None, length=T)
+        (pi_params, env_states, obs, rng, ep_ret, dsum, dcnt) = carry
+        flat = {k: v.reshape((n_insert,) + v.shape[2:])
+                for k, v in traj.items()}
+        replay = _replay_insert(state.replay, flat)
+
+        def update(carry, key):
+            (pi_params, q_params, q_target, log_alpha, pi_opt, q_opt,
+             a_opt) = carry
+            k_idx, k_q, k_pi = jax.random.split(key, 3)
+            idx = jax.random.randint(k_idx, (config.sac_batch_size,), 0,
+                                     jnp.maximum(replay.size, 1))
+            batch = {k: getattr(replay, k)[idx]
+                     for k in ("obs", "actions", "rewards", "next_obs",
+                               "dones")}
+            ql, q_grads = jax.value_and_grad(q_loss)(
+                q_params, q_target, pi_params, log_alpha, batch, k_q)
+            qu, q_opt = q_tx.update(q_grads, q_opt)
+            q_params = optax.apply_updates(q_params, qu)
+            (pl, logp), pi_grads = jax.value_and_grad(pi_loss, has_aux=True)(
+                pi_params, q_params, log_alpha, batch, k_pi)
+            pu, pi_opt = pi_tx.update(pi_grads, pi_opt)
+            pi_params = optax.apply_updates(pi_params, pu)
+            al, a_grad = jax.value_and_grad(alpha_loss)(log_alpha, logp)
+            au, a_opt = a_tx.update(a_grad, a_opt)
+            log_alpha = optax.apply_updates(log_alpha, au)
+            tau = config.tau
+            q_target = jax.tree_util.tree_map(
+                lambda t, p: (1 - tau) * t + tau * p, q_target, q_params)
+            return (pi_params, q_params, q_target, log_alpha, pi_opt,
+                    q_opt, a_opt), (ql, pl, al)
+
+        rng, k = jax.random.split(rng)
+        keys = jax.random.split(k, config.num_updates_per_iter)
+        warm = replay.size >= config.learning_starts
+        new_carry, (qls, pls, als) = jax.lax.scan(
+            update, (pi_params, state.q_params, state.q_target,
+                     state.log_alpha, state.pi_opt, state.q_opt,
+                     state.a_opt), keys)
+        old_carry = (pi_params, state.q_params, state.q_target,
+                     state.log_alpha, state.pi_opt, state.q_opt, state.a_opt)
+        # Before learning_starts: collect only, discard the updates.
+        (pi_params, q_params, q_target, log_alpha, pi_opt, q_opt,
+         a_opt) = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(warm, new, old), new_carry, old_carry)
+
+        new_state = SACState(pi_params, q_params, q_target, log_alpha,
+                             pi_opt, q_opt, a_opt, env_states, obs, rng,
+                             replay, ep_ret, dsum, dcnt)
+        metrics = {
+            "critic_loss": qls.mean(), "actor_loss": pls.mean(),
+            "alpha_loss": als.mean(), "alpha": jnp.exp(log_alpha),
+            "replay_size": replay.size,
+            "episode_return_sum": dsum, "episode_count": dcnt,
+        }
+        return new_state, metrics
+
+    return pi, init_fn, jax.jit(train_step), n_insert
+
+
+class SAC(Algorithm):
+    _default_config_cls = SACConfig
+
+    def _setup_anakin(self):
+        (self.module, init_fn, self._train_step,
+         self._steps_per_iter) = make_anakin_sac(self.config)
+        self._anakin_state = init_fn(self.config.seed)
+
+    def _training_step_anakin(self) -> Dict[str, Any]:
+        self._anakin_state, metrics = self._train_step(self._anakin_state)
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics = self._episode_counter_metrics(metrics)
+        metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
+        return metrics
+
+    def _setup_actor_mode(self):
+        raise NotImplementedError(
+            "SAC ships anakin-mode only (off-policy replay is on-device; "
+            "the actor-path sampling stack serves PPO/IMPALA)")
+
+
+    # SACState has multiple param trees — override the Trainable protocol's
+    # single-tree default (algorithm.py:52).
+    def save_checkpoint(self) -> "Checkpoint":
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        s = self._anakin_state
+        return Checkpoint.from_pytree(
+            {"pi": s.pi_params, "q": s.q_params, "q_target": s.q_target,
+             "log_alpha": s.log_alpha},
+            extra={"iteration": self.iteration})
+
+    def load_checkpoint(self, checkpoint):
+        tree = checkpoint.to_pytree()
+        self.iteration = checkpoint.extra().get("iteration", 0)
+        self._anakin_state = self._anakin_state._replace(
+            pi_params=tree["pi"], q_params=tree["q"],
+            q_target=tree["q_target"], log_alpha=tree["log_alpha"])

@@ -101,8 +101,13 @@ class Pendulum:
         return jnp.stack([jnp.cos(state["th"]), jnp.sin(state["th"]),
                           state["thdot"]])
 
+    def _torque(self, action):
+        """Map the policy action to torque; the continuous subclass
+        overrides this single hook so the dynamics stay in one place."""
+        return (action.astype(jnp.float32) - 1.0) * self.max_torque
+
     def step(self, state, action, rng):
-        u = (action.astype(jnp.float32) - 1.0) * self.max_torque
+        u = self._torque(action)
         th, thdot = state["th"], state["thdot"]
         norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
         cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
@@ -211,9 +216,26 @@ class Breakout:
         return out_state, obs, reward, done, {}
 
 
+class PendulumContinuous(Pendulum):
+    """Pendulum-v1 with the real continuous torque action — the SAC-family
+    env.  ``action`` is a float array of shape [action_dim] in
+    [-max_torque, max_torque] (reference env semantics:
+    gym Pendulum-v1; the discretized parent serves categorical policies)."""
+
+    num_actions = None  # continuous
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def _torque(self, action):
+        return jnp.clip(jnp.reshape(action, ()), self.action_low,
+                        self.action_high)
+
+
 REGISTRY = {
     "CartPole-v1": CartPole,
     "Pendulum-v1": Pendulum,
+    "PendulumContinuous-v1": PendulumContinuous,
     "Breakout-MinAtar-v0": Breakout,
 }
 

@@ -1,0 +1,14 @@
+"""Offline RL: sample IO, behavior cloning, off-policy evaluation.
+
+Reference: rllib/offline/ — JsonWriter/JsonReader (json_writer.py:30,
+json_reader.py:43), the BC algorithm (rllib/algorithms/bc/bc.py) and the
+OPE estimators (offline/estimators/importance_sampling.py,
+weighted_importance_sampling.py).  The IO format matches the reference's
+spirit: one JSON object per line, arrays as nested lists, so files are
+greppable and language-neutral.
+"""
+from ray_tpu.rllib.offline.io import JsonReader, JsonWriter  # noqa: F401
+from ray_tpu.rllib.offline.estimators import (  # noqa: F401
+    ImportanceSampling,
+    WeightedImportanceSampling,
+)
