@@ -381,6 +381,14 @@ class Head:
                     self.on_arena_release(msg)
                 elif mtype == "request":
                     self._handle_request(msg, conn, worker_id)
+                elif mtype == "notify":
+                    # One-way request: no reply frame (hot-path submits).
+                    try:
+                        self.handle_request(msg["op"],
+                                            msg.get("payload") or {},
+                                            lambda *a, **k: None, worker_id)
+                    except Exception:
+                        traceback.print_exc()
         except (EOFError, OSError, BrokenPipeError):
             pass
         except Exception:
@@ -480,6 +488,23 @@ class Head:
     def req_submit(self, payload, reply, caller):
         self.submit_task(payload["spec"])
         reply(True)
+
+    def req_resolve_batch(self, payload, reply, caller):
+        """Resolve many objects in one round trip: returns {hex: msg} for
+        every object that is available RIGHT NOW (arena leases granted as
+        in req_get_locations); callers fall back to the blocking per-object
+        path for the rest.  Collapses the driver's get([refs...]) from one
+        request per ref to one request per batch."""
+        caller_host = self._caller_host(caller)
+        out = {}
+        with self._lock:
+            for oid in payload["oids"]:
+                resolved = self._resolve_object(oid, caller_host=caller_host)
+                if resolved is not None:
+                    if resolved.get("kind") == "arena":
+                        self._grant_arena_lease(oid, caller)
+                    out[oid.binary()] = resolved
+        reply(out)
 
     def req_get_locations(self, payload, reply, caller):
         """Resolve an object: reply immediately if available, else defer."""
@@ -922,8 +947,20 @@ class Head:
         if not self.pending:
             return
         still: deque = deque()
+        # Per-scheduling-class early-out (reference: the raylet queues tasks
+        # by SchedulingClass, cluster_task_manager.h): once a class finds no
+        # feasible node in this pass, its remaining tasks are skipped — the
+        # drain is O(pending) instead of O(pending * completions).  Tasks
+        # with placement strategies schedule against per-task state (PG
+        # bundle, target node), so only default-strategy tasks share a key.
+        blocked: set = set()
         while self.pending:
             spec = self.pending.popleft()
+            key = (spec.scheduling_class()
+                   if spec.scheduling_strategy.kind == "DEFAULT" else None)
+            if key is not None and key in blocked:
+                still.append(spec)
+                continue
             try:
                 node_id = self.scheduler.pick_node(spec)
             except Infeasible as e:
@@ -931,6 +968,8 @@ class Head:
                 continue
             if node_id is None:
                 still.append(spec)
+                if key is not None:
+                    blocked.add(key)
             else:
                 self.gcs.update_task_status(spec.task_id, TaskStatus.SCHEDULED,
                                             node_id=node_id)

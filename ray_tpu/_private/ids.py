@@ -10,8 +10,27 @@ from __future__ import annotations
 
 import os
 import binascii
+import random
+import threading
 
 _NIL = b"\x00"
+
+# Process-local PRNG seeded from the OS once: ID generation is on the task
+# submission hot path and os.urandom's syscall per ID costs ~100x a PRNG
+# draw.  Uniqueness needs 128 random bits, not cryptographic strength.
+# Re-seeded after fork so children don't replay the parent's stream.
+_rng = random.Random(os.urandom(16))
+_rng_pid = os.getpid()
+_rng_lock = threading.Lock()
+
+
+def _random_bytes(n: int) -> bytes:
+    global _rng, _rng_pid
+    with _rng_lock:
+        if os.getpid() != _rng_pid:
+            _rng = random.Random(os.urandom(16))
+            _rng_pid = os.getpid()
+        return _rng.getrandbits(n * 8).to_bytes(n, "little")
 
 
 class BaseID:
@@ -30,7 +49,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        return cls(_random_bytes(cls.SIZE))
 
     @classmethod
     def nil(cls):

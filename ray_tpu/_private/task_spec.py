@@ -100,8 +100,14 @@ class TaskSpec:
 
     def scheduling_class(self) -> Tuple:
         """Key for lease reuse: same-shaped tasks share leased workers
-        (reference: SchedulingClass in src/ray/common/task/task_spec.h)."""
-        return (tuple(sorted(self.resources.items())), self.runtime_env is None)
+        (reference: SchedulingClass in src/ray/common/task/task_spec.h).
+        Cached — it's recomputed on every pending-queue drain pass."""
+        key = getattr(self, "_sched_class", None)
+        if key is None:
+            key = (tuple(sorted(self.resources.items())),
+                   self.runtime_env is None)
+            self._sched_class = key
+        return key
 
 
 @dataclass

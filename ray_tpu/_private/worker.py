@@ -57,6 +57,12 @@ class DirectTransport:
         self.head.handle_request(op, payload, reply, self.worker_id)
         return fut.result(timeout=None)  # head enforces timeouts itself
 
+    def request_oneway(self, op: str, payload: dict):
+        """Fire-and-forget request — the reply (always just an ack on these
+        ops) is dropped; errors surface through the task result path."""
+        self.head.handle_request(op, payload, lambda *a, **k: None,
+                                 self.worker_id)
+
     def notify(self, msg: dict):
         t = msg["type"]
         if t == "seal":
@@ -123,6 +129,11 @@ class ConnTransport:
 
     def notify(self, msg: dict):
         self.send(msg)
+
+    def request_oneway(self, op: str, payload: dict):
+        """Fire-and-forget request: one send, no reply frame, no round
+        trip.  Used for acked-only ops on the submission hot path."""
+        self.send({"type": "notify", "op": op, "payload": payload})
 
     def send(self, msg: dict):
         with self._send_lock:
@@ -343,11 +354,43 @@ class CoreWorker:
                 f"get() expects an ObjectRef or a list of ObjectRefs, "
                 f"got {type(refs).__name__}")
         ref_list = [refs] if single else list(refs)
-        out = []
         for r in ref_list:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
-            out.append(self._get_one(r.id, timeout))
+        resolved: dict = {}
+        if len(ref_list) > 1:
+            # One round trip resolves everything already available; only
+            # the stragglers take the blocking per-object path.
+            # Dedup: a repeated ref must not be granted two arena leases
+            # when only one materialize (and lease release) will happen.
+            missing = list(dict.fromkeys(
+                r.id for r in ref_list if r.id not in self._value_cache))
+            if missing:
+                batch = self.transport.request("resolve_batch",
+                                               {"oids": missing})
+                resolved = dict(batch or {})
+        out = []
+        try:
+            for r in ref_list:
+                msg = resolved.pop(r.id.binary(), None)
+                if msg is not None and r.id not in self._value_cache:
+                    out.append(self._materialize(r.id, msg))
+                else:
+                    if msg is not None and msg.get("kind") == "arena":
+                        # Batch granted a lease but the cache won: give the
+                        # lease back instead of dropping it on the floor.
+                        self._release_arena_lease(r.id)
+                    out.append(self._get_one(r.id, timeout))
+        finally:
+            # If an earlier ref's materialization raised, release the
+            # leases of every unconsumed arena resolution — otherwise the
+            # slots stay pinned until the driver disconnects.
+            for oid_bin, msg in resolved.items():
+                if msg.get("kind") == "arena":
+                    try:
+                        self._release_arena_lease(ObjectID(oid_bin))
+                    except Exception:
+                        pass
         return out[0] if single else out
 
     def _cache_value(self, oid: ObjectID, value):
@@ -566,14 +609,14 @@ class CoreWorker:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
-        self.transport.request("submit", {"spec": spec})
+        self.transport.request_oneway("submit", {"spec": spec})
         return refs
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
-        self.transport.request("actor_call", {"spec": spec})
+        self.transport.request_oneway("actor_call", {"spec": spec})
         return refs
 
     # ---- function resolution ----

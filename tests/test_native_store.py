@@ -132,3 +132,37 @@ def test_arena_slot_pinned_while_actor_holds_view(shutdown_only):
     while time.time() < deadline and store.arena.num_objects >= before:
         time.sleep(0.05)
     assert store.arena.num_objects < before
+
+
+def test_batched_get_releases_leases_on_error(shutdown_only):
+    """A failing ref in a batched get() must not strand arena leases on
+    the other (unconsumed) resolutions — stranded leases pin slots until
+    the driver disconnects."""
+    import numpy as np
+    import pytest as _pytest
+
+    import ray_tpu
+    from ray_tpu import exceptions as exc
+
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024**2)
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("nope")
+
+    bad = boom.remote()
+    # A driver put lands in the native arena — the lease-granting path.
+    good = ray_tpu.put(np.ones((1024, 512), np.float32))  # 2MB -> arena
+    ray_tpu.wait([bad], num_returns=1)
+    ray_tpu._worker()._value_cache.clear()  # force a real arena read
+    with _pytest.raises(exc.TaskError):
+        ray_tpu.get([bad, good])  # bad materializes first and raises
+    import gc
+
+    gc.collect()
+    head = ray_tpu._global_head()
+    leases = {k: dict(v) for k, v in head._arena_leases.items() if v}
+    assert not leases, f"stranded arena leases: {leases}"
+    # The good object is still retrievable afterwards.
+    v = ray_tpu.get(good)
+    assert float(v.sum()) == 1024 * 512
