@@ -39,6 +39,8 @@ class AlgorithmConfig:
         self.broadcast_interval = 1
         # model
         self.hiddens = (64, 64)
+        self.use_lstm = False
+        self.lstm_cell_size = 128
         # resources / misc
         self.seed = 0
         self.framework_str = "jax"
@@ -83,6 +85,11 @@ class AlgorithmConfig:
         for k, v in kw.items():
             if k == "model" and isinstance(v, dict):
                 self.hiddens = tuple(v.get("fcnet_hiddens", self.hiddens))
+                # Recurrent policy knobs (reference model config:
+                # use_lstm / lstm_cell_size, catalog.py MODEL_DEFAULTS).
+                self.use_lstm = bool(v.get("use_lstm", self.use_lstm))
+                self.lstm_cell_size = int(v.get("lstm_cell_size",
+                                                self.lstm_cell_size))
                 continue
             if not hasattr(self, k):
                 raise ValueError(f"unknown training param {k!r}")

@@ -36,10 +36,10 @@ class PPOConfig(AlgorithmConfig):
         super().__init__(algo_class=PPO)
 
 
-def ppo_loss(params, module, batch, *, clip_param, vf_clip_param,
-             vf_loss_coeff, entropy_coeff):
-    logp, value, entropy = module.forward_train(
-        params, batch["obs"], batch["actions"])
+def ppo_surrogate(logp, value, entropy, batch, *, clip_param, vf_clip_param,
+                  vf_loss_coeff, entropy_coeff):
+    """The clipped-surrogate objective from already-computed forward
+    outputs — shared by the feedforward and recurrent paths."""
     ratio = jnp.exp(logp - batch["action_logp"])
     adv = batch["advantages"]
     surr = jnp.minimum(
@@ -53,6 +53,17 @@ def ppo_loss(params, module, batch, *, clip_param, vf_clip_param,
     total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * ent
     return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
                    "entropy": ent}
+
+
+def ppo_loss(params, module, batch, *, clip_param, vf_clip_param,
+             vf_loss_coeff, entropy_coeff):
+    logp, value, entropy = module.forward_train(
+        params, batch["obs"], batch["actions"])
+    return ppo_surrogate(logp, value, entropy, batch,
+                         clip_param=clip_param,
+                         vf_clip_param=vf_clip_param,
+                         vf_loss_coeff=vf_loss_coeff,
+                         entropy_coeff=entropy_coeff)
 
 
 class AnakinState(NamedTuple):
@@ -186,8 +197,14 @@ class PPO(Algorithm):
 
     # ---- anakin mode ----
     def _setup_anakin(self):
-        (self.module, init_fn, self._train_step,
-         self._steps_per_iter) = make_anakin_ppo(self.config)
+        if self.config.use_lstm:
+            from ray_tpu.rllib.algorithms.ppo_rnn import make_anakin_ppo_rnn
+
+            (self.module, init_fn, self._train_step,
+             self._steps_per_iter) = make_anakin_ppo_rnn(self.config)
+        else:
+            (self.module, init_fn, self._train_step,
+             self._steps_per_iter) = make_anakin_ppo(self.config)
         self._anakin_state = init_fn(self.config.seed)
 
     def _training_step_anakin(self) -> Dict[str, Any]:

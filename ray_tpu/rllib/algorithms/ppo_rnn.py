@@ -1,0 +1,234 @@
+"""Recurrent (LSTM) PPO, anakin-style.
+
+Reference: the use_lstm/lstm_cell_size model path (rllib model config,
+models/catalog.py MODEL_DEFAULTS; torch RNN wrapper
+models/torch/recurrent_net.py) plus PPO's sequence handling (SampleBatch
+seq_lens + state_in/state_out columns).
+
+TPU redesign: no padding or seq_lens at all.  The rollout is a [T, N]
+scan that carries the LSTM state on device, resetting per-env state at
+episode boundaries; training replays the SAME scan from the unroll's
+initial carry, so hidden states are exact (the reference approximates
+with stored state_in at fragment boundaries).  Minibatches cut across the
+ENV axis (whole sequences stay intact) — the recurrent analogue of the
+reference's sequence-preserving minibatching, without padding because
+every sequence has length T by construction.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models.mlp import MLP
+from ray_tpu.rllib.evaluation.postprocessing import gae_jax
+from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+
+
+class RecurrentActorCritic(nn.Module):
+    """Per-head embed → LSTM → head, with SEPARATE recurrent trunks for
+    policy and value — matching the feedforward module's separate trunks
+    (core/rl_module.py DiscreteActorCritic): a shared trunk lets the large
+    early value-error gradients wreck the policy representation.  Exposed
+    as a single per-step function; sequences scan it from outside so the
+    same params serve rollout and training."""
+
+    num_actions: int
+    hiddens: Tuple[int, ...] = (64,)
+    lstm_size: int = 128
+
+    @nn.compact
+    def __call__(self, carry, obs, reset):
+        """One step: zero both carries where `reset`, then advance.
+        carry: ((c,h) policy, (c,h) value), each [N, lstm]; reset [N]."""
+        mask = (1.0 - reset.astype(jnp.float32))[:, None]
+
+        def trunk(sub_carry, name):
+            c, h = sub_carry
+            c, h = c * mask, h * mask
+            x = MLP(self.hiddens, self.lstm_size, name=f"embed_{name}")(obs)
+            return nn.OptimizedLSTMCell(self.lstm_size,
+                                        name=f"lstm_{name}")((c, h), x)
+
+        pi_carry, y_pi = trunk(carry[0], "pi")
+        vf_carry, y_vf = trunk(carry[1], "vf")
+        logits = nn.Dense(self.num_actions, name="pi")(y_pi)
+        value = nn.Dense(1, name="vf")(y_vf)[..., 0]
+        return (pi_carry, vf_carry), logits, value
+
+
+def zero_carry(n: int, lstm_size: int):
+    one = (jnp.zeros((n, lstm_size)), jnp.zeros((n, lstm_size)))
+    return (one, one)
+
+
+class RNNAnakinState(NamedTuple):
+    params: Any
+    opt_state: Any
+    env_states: Any
+    obs: jax.Array
+    carry: Tuple[jax.Array, jax.Array]
+    prev_done: jax.Array           # [N] — reset mask for the NEXT step
+    rng: jax.Array
+    ep_return: jax.Array
+    done_return_sum: jax.Array
+    done_count: jax.Array
+
+
+def make_anakin_ppo_rnn(config):
+    """Builds (module, init_fn, jitted train_step, steps/iter) for
+    LSTM-PPO; mirrors make_anakin_ppo with state threading."""
+    from ray_tpu.rllib.algorithms.ppo import ppo_surrogate
+
+    env = make_jax_env(config.env) if isinstance(config.env, str) \
+        else config.env
+    if getattr(env, "obs_shape", None) is not None:
+        raise ValueError(
+            "use_lstm supports flat-observation envs only (a CNN+LSTM "
+            "trunk is not wired yet); got pixel env "
+            f"{config.env!r} with obs_shape={env.obs_shape}")
+    if env.num_actions is None:
+        raise ValueError(
+            "use_lstm supports discrete action spaces only; continuous "
+            f"env {config.env!r} belongs to the SAC family")
+    module = RecurrentActorCritic(num_actions=env.num_actions,
+                                  hiddens=tuple(config.hiddens),
+                                  lstm_size=config.lstm_cell_size)
+    tx_parts = []
+    if config.grad_clip:
+        tx_parts.append(optax.clip_by_global_norm(config.grad_clip))
+    tx_parts.append(optax.adam(config.lr))
+    tx = optax.chain(*tx_parts)
+
+    N, T = config.num_envs, config.unroll_length
+    # Minibatches cut across envs: sequences stay whole.
+    envs_per_mb = max(1, min(N, config.sgd_minibatch_size // max(T, 1)))
+    num_mb = N // envs_per_mb
+    if N % envs_per_mb:
+        raise ValueError(
+            f"num_envs={N} is not divisible by the per-minibatch env count "
+            f"{envs_per_mb} (sgd_minibatch_size={config.sgd_minibatch_size}"
+            f" / unroll_length={T}): {N - num_mb * envs_per_mb} whole env "
+            "sequences would be silently dropped from every SGD epoch — "
+            "pick num_envs divisible by envs-per-minibatch")
+
+    def init_fn(seed: int = 0) -> RNNAnakinState:
+        rng = jax.random.PRNGKey(seed)
+        rng, k_init, k_env = jax.random.split(rng, 3)
+        env_states, obs = vector_reset(env, k_env, N)
+        carry = zero_carry(N, config.lstm_cell_size)
+        params = module.init(k_init, carry, obs, jnp.zeros(N, bool))
+        return RNNAnakinState(params, tx.init(params), env_states, obs,
+                              carry, jnp.zeros(N, bool), rng,
+                              jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
+
+    def rollout_step(carry_all, _):
+        (params, env_states, obs, carry, prev_done, rng, ep_ret, dsum,
+         dcnt) = carry_all
+        rng, k_act, k_step = jax.random.split(rng, 3)
+        carry, logits, value = module.apply(params, carry, obs, prev_done)
+        action = jax.random.categorical(k_act, logits)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, action[:, None], -1)[:, 0]
+        env_states, next_obs, reward, done, _ = vector_step(
+            env, env_states, action, k_step)
+        ep_ret = ep_ret + reward
+        dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        dcnt = dcnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        out = (obs, prev_done, action, logp, value, reward, done)
+        return (params, env_states, next_obs, carry, done, rng, ep_ret,
+                dsum, dcnt), out
+
+    def sequence_forward(params, carry0, obs_t, reset_t, actions_t):
+        """Replay the scan for training: exact hidden states, no padding.
+        obs_t [T, n, d], reset_t [T, n], actions_t [T, n]."""
+        def f(carry, inp):
+            obs, reset, act = inp
+            carry, logits, value = module.apply(params, carry, obs, reset)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, act[:, None], -1)[:, 0]
+            ent = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            return carry, (logp, value, ent)
+
+        _, (logp, value, ent) = jax.lax.scan(
+            f, carry0, (obs_t, reset_t, actions_t))
+        return logp, value, ent
+
+    def seq_ppo_loss(params, batch):
+        logp, value, entropy = sequence_forward(
+            params, batch["carry0"], batch["obs"], batch["resets"],
+            batch["actions"])
+        return ppo_surrogate(logp, value, entropy, batch,
+                             clip_param=config.clip_param,
+                             vf_clip_param=config.vf_clip_param,
+                             vf_loss_coeff=config.vf_loss_coeff,
+                             entropy_coeff=config.entropy_coeff)
+
+    def train_step(state: RNNAnakinState
+                   ) -> Tuple[RNNAnakinState, Dict[str, jax.Array]]:
+        carry0 = state.carry  # hidden state at the unroll's first step
+        roll = (state.params, state.env_states, state.obs, state.carry,
+                state.prev_done, state.rng, state.ep_return,
+                state.done_return_sum, state.done_count)
+        roll, traj = jax.lax.scan(rollout_step, roll, None, length=T)
+        (params, env_states, obs, carry, prev_done, rng, ep_ret, dsum,
+         dcnt) = roll
+        obs_t, reset_t, act_t, logp_t, val_t, rew_t, done_t = traj
+
+        _, _, last_value = module.apply(params, carry, obs, prev_done)
+        adv, vtarg = gae_jax(rew_t, val_t, done_t, last_value,
+                             config.gamma, config.lambda_)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        def sgd_epoch(carry_sgd, _):
+            params, opt_state, rng = carry_sgd
+            rng, k = jax.random.split(rng)
+            perm = jax.random.permutation(k, N)
+
+            def mb_step(carry_mb, env_idx):
+                params, opt_state = carry_mb
+                mb = {
+                    "carry0": jax.tree_util.tree_map(
+                        lambda c: c[env_idx], carry0),
+                    "obs": obs_t[:, env_idx],
+                    "resets": reset_t[:, env_idx],
+                    "actions": act_t[:, env_idx],
+                    "action_logp": logp_t[:, env_idx],
+                    "advantages": adv[:, env_idx],
+                    "value_targets": vtarg[:, env_idx],
+                }
+                (loss, aux), grads = jax.value_and_grad(
+                    seq_ppo_loss, has_aux=True)(params, mb)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, aux)
+
+            idxs = perm[: num_mb * envs_per_mb].reshape(num_mb, envs_per_mb)
+            (params, opt_state), (losses, auxes) = jax.lax.scan(
+                mb_step, (params, opt_state), idxs)
+            return (params, opt_state, rng), (losses.mean(),
+                                              {k_: v.mean() for k_, v
+                                               in auxes.items()})
+
+        (params, opt_state, rng), (losses, auxes) = jax.lax.scan(
+            sgd_epoch, (params, state.opt_state, rng), None,
+            length=config.num_sgd_iter)
+
+        new_state = RNNAnakinState(params, opt_state, env_states, obs,
+                                   carry, prev_done, rng, ep_ret, dsum,
+                                   dcnt)
+        metrics = {
+            "total_loss": losses.mean(),
+            "policy_loss": auxes["policy_loss"].mean(),
+            "vf_loss": auxes["vf_loss"].mean(),
+            "entropy": auxes["entropy"].mean(),
+            "episode_return_sum": dsum,
+            "episode_count": dcnt,
+        }
+        return new_state, metrics
+
+    return module, init_fn, jax.jit(train_step), N * T

@@ -42,7 +42,12 @@ class CartPole:
     def reset(self, rng) -> Tuple[Any, jax.Array]:
         core = jax.random.uniform(rng, (4,), minval=-0.05, maxval=0.05)
         state = {"core": core, "t": jnp.zeros((), jnp.int32)}
-        return state, core
+        return state, self._obs(core)
+
+    def _obs(self, core):
+        """Observation from the 4-dim physical core; the stateless variant
+        masks the velocity components here."""
+        return core
 
     def step(self, state, action, rng):
         x, x_dot, theta, theta_dot = state["core"]
@@ -72,7 +77,7 @@ class CartPole:
             "core": jnp.where(done, reset_state["core"], core),
             "t": jnp.where(done, reset_state["t"], t),
         }
-        obs = jnp.where(done, reset_obs, core)
+        obs = jnp.where(done, reset_obs, self._obs(core))
         return new_state, obs, reward, done, {}
 
 
@@ -216,6 +221,19 @@ class Breakout:
         return out_state, obs, reward, done, {}
 
 
+class StatelessCartPole(CartPole):
+    """CartPole with the velocity components hidden (obs = [x, theta]) —
+    the classic recurrent-policy testbed: a memoryless policy cannot infer
+    which way the pole is moving (reference:
+    rllib/examples/env/stateless_cartpole.py, re-derived for the jittable
+    env)."""
+
+    obs_dim = 2
+
+    def _obs(self, core):
+        return core[jnp.array([0, 2])]  # x, theta — drop the velocities
+
+
 class PendulumContinuous(Pendulum):
     """Pendulum-v1 with the real continuous torque action — the SAC-family
     env.  ``action`` is a float array of shape [action_dim] in
@@ -234,6 +252,7 @@ class PendulumContinuous(Pendulum):
 
 REGISTRY = {
     "CartPole-v1": CartPole,
+    "StatelessCartPole-v1": StatelessCartPole,
     "Pendulum-v1": Pendulum,
     "PendulumContinuous-v1": PendulumContinuous,
     "Breakout-MinAtar-v0": Breakout,
