@@ -186,6 +186,8 @@ class IMPALA(Algorithm):
         updates = 0
         while updates < target_updates:
             futs = list(self._inflight.values())
+            if not futs:
+                break
             ready, _ = ray_tpu.wait(futs, num_returns=1, timeout=120)
             if not ready:
                 break
@@ -195,6 +197,13 @@ class IMPALA(Algorithm):
             try:
                 batch, eps = ray_tpu.get(fut)
             except ray_tpu.exceptions.RayTpuError:
+                # Feed the FT manager (it may replace the worker), then
+                # re-seed any worker slot with nothing in flight so the
+                # pipeline never drains to empty.
+                self.workers.report_failure(worker)
+                for w in self.workers.workers:
+                    if w not in self._inflight:
+                        self._inflight[w] = w.sample_timemajor.remote()
                 continue
             ep_returns.extend(eps)
             metrics = self.learner.update(batch)

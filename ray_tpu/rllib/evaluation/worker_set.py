@@ -46,6 +46,9 @@ class RolloutWorker:
         self.params = params
         return True
 
+    def ping(self):
+        return "ok"
+
     def sample(self):
         """Returns (SampleBatch with GAE columns, completed episode returns)."""
         import jax
@@ -134,36 +137,107 @@ class RolloutWorker:
 
 
 class WorkerSet:
+    """Rollout workers behind a fault-tolerant actor manager (reference:
+    FaultTolerantActorManager, rllib/utils/actor_manager.py:157 — health
+    tracking, probing, and replacement of workers whose restart budget is
+    exhausted; num_healthy_workers surfaces in training metrics)."""
+
+    MAX_FAILURES_BEFORE_RECREATE = 2
+
     def __init__(self, config, module_spec):
+        self._config = config
+        self._module_spec = module_spec
         n = max(1, config.num_rollout_workers)
-        self.workers = [
-            RolloutWorker.options(max_restarts=1).remote(
-                config.env, module_spec, i, config.num_envs_per_worker,
-                config.rollout_fragment_length, config.gamma, config.lambda_,
-                config.seed)
-            for i in range(n)
-        ]
+        self.workers = [self._make_worker(i) for i in range(n)]
+        self._failures = [0] * n
         self._weights_ref = None
+
+    def _make_worker(self, i: int):
+        c = self._config
+        return RolloutWorker.options(max_restarts=1).remote(
+            c.env, self._module_spec, i, c.num_envs_per_worker,
+            c.rollout_fragment_length, c.gamma, c.lambda_, c.seed)
+
+    def _foreach(self, make_future) -> List[Tuple[int, Any]]:
+        """The ONE fault-handling loop: run `make_future(worker)` on every
+        worker, harvest results, reset the failure counter on success,
+        count failures (replacing exhausted workers), and restore weights
+        on replacements AFTER the harvest so one cold-starting actor never
+        stalls the others' results.  Returns (index, result) pairs for the
+        successes."""
+        futures = [(i, make_future(w)) for i, w in enumerate(self.workers)]
+        out: List[Tuple[int, Any]] = []
+        replaced: List[int] = []
+        for i, f in futures:
+            try:
+                out.append((i, ray_tpu.get(f)))
+                self._failures[i] = 0
+            except ray_tpu.exceptions.RayTpuError:
+                if self._count_failure(i):
+                    replaced.append(i)
+        self._restore_weights(replaced)
+        return out
+
+    def _count_failure(self, i: int) -> bool:
+        """Count a strike; past the budget, replace the actor entirely
+        (the reference recreates workers the restart policy gave up on).
+        Returns True when the worker was replaced."""
+        self._failures[i] += 1
+        if self._failures[i] < self.MAX_FAILURES_BEFORE_RECREATE:
+            return False  # the actor restart policy gets another chance
+        try:
+            ray_tpu.kill(self.workers[i])
+        except Exception:
+            pass
+        self.workers[i] = self._make_worker(i)
+        # One strike from another replacement until a success resets it —
+        # a worker that can't restore its weights must not look healthy.
+        self._failures[i] = self.MAX_FAILURES_BEFORE_RECREATE - 1
+        return True
+
+    def _restore_weights(self, indices: List[int]):
+        if not indices or self._weights_ref is None:
+            return
+        futures = [(i, self.workers[i].set_weights.remote(self._weights_ref))
+                   for i in indices]
+        for i, f in futures:
+            try:
+                ray_tpu.get(f)
+                self._failures[i] = 0
+            except ray_tpu.exceptions.RayTpuError:
+                self._count_failure(i)
+
+    def report_failure(self, worker):
+        """External samplers (IMPALA's async loop) report a dead handle
+        they harvested themselves."""
+        for i, w in enumerate(self.workers):
+            if w is worker:
+                if self._count_failure(i):
+                    self._restore_weights([i])
+                return
 
     def sync_weights(self, params):
         # One put, N borrowers — the object-store broadcast pattern the
         # reference uses for sync_weights.
         self._weights_ref = ray_tpu.put(params)
-        ray_tpu.get([w.set_weights.remote(self._weights_ref)
-                     for w in self.workers])
+        self._foreach(lambda w: w.set_weights.remote(self._weights_ref))
+
+    def probe_health(self) -> int:
+        """Ping every worker; failures feed the replacement policy.
+        Returns the number of currently-healthy workers."""
+        return len(self._foreach(lambda w: w.ping.remote()))
+
+    @property
+    def num_healthy_workers(self) -> int:
+        return sum(1 for n in self._failures if n == 0)
 
     def sample_sync(self) -> Tuple[List[Any], List[float]]:
         """synchronous_parallel_sample (reference:
         rllib/execution/rollout_ops.py:21) with dead-worker tolerance."""
-        futures = [w.sample.remote() for w in self.workers]
         batches, returns = [], []
-        for f in futures:
-            try:
-                b, eps = ray_tpu.get(f)
-                batches.append(b)
-                returns.extend(eps)
-            except ray_tpu.exceptions.RayTpuError:
-                continue  # dead worker; restart policy handles it
+        for _i, (b, eps) in self._foreach(lambda w: w.sample.remote()):
+            batches.append(b)
+            returns.extend(eps)
         return batches, returns
 
     def sample_async(self):

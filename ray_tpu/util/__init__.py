@@ -14,6 +14,7 @@ from ray_tpu.util.scheduling_strategies import (  # noqa: F401
 def __getattr__(name):
     import importlib
 
-    if name in ("collective", "actor_pool", "queue", "metrics", "iter"):
+    if name in ("collective", "actor_pool", "queue", "metrics", "iter",
+                "multiprocessing", "joblib"):
         return importlib.import_module(f"ray_tpu.util.{name}")
     raise AttributeError(name)

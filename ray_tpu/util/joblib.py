@@ -1,0 +1,70 @@
+"""joblib backend over cluster tasks.
+
+Reference: python/ray/util/joblib/__init__.py (register_ray) +
+ray_backend.py — a joblib ParallelBackend whose apply_async runs on the
+cluster, so sklearn-style `with parallel_backend("ray_tpu"): ...` code
+fans out without changes.
+"""
+from __future__ import annotations
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _run_batch(batch):
+    return batch()
+
+
+def register_ray_tpu():
+    """Register the 'ray_tpu' joblib parallel backend."""
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import ParallelBackendBase
+
+    import threading
+
+    class ImmediateResult:
+        def __init__(self, ref):
+            self._ref = ref
+
+        def get(self, timeout=None):
+            return ray_tpu.get(self._ref, timeout=timeout)
+
+    class RayTpuBackend(ParallelBackendBase):
+        supports_timeout = True
+        default_n_jobs = -1
+
+        def configure(self, n_jobs=1, parallel=None, **kw):
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            if n_jobs in (None, -1):
+                if not ray_tpu.is_initialized():
+                    ray_tpu.init()
+                return max(1, int(ray_tpu.cluster_resources()
+                                  .get("CPU", 1)))
+            return n_jobs
+
+        def apply_async(self, func, callback=None):
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            ref = _run_batch.remote(func)
+            if callback is not None:
+                # joblib's dispatch loop only hands out the next batch when
+                # a completion callback fires (pre_dispatch batching) — run
+                # it from a waiter thread like the pool backends do.
+                def waiter():
+                    try:
+                        callback(ray_tpu.get(ref))
+                    except Exception:
+                        pass  # errors re-raise from .get() in retrieve()
+
+                threading.Thread(target=waiter, daemon=True).start()
+            return ImmediateResult(ref)
+
+        def abort_everything(self, ensure_ready=True):
+            pass
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,138 @@
+"""multiprocessing.Pool API over cluster tasks.
+
+Reference: python/ray/util/multiprocessing/pool.py:276 (Pool mapping the
+stdlib surface onto remote tasks).  Drop-in subset: apply/apply_async,
+map/map_async, starmap, imap, imap_unordered, close/terminate/join, with
+chunking so small work items amortize per-task overhead.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _run_chunk(fn, chunk: List, star: bool):
+    if star:
+        return [fn(*args) for args in chunk]
+    return [fn(x) for x in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List, chunked: bool = True):
+        self._refs = refs
+        self._chunked = chunked
+
+    def get(self, timeout: Optional[float] = None):
+        parts = ray_tpu.get(self._refs, timeout=timeout)
+        if not self._chunked:
+            return parts[0][0]
+        return list(itertools.chain.from_iterable(parts))
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get()
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Stdlib-shaped process pool backed by the cluster scheduler; the
+    `processes` count only bounds chunking (placement is the scheduler's
+    job, matching the reference's semantics)."""
+
+    def __init__(self, processes: Optional[int] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            processes = max(1, int(ray_tpu.cluster_resources()
+                                   .get("CPU", 1)))
+        self._procs = processes
+        self._closed = False
+
+    # ---- apply ----
+    def apply(self, fn: Callable, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args=(), kwds=None) -> AsyncResult:
+        self._check_open()
+        kwds = kwds or {}
+        ref = _run_chunk.remote(lambda: fn(*args, **kwds), [()], True)
+        return AsyncResult([ref], chunked=False)
+
+    # ---- map ----
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._procs * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check_open()
+        refs = [_run_chunk.remote(fn, c, False)
+                for c in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_open()
+        refs = [_run_chunk.remote(fn, c, True)
+                for c in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1):
+        self._check_open()
+        refs = [_run_chunk.remote(fn, c, False)
+                for c in self._chunks(iterable, chunksize)]
+        for r in refs:
+            yield from ray_tpu.get(r)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1):
+        self._check_open()
+        refs = [_run_chunk.remote(fn, c, False)
+                for c in self._chunks(iterable, chunksize)]
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(ready[0])
+
+    # ---- lifecycle ----
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.terminate()

@@ -1,0 +1,100 @@
+"""multiprocessing.Pool / joblib shims + fault-tolerant WorkerSet
+(reference: python/ray/util/multiprocessing/pool.py, util/joblib/,
+rllib/utils/actor_manager.py FaultTolerantActorManager)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_pool_map_and_starmap(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(lambda x: x * x, range(20)) == [x * x
+                                                     for x in range(20)]
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+
+
+def test_pool_apply_and_async(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    p = Pool(processes=2)
+    assert p.apply(lambda a, b: a * b, (3, 4)) == 12
+    r = p.map_async(lambda x: x + 1, range(10))
+    assert r.get() == list(range(1, 11))
+    assert r.successful()
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(lambda x: x, [1])
+    p.join()
+
+
+def test_pool_imap_variants(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert list(p.imap(lambda x: -x, range(8), chunksize=3)) \
+            == [-x for x in range(8)]
+        assert sorted(p.imap_unordered(lambda x: -x, range(8),
+                                       chunksize=3)) \
+            == sorted(-x for x in range(8))
+
+
+def test_joblib_backend(cluster):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(lambda x: x ** 2)(i) for i in range(16))
+    assert out == [i ** 2 for i in range(16)]
+
+
+def test_worker_set_replaces_dead_workers(cluster):
+    """FT manager: a worker killed beyond its restart budget is replaced
+    and gets the current weights (reference: FaultTolerantActorManager
+    restored_actors + probe_unhealthy_actors)."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+
+    cfg = (PPOConfig().environment("CartPole-v1")
+           .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                     rollout_fragment_length=16))
+    spec = RLModuleSpec(obs_dim=4, num_actions=2, hiddens=(16,))
+    ws = WorkerSet(cfg, spec)
+    module = spec.build()
+    import jax
+
+    params = module.init(jax.random.PRNGKey(0),
+                         np.zeros((1, 4), np.float32))
+    ws.sync_weights(params)
+    batches, _ = ws.sample_sync()
+    assert len(batches) == 2
+
+    # Kill worker 0 hard (no restart) — the manager must replace it.
+    ray_tpu.kill(ws.workers[0])
+    time.sleep(0.2)
+    old = ws.workers[0]
+    for _ in range(WorkerSet.MAX_FAILURES_BEFORE_RECREATE + 1):
+        ws.probe_health()
+        time.sleep(0.1)
+    assert ws.workers[0] is not old, "dead worker was never replaced"
+    deadline = time.time() + 30
+    batches = []
+    while time.time() < deadline and len(batches) < 2:
+        batches, _ = ws.sample_sync()
+    assert len(batches) == 2, "replacement worker never sampled"
+    ws.stop()
