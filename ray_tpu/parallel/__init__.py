@@ -34,3 +34,13 @@ from ray_tpu.parallel.mesh_group import (  # noqa: F401
     bootstrap_jax_distributed,
     rendezvous,
 )
+
+
+def __getattr__(name):
+    # mpmd_pipeline spawns actors on import-site use; keep it lazy so
+    # `import ray_tpu.parallel` stays runtime-free.
+    if name in ("MPMDPipeline", "PipelineStage"):
+        from ray_tpu.parallel import mpmd_pipeline
+
+        return getattr(mpmd_pipeline, name)
+    raise AttributeError(name)

@@ -1,0 +1,193 @@
+"""MPMD pipeline parallelism: stages as separate processes, each with its
+own device mesh, activations flowing through the object store.
+
+This is the second pipeline form SURVEY §7.8 calls for, layered on the
+actor runtime (the first — intra-mesh SPMD GPipe via shard_map/ppermute —
+is parallel/pipeline.py).  Reference substrate: placement groups +
+collective send/recv between actors; the MPMD schedule itself follows the
+GPipe paper (PAPERS.md) — no reference-code counterpart exists.
+
+Design:
+
+- Each ``PipelineStage`` is an actor owning one stage's params and (on a
+  pod) one process group's chips.  Stage k's forward keeps its VJP
+  residuals per-microbatch ON the actor, so backward needs only the
+  upstream cotangent: nothing but [mb, ...] activation tensors ever
+  crosses processes, and those ride the zero-copy object store.
+- The driver runs the GPipe schedule by CHAINING OBJECT REFS: stage k's
+  forward output ref is passed directly as stage k+1's input, so
+  activations move store-to-store without touching the driver, and the
+  scheduler's locality rules keep the transfer on-node where possible.
+- Backward replays the chain in reverse via the stored residuals; each
+  stage accumulates grads over microbatches and steps its own optimizer
+  (optax) locally — exactly the per-stage-optimizer layout a multi-mesh
+  pipeline wants (no global allreduce across stages).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class PipelineStage:
+    """One pipeline stage process.
+
+    stage_fn(params, x) -> y for middle stages; the LAST stage's fn is
+    ``loss_fn(params, x, target) -> scalar loss``.
+    """
+
+    def __init__(self, stage_fn: Callable, init_params: Any,
+                 optimizer=None):
+        # Device placement is the runtime's job, not this actor's: a
+        # pooled worker may already have jax imported (platform config
+        # frozen), so JAX_PLATFORMS/XLA_FLAGS set here would silently
+        # no-op.  On hardware, the raylet's per-worker TPU chip
+        # partitioning (TPU_VISIBLE_CHIPS at spawn) gives each stage its
+        # chips; in tests the conftest's CPU-mesh env does.
+        import jax
+        import optax
+
+        self._jax = jax
+        self.fn = stage_fn
+        self.params = init_params
+        self.tx = optimizer or optax.sgd(1e-2)
+        self.opt_state = self.tx.init(self.params)
+        self._residuals: dict = {}
+        self._grad_accum = None
+
+    # ---- schedule ops ----
+    def forward(self, mb_id: int, x, target=None):
+        """Run this stage on one microbatch; keep the VJP closure local.
+        Returns the activation (middle) or the loss value (last)."""
+        args = (x,) if target is None else (x, target)
+        y, vjp_fn = self._jax.vjp(self.fn, self.params, *args)
+        self._residuals[mb_id] = vjp_fn
+        return np.asarray(self._jax.device_get(y))
+
+    def backward(self, mb_id: int, dy=None):
+        """Consume the stored residuals: returns the cotangent to ship
+        upstream; grads accumulate locally."""
+        vjp_fn = self._residuals.pop(mb_id)
+        if dy is None:  # last stage: d(loss)/d(loss) = 1
+            dy = np.float32(1.0)
+        cotangents = vjp_fn(self._jax.numpy.asarray(dy))
+        dparams, dx = cotangents[0], cotangents[1]
+        if self._grad_accum is None:
+            self._grad_accum = dparams
+        else:
+            self._grad_accum = self._jax.tree_util.tree_map(
+                lambda a, b: a + b, self._grad_accum, dparams)
+        return np.asarray(self._jax.device_get(dx))
+
+    def apply_grads(self, scale: float = 1.0):
+        """Optimizer step on the accumulated microbatch grads."""
+        import optax
+
+        grads = self._jax.tree_util.tree_map(
+            lambda g: g * scale, self._grad_accum)
+        updates, self.opt_state = self.tx.update(grads, self.opt_state,
+                                                 self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self._grad_accum = None
+        return True
+
+    def reset(self):
+        """Drop partial schedule state after a failed step — stale grad
+        accumulations must not leak into the next optimizer update."""
+        self._residuals.clear()
+        self._grad_accum = None
+        return True
+
+    def get_params(self):
+        return self._jax.device_get(self.params)
+
+    def set_params(self, params):
+        self.params = params
+        self.opt_state = self.tx.init(self.params)
+        return True
+
+
+class MPMDPipeline:
+    """Driver-side GPipe schedule over stage actors.
+
+    ``stage_fns``: list of callables; the last must be
+    loss_fn(params, x, target) -> scalar.  ``init_params``: per-stage
+    pytrees.
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable],
+                 init_params: Sequence[Any], optimizer=None,
+                 num_microbatches: int = 4,
+                 stage_options: Optional[List[dict]] = None):
+        n = len(stage_fns)
+        if len(init_params) != n:
+            raise ValueError("one params pytree per stage")
+        self.num_stages = n
+        self.num_microbatches = num_microbatches
+        opts = stage_options or [{} for _ in range(n)]
+        self.stages = [
+            PipelineStage.remote(stage_fns[k], init_params[k],
+                                 optimizer=optimizer, **opts[k])
+            for k in range(n)
+        ]
+
+    def train_step(self, x: np.ndarray, target: np.ndarray) -> float:
+        """One GPipe step: forward all microbatches through the stage
+        chain (refs chain store-to-store), backward in reverse, then every
+        stage steps its optimizer.  Returns the mean microbatch loss."""
+        M = self.num_microbatches
+        if len(x) < M:
+            raise ValueError(
+                f"batch of {len(x)} rows cannot fill num_microbatches={M} "
+                "(an empty microbatch means a NaN loss, not an error)")
+        xs = np.array_split(x, M)
+        ts = np.array_split(target, M)
+        try:
+            # Forward: chain refs so activations never visit the driver.
+            loss_refs = []
+            for m in range(M):
+                act = xs[m]
+                for k, stage in enumerate(self.stages):
+                    if k == self.num_stages - 1:
+                        act = stage.forward.remote(m, act, ts[m])
+                    else:
+                        act = stage.forward.remote(m, act)
+                loss_refs.append(act)
+            losses = ray_tpu.get(loss_refs)
+            # Backward: reverse chain; cotangents flow downstream→upstream.
+            done = []
+            for m in range(M):
+                dy = None
+                for k in range(self.num_stages - 1, -1, -1):
+                    if dy is None:
+                        dy = self.stages[k].backward.remote(m)
+                    else:
+                        dy = self.stages[k].backward.remote(m, dy)
+                done.append(dy)
+            ray_tpu.get(done)  # barrier: all residuals consumed
+            ray_tpu.get([s.apply_grads.remote(1.0 / M)
+                         for s in self.stages])
+        except Exception:
+            # A failed step leaves partial residuals/grad accumulations on
+            # the stages; drop them so a retry doesn't double-apply.
+            for s in self.stages:
+                try:
+                    ray_tpu.get(s.reset.remote())
+                except Exception:
+                    pass
+            raise
+        return float(np.mean(losses))
+
+    def get_params(self) -> List[Any]:
+        return ray_tpu.get([s.get_params.remote() for s in self.stages])
+
+    def stop(self):
+        for s in self.stages:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
