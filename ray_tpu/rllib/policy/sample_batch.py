@@ -2,7 +2,7 @@
 rllib/policy/sample_batch.py:96; MultiAgentBatch :1218)."""
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -41,7 +41,14 @@ class SampleBatch(dict):
     def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
         if not batches:
             return SampleBatch()
-        keys = batches[0].keys()
+        keys = set(batches[0].keys())
+        for b in batches[1:]:
+            if set(b.keys()) != keys:
+                # Loud, not silent: dropping the odd column loses training
+                # data; indexing it would KeyError mid-concatenate.
+                raise ValueError(
+                    "concat_samples requires identical columns; got "
+                    f"{sorted(keys)} vs {sorted(b.keys())}")
         return SampleBatch({
             k: np.concatenate([b[k] for b in batches]) for k in keys})
 
@@ -73,8 +80,52 @@ class SampleBatch(dict):
 
         return {k: jnp.asarray(v) for k, v in self.items()}
 
+    # ---- sequence support (reference: SampleBatch.seq_lens +
+    # rllib/policy/rnn_sequencing.py pad_batch_to_sequences_of_same_size) ----
+    def to_sequences(self, max_seq_len: int,
+                     states: Optional[List[str]] = None
+                     ) -> "SampleBatch":
+        """Chunk episodes into sequences of <= max_seq_len, pad to the
+        fixed length, and add a ``seq_lens`` column.  Output columns have
+        shape [num_seqs, max_seq_len, ...] (zero-padded); state columns
+        (if named) keep only each sequence's FIRST row ([num_seqs, ...]) —
+        the reference's state_in semantics.  The fixed [S, T, ...] layout
+        is what a jit-compiled recurrent loss wants: one compilation for
+        every batch."""
+        states = states or []
+        seqs: List[SampleBatch] = []
+        for ep in self.split_by_episode():
+            for s in range(0, len(ep), max_seq_len):
+                seqs.append(ep.slice(s, min(s + max_seq_len, len(ep))))
+        if not seqs:
+            return SampleBatch({"seq_lens": np.zeros((0,), np.int32)})
+        out: Dict[str, np.ndarray] = {}
+        for k in seqs[0].keys():
+            if k in states:
+                out[k] = np.stack([sq[k][0] for sq in seqs])
+                continue
+            first = np.asarray(seqs[0][k])
+            padded = np.zeros((len(seqs), max_seq_len) + first.shape[1:],
+                              first.dtype)
+            for i, sq in enumerate(seqs):
+                padded[i, : len(sq)] = sq[k]
+            out[k] = padded
+        out["seq_lens"] = np.asarray([len(sq) for sq in seqs], np.int32)
+        return SampleBatch(out)
+
+    @staticmethod
+    def sequence_mask(seq_lens: np.ndarray, max_seq_len: int) -> np.ndarray:
+        """[S, T] 0/1 mask from seq_lens — multiply into per-step losses
+        so padding contributes nothing."""
+        return (np.arange(max_seq_len)[None, :]
+                < np.asarray(seq_lens)[:, None]).astype(np.float32)
+
 
 class MultiAgentBatch:
+    """Per-policy batches (reference: policy/sample_batch.py
+    MultiAgentBatch — concat, timeslice, and the agent→policy grouping
+    builder the rollout path uses)."""
+
     def __init__(self, policy_batches: Dict[str, SampleBatch], env_steps: int):
         self.policy_batches = policy_batches
         self._env_steps = env_steps
@@ -84,3 +135,34 @@ class MultiAgentBatch:
 
     def agent_steps(self) -> int:
         return sum(len(b) for b in self.policy_batches.values())
+
+    @staticmethod
+    def from_agent_batches(agent_batches: Dict[Any, SampleBatch],
+                           policy_mapping_fn: Callable[[Any], str],
+                           env_steps: int) -> "MultiAgentBatch":
+        """Group per-agent batches under their policies (the
+        policy_mapping_fn contract; shared-policy training maps every
+        agent to one id)."""
+        grouped: Dict[str, List[SampleBatch]] = {}
+        for agent_id, batch in agent_batches.items():
+            grouped.setdefault(policy_mapping_fn(agent_id), []).append(batch)
+        return MultiAgentBatch(
+            {pid: SampleBatch.concat_samples(bs)
+             for pid, bs in grouped.items()}, env_steps)
+
+    @staticmethod
+    def concat_samples(batches: List["MultiAgentBatch"]
+                       ) -> "MultiAgentBatch":
+        policies: Dict[str, List[SampleBatch]] = {}
+        steps = 0
+        for mb in batches:
+            steps += mb.env_steps()
+            for pid, b in mb.policy_batches.items():
+                policies.setdefault(pid, []).append(b)
+        return MultiAgentBatch(
+            {pid: SampleBatch.concat_samples(bs)
+             for pid, bs in policies.items()}, steps)
+
+    def __repr__(self):
+        sizes = {p: len(b) for p, b in self.policy_batches.items()}
+        return f"MultiAgentBatch(env_steps={self._env_steps}, {sizes})"

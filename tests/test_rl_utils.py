@@ -119,3 +119,67 @@ def test_runtime_env_env_vars_do_not_leak(ray_start_regular):
     # Run enough bare tasks that at least one reuses the mutated worker.
     results = ray_tpu.get([without_flag.remote() for _ in range(16)])
     assert all(r is None for r in results)
+
+
+def test_sample_batch_to_sequences_and_mask():
+    """seq_lens chunking/padding (reference: rnn_sequencing.py
+    pad_batch_to_sequences_of_same_size)."""
+    import numpy as np
+
+    from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+    b = SampleBatch({
+        "eps_id": np.array([0, 0, 0, 0, 0, 1, 1, 2]),
+        "obs": np.arange(16, dtype=np.float32).reshape(8, 2),
+        "state_h": np.arange(8, dtype=np.float32),
+    })
+    seqs = b.to_sequences(max_seq_len=3, states=["state_h"])
+    # ep0 (5 rows) -> [3, 2]; ep1 (2) -> [2]; ep2 (1) -> [1]
+    np.testing.assert_array_equal(seqs["seq_lens"], [3, 2, 2, 1])
+    assert seqs["obs"].shape == (4, 3, 2)
+    np.testing.assert_array_equal(seqs["obs"][0], b["obs"][0:3])
+    np.testing.assert_array_equal(seqs["obs"][1][:2], b["obs"][3:5])
+    assert seqs["obs"][1][2].sum() == 0  # padded
+    # state columns keep only each sequence's first row
+    np.testing.assert_array_equal(seqs["state_h"], [0, 3, 5, 7])
+    mask = SampleBatch.sequence_mask(seqs["seq_lens"], 3)
+    np.testing.assert_array_equal(
+        mask, [[1, 1, 1], [1, 1, 0], [1, 1, 0], [1, 0, 0]])
+
+
+def test_multi_agent_batch_builders():
+    import numpy as np
+
+    from ray_tpu.rllib.policy.sample_batch import (
+        MultiAgentBatch, SampleBatch)
+
+    a0 = SampleBatch({"obs": np.ones((3, 2)), "rewards": np.ones(3)})
+    a1 = SampleBatch({"obs": np.zeros((2, 2)), "rewards": np.zeros(2)})
+    mb = MultiAgentBatch.from_agent_batches(
+        {"agent_0": a0, "agent_1": a1},
+        policy_mapping_fn=lambda aid: "shared", env_steps=3)
+    assert list(mb.policy_batches) == ["shared"]
+    assert len(mb.policy_batches["shared"]) == 5
+    assert mb.agent_steps() == 5 and mb.env_steps() == 3
+
+    mb2 = MultiAgentBatch.from_agent_batches(
+        {"agent_0": a0, "agent_1": a1},
+        policy_mapping_fn=lambda aid: aid, env_steps=3)
+    both = MultiAgentBatch.concat_samples([mb2, mb2])
+    assert both.env_steps() == 6
+    assert len(both.policy_batches["agent_0"]) == 6
+    assert len(both.policy_batches["agent_1"]) == 4
+
+
+def test_concat_samples_rejects_mismatched_columns():
+    import numpy as np
+    import pytest
+
+    from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+    a = SampleBatch({"obs": np.ones(3), "extra": np.ones(3)})
+    b = SampleBatch({"obs": np.ones(2)})
+    with pytest.raises(ValueError, match="identical columns"):
+        SampleBatch.concat_samples([a, b])
+    with pytest.raises(ValueError, match="identical columns"):
+        SampleBatch.concat_samples([b, a])
