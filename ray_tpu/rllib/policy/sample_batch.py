@@ -97,8 +97,17 @@ class SampleBatch(dict):
         for ep in self.split_by_episode():
             for s in range(0, len(ep), max_seq_len):
                 seqs.append(ep.slice(s, min(s + max_seq_len, len(ep))))
-        if not seqs:
-            return SampleBatch({"seq_lens": np.zeros((0,), np.int32)})
+        if not seqs or all(len(sq) == 0 for sq in seqs):
+            # Keep the schema: empty [0, T, ...] columns compose with
+            # non-empty sequence batches (concat) instead of key-erroring.
+            out = {}
+            for k, v in self.items():
+                v = np.asarray(v)
+                out[k] = (np.zeros((0,) + v.shape[1:], v.dtype)
+                          if k in states else
+                          np.zeros((0, max_seq_len) + v.shape[1:], v.dtype))
+            out["seq_lens"] = np.zeros((0,), np.int32)
+            return SampleBatch(out)
         out: Dict[str, np.ndarray] = {}
         for k in seqs[0].keys():
             if k in states:

@@ -183,3 +183,22 @@ def test_concat_samples_rejects_mismatched_columns():
         SampleBatch.concat_samples([a, b])
     with pytest.raises(ValueError, match="identical columns"):
         SampleBatch.concat_samples([b, a])
+
+
+def test_to_sequences_empty_batch_keeps_schema():
+    import numpy as np
+
+    from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+    empty = SampleBatch({"obs": np.zeros((0, 2), np.float32),
+                         "state_h": np.zeros((0, 4), np.float32)})
+    seqs = empty.to_sequences(max_seq_len=4, states=["state_h"])
+    assert seqs["obs"].shape == (0, 4, 2)
+    assert seqs["state_h"].shape == (0, 4)
+    assert seqs["seq_lens"].shape == (0,)
+    # Composes with a non-empty sequence batch.
+    full = SampleBatch({"obs": np.ones((3, 2), np.float32),
+                        "state_h": np.ones((3, 4), np.float32)})
+    fseqs = full.to_sequences(max_seq_len=4, states=["state_h"])
+    both = SampleBatch.concat_samples([seqs, fseqs])
+    assert both["obs"].shape == (1, 4, 2)
