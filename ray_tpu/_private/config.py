@@ -140,4 +140,7 @@ CONFIG \
     .declare("task_event_buffer_size", int, 10000,
              "Max task events retained for the state API.") \
     .declare("gcs_snapshot_period_s", float, 0.0,
-             "Persist GCS tables every N seconds (0 = disabled).")
+             "Persist GCS tables every N seconds (0 = disabled).") \
+    .declare("tracing_enabled", bool, False,
+             "Instrument task submit/execute with OpenTelemetry spans "
+             "(API-only; wire a TracerProvider to export).")

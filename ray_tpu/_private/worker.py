@@ -9,6 +9,7 @@ Python-side execute_task loop (python/ray/_raylet.pyx:701).
 """
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import threading
 import traceback
@@ -31,6 +32,20 @@ from ray_tpu._private.task_spec import (
     TaskType,
 )
 from ray_tpu.object_ref import ObjectRef
+
+
+_tracing_mod = None
+
+
+def _tracing():
+    """Lazy tracing-module accessor: imported at first use, not module
+    scope (ray_tpu.util imports back into ray_tpu during bootstrap)."""
+    global _tracing_mod
+    if _tracing_mod is None:
+        from ray_tpu.util import tracing as _t
+
+        _tracing_mod = _t
+    return _tracing_mod
 
 
 # ---------------------------------------------------------------------------
@@ -609,14 +624,20 @@ class CoreWorker:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
-        self.transport.request_oneway("submit", {"spec": spec})
+        tr = _tracing()
+        with (tr.span("task.submit", task_name=spec.name)
+              if tr.tracing_enabled() else contextlib.nullcontext()):
+            self.transport.request_oneway("submit", {"spec": spec})
         return refs
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
-        self.transport.request_oneway("actor_call", {"spec": spec})
+        tr = _tracing()
+        with (tr.span("actor_task.submit", task_name=spec.name)
+              if tr.tracing_enabled() else contextlib.nullcontext()):
+            self.transport.request_oneway("actor_call", {"spec": spec})
         return refs
 
     # ---- function resolution ----
@@ -654,23 +675,29 @@ class CoreWorker:
                 _env_overlay.apply(env_vars)
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(a) for k, a in spec.kwargs.items()}
-            if spec.task_type == TaskType.NORMAL:
-                fn = self.load_function(spec.func_blob, spec.func_hash)
-                out = fn(*args, **kwargs)
-            elif spec.task_type == TaskType.ACTOR_CREATION:
-                cls = self.load_function(spec.func_blob, spec.func_hash)
-                self.actors[spec.actor_id] = cls(*args, **kwargs)
-                out = None
-            elif spec.task_type == TaskType.ACTOR_TASK:
-                instance = self.actors.get(spec.actor_id)
-                if instance is None:
-                    raise exc.ActorDiedError("actor instance not found on worker")
-                method = getattr(instance, spec.method_name)
-                out = method(*args, **kwargs)
-                if _is_coroutine(out):
-                    out = _run_coroutine(out)
-            else:
-                raise exc.RayTpuError(f"bad task type {spec.task_type}")
+            tr = _tracing()
+            with (tr.span("task.execute", task_name=spec.name,
+                          task_type=spec.task_type.name,
+                          task_id=spec.task_id.hex())
+                  if tr.tracing_enabled() else contextlib.nullcontext()):
+                if spec.task_type == TaskType.NORMAL:
+                    fn = self.load_function(spec.func_blob, spec.func_hash)
+                    out = fn(*args, **kwargs)
+                elif spec.task_type == TaskType.ACTOR_CREATION:
+                    cls = self.load_function(spec.func_blob, spec.func_hash)
+                    self.actors[spec.actor_id] = cls(*args, **kwargs)
+                    out = None
+                elif spec.task_type == TaskType.ACTOR_TASK:
+                    instance = self.actors.get(spec.actor_id)
+                    if instance is None:
+                        raise exc.ActorDiedError(
+                            "actor instance not found on worker")
+                    method = getattr(instance, spec.method_name)
+                    out = method(*args, **kwargs)
+                    if _is_coroutine(out):
+                        out = _run_coroutine(out)
+                else:
+                    raise exc.RayTpuError(f"bad task type {spec.task_type}")
             results = self._store_returns(spec, out)
         except BaseException as e:  # noqa: BLE001 — errors are task results
             error_str = traceback.format_exc()

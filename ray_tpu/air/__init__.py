@@ -7,6 +7,7 @@ from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,
     RunConfig,
+    SyncConfig,
     ScalingConfig,
 )
 from ray_tpu.air.result import Result  # noqa: F401

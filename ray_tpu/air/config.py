@@ -60,3 +60,12 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
+    # Mirror the experiment dir to durable storage after each state save
+    # (reference: SyncConfig/Syncer, python/ray/tune/syncer.py).
+    sync_config: Optional["SyncConfig"] = None
+
+
+@dataclasses.dataclass
+class SyncConfig:
+    upload_dir: Optional[str] = None
+    sync_period_s: float = 0.0  # 0 = sync on every experiment-state save

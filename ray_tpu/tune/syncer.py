@@ -1,0 +1,53 @@
+"""Experiment-dir syncer: mirror trial/experiment state to durable
+storage (reference: python/ray/tune/syncer.py — the _DefaultSyncer that
+uploads the experiment dir; cloud URIs reduce to a local mount here, the
+honest scope for a zero-egress environment)."""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Optional
+
+
+class Syncer:
+    def __init__(self, upload_dir: str, sync_period_s: float = 0.0):
+        self.upload_dir = upload_dir
+        self.sync_period_s = sync_period_s
+        self._last_sync = 0.0
+
+    def sync_if_due(self, exp_dir: str):
+        if self.sync_period_s > 0 and \
+                time.time() - self._last_sync < self.sync_period_s:
+            return False
+        self.sync_now(exp_dir)
+        return True
+
+    def sync_now(self, exp_dir: str):
+        """Incremental mirror: copy files whose mtime/size changed."""
+        dst_root = os.path.join(self.upload_dir,
+                                os.path.basename(exp_dir.rstrip("/")))
+        for root, _dirs, files in os.walk(exp_dir):
+            rel = os.path.relpath(root, exp_dir)
+            dst_dir = os.path.join(dst_root, rel) if rel != "." else dst_root
+            os.makedirs(dst_dir, exist_ok=True)
+            for name in files:
+                if name.endswith(".tmp"):
+                    continue  # in-flight atomic writes
+                src = os.path.join(root, name)
+                dst = os.path.join(dst_dir, name)
+                try:
+                    s = os.stat(src)
+                    if os.path.exists(dst):
+                        d = os.stat(dst)
+                        # Nanosecond mtimes: a same-size rewrite (e.g. the
+                        # final save flipping one pickled bool) still gets
+                        # a fresh mtime_ns from os.replace, so it syncs;
+                        # second-granularity st_mtime would skip it.
+                        if d.st_mtime_ns >= s.st_mtime_ns \
+                                and d.st_size == s.st_size:
+                            continue
+                    shutil.copy2(src, dst)
+                except OSError:
+                    continue  # file vanished mid-walk; next sync catches it
+        self._last_sync = time.time()

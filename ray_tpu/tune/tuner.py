@@ -164,6 +164,20 @@ class Tuner:
                     f.write(blob)
             except Exception:
                 pass  # restore() then requires trainable= to be passed
+        # Mirror LAST, after trainable.pkl exists — a crash between the
+        # first sync and the next must not leave a durable copy that
+        # restore() can't rebuild from.
+        sync_cfg = getattr(self.run_config, "sync_config", None)
+        if sync_cfg is not None and sync_cfg.upload_dir:
+            from ray_tpu.tune.syncer import Syncer
+
+            if getattr(self, "_syncer", None) is None:
+                self._syncer = Syncer(sync_cfg.upload_dir,
+                                      sync_cfg.sync_period_s)
+            if final:
+                self._syncer.sync_now(exp_dir)
+            else:
+                self._syncer.sync_if_due(exp_dir)
 
     @classmethod
     def restore(cls, path: str, trainable: Optional[Callable] = None,

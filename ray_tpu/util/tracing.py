@@ -1,0 +1,85 @@
+"""OpenTelemetry tracing integration.
+
+Reference: python/ray/util/tracing/tracing_helper.py — the runtime is
+instrumented against the opentelemetry *API* (present in this image);
+span data goes wherever the application's TracerProvider sends it, so
+wiring an SDK/exporter is the user's call exactly as in the reference
+(`ray.init(_tracing_startup_hook=...)`).  Without a provider the API's
+no-op tracer makes every span free.
+
+Surface:
+- ``enable_tracing()`` / ``tracing_enabled()`` — process-local switch
+  (also on via the ``tracing_enabled`` config flag / RAY_TPU_TRACING_ENABLED).
+- ``span(name, **attrs)`` — context manager used at the runtime's
+  instrumentation points (task submit, task execute, actor calls).
+- Spans ALSO land in a process-local buffer (``pop_local_spans``) so
+  `ray_tpu.timeline()`-style tooling sees them even with no SDK.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_enabled: Optional[bool] = None
+_local_spans: List[Dict[str, Any]] = []
+_MAX_LOCAL_SPANS = 10_000
+
+
+def enable_tracing():
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing():
+    global _enabled
+    _enabled = False
+
+
+def tracing_enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        from ray_tpu._private.config import CONFIG
+
+        _enabled = bool(CONFIG.tracing_enabled)
+    return _enabled
+
+
+def _tracer():
+    try:
+        from opentelemetry import trace
+
+        return trace.get_tracer("ray_tpu")
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    """Instrumentation point: otel span (no-op without a provider) plus a
+    local record for timeline tooling."""
+    if not tracing_enabled():
+        yield
+        return
+    t0 = time.time()
+    tracer = _tracer()
+    ctx = (tracer.start_as_current_span(name, attributes=attributes)
+           if tracer is not None else contextlib.nullcontext())
+    try:
+        with ctx:
+            yield
+    finally:
+        rec = {"name": name, "start": t0, "end": time.time(),
+               "attributes": attributes}
+        with _lock:
+            _local_spans.append(rec)
+            if len(_local_spans) > _MAX_LOCAL_SPANS:
+                del _local_spans[: len(_local_spans) - _MAX_LOCAL_SPANS]
+
+
+def pop_local_spans() -> List[Dict[str, Any]]:
+    with _lock:
+        out, _local_spans[:] = list(_local_spans), []
+        return out
