@@ -221,6 +221,82 @@ class _EnvOverlay:
 _env_overlay = _EnvOverlay()
 
 
+class _WorkingDirOverlay:
+    """runtime_env working_dir (reference: the working_dir plugin,
+    python/ray/_private/runtime_env/working_dir.py — there the dir is
+    uploaded to GCS and extracted per node; on this single-host plane the
+    path is already local, so the overlay is chdir + sys.path).  Refcounted
+    like _EnvOverlay: concurrent tasks with the same working_dir share one
+    activation; mismatched concurrent dirs raise (one process, one cwd)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: Optional[str] = None
+        self._count = 0
+        self._orig_cwd: Optional[str] = None
+
+    def apply(self, working_dir: str):
+        import os
+        import sys
+
+        with self._lock:
+            path = os.path.abspath(working_dir)
+            if not os.path.isdir(path):
+                raise FileNotFoundError(
+                    f"runtime_env working_dir {working_dir!r} does not "
+                    "exist on this node")
+            if self._count and self._active != path:
+                raise RuntimeError(
+                    "concurrent tasks with different working_dirs on one "
+                    f"worker ({self._active} vs {path}); use separate "
+                    "actors or max_concurrency=1")
+            if self._count == 0:
+                self._orig_cwd = os.getcwd()
+                os.chdir(path)
+                sys.path.insert(0, path)
+                self._active = path
+            self._count += 1
+
+    def restore(self):
+        import os
+        import sys
+
+        with self._lock:
+            if self._count == 0:
+                return
+            self._count -= 1
+            if self._count == 0:
+                try:
+                    sys.path.remove(self._active)
+                except ValueError:
+                    pass
+                # Evict modules imported FROM the working_dir: a later task
+                # (same pooled worker, different dir) must not hit a stale
+                # sys.modules cache for a same-named module.
+                prefix = self._active + os.sep
+                for name, mod in list(sys.modules.items()):
+                    mod_file = getattr(mod, "__file__", None) or ""
+                    if mod_file.startswith(prefix):
+                        sys.modules.pop(name, None)
+                try:
+                    os.chdir(self._orig_cwd)
+                except OSError:
+                    pass
+                self._active = None
+
+    def adopt(self):
+        """Actor-creation: the working_dir stays for the actor's life —
+        leave cwd/sys.path as applied, drop the refcount bookkeeping."""
+        with self._lock:
+            self._count = max(self._count - 1, 0)
+            if self._count == 0:
+                self._active = None
+                self._orig_cwd = None
+
+
+_workdir_overlay = _WorkingDirOverlay()
+
+
 def _arena_lease_releaser(transport, oid_bin: bytes, holder_bin: bytes):
     """Standalone finalizer (must not capture the buffer owner) that returns
     this process's reader lease on an arena object to the head."""
@@ -662,6 +738,7 @@ class CoreWorker:
         error_str = None
         results: List[TaskResult] = []
         env_vars: Dict[str, Any] = {}
+        workdir_applied = False
         try:
             # Runtime env (lite): per-task/actor env vars (reference:
             # python/ray/_private/runtime_env/ plugin architecture; the
@@ -673,6 +750,17 @@ class CoreWorker:
                 # does not leak into the next (the reference instead
                 # dedicates workers to a runtime env).
                 _env_overlay.apply(env_vars)
+            working_dir = (spec.runtime_env or {}).get("working_dir")
+            if working_dir:
+                _workdir_overlay.apply(working_dir)
+                workdir_applied = True
+            unsupported = set(spec.runtime_env or {}) - {
+                "env_vars", "working_dir"}
+            if unsupported:
+                raise exc.RayTpuError(
+                    f"runtime_env fields {sorted(unsupported)} are not "
+                    "supported (pip/conda need package egress; this "
+                    "environment has none)")
             args = [self._resolve_arg(a) for a in spec.args]
             kwargs = {k: self._resolve_arg(a) for k, a in spec.kwargs.items()}
             tr = _tracing()
@@ -713,6 +801,14 @@ class CoreWorker:
                     _env_overlay.adopt(env_vars)
                 else:
                     _env_overlay.restore(env_vars)
+            if workdir_applied:
+                # Only rebalance if apply() actually incremented the
+                # count — a failed apply must not decrement a concurrent
+                # holder's activation.
+                if spec.task_type == TaskType.ACTOR_CREATION:
+                    _workdir_overlay.adopt()
+                else:
+                    _workdir_overlay.restore()
             self.ctx.task_id = None
         return {
             "type": "task_done",

@@ -178,6 +178,16 @@ _AXIS_BY_NAME: Dict[str, tuple] = {
     "moe_router": ("embed", None),
     "moe_w_in": ("expert", "embed", "mlp"),
     "moe_w_out": ("expert", "mlp", "embed_fsdp"),
+    # Llama family (models/llama.py) — same logical axes, llama names.
+    "embed/embedding": ("vocab", "embed"),
+    "q_proj/kernel": ("embed", "heads"),
+    "k_proj/kernel": ("embed", "heads"),
+    "v_proj/kernel": ("embed", "heads"),
+    "o_proj/kernel": ("heads", "embed_fsdp"),
+    "gate_proj/kernel": ("embed", "mlp"),
+    "up_proj/kernel": ("embed", "mlp"),
+    "down_proj/kernel": ("mlp", "embed_fsdp"),
+    "lm_head/kernel": ("embed", "vocab"),
 }
 
 

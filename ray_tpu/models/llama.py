@@ -1,0 +1,180 @@
+"""Llama-family decoder in flax, TPU-first.
+
+Second LM family beside GPT-2 (models/gpt2.py): the modern pre-norm
+decoder recipe — RMSNorm, rotary position embeddings, grouped-query
+attention, SwiGLU MLP, no biases, weights untied from the embedding.  The
+reference framework ships no model implementations (its LM benchmarks
+wrap HuggingFace torch through TorchTrainer, python/ray/train/
+huggingface/); this is a ground-up jax design sharing the GPT-2 module's
+conventions:
+
+- bfloat16 activations / fp32 params via ``dtype``,
+- attention through ray_tpu.ops (Pallas flash on TPU, XLA fallback) after
+  GQA head expansion,
+- the same parameter-name → logical-axis table as GPT-2, so
+  ShardingRules runs it 1-chip, DP, FSDP or DP×TP unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import mha_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_position_embeddings: int = 2048
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: int = 4          # < num_heads → grouped-query attention
+    hidden_size: int = 512
+    intermediate_size: Optional[int] = None  # default ~8/3 * hidden
+    rope_theta: float = 10_000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    use_flash: Optional[bool] = None
+
+    @classmethod
+    def tiny(cls, **kw):  # test-sized
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("max_position_embeddings", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_kv_heads", 2)
+        kw.setdefault("hidden_size", 64)
+        return cls(**kw)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        # The 2/3·4h SwiGLU sizing, rounded to a multiple of 32 for MXU
+        # tiling.
+        raw = int(self.hidden_size * 8 / 3)
+        return ((raw + 31) // 32) * 32
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # Variance in fp32 regardless of activation dtype.
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        norm = x * jax.lax.rsqrt(var + self.eps).astype(x.dtype)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        return norm * scale.astype(x.dtype)
+
+
+def rope_tables(length: int, head_dim: int, theta: float):
+    """[L, D/2] cos/sin tables."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                        dtype=jnp.float32) / head_dim))
+    angles = jnp.arange(length, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs of channels; x: [B, L, H, D]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        B, L, _ = x.shape
+        hd = c.head_dim
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=c.dtype, name=name)
+        q = dense(c.num_heads * hd, "q_proj")(x).reshape(
+            B, L, c.num_heads, hd)
+        k = dense(c.num_kv_heads * hd, "k_proj")(x).reshape(
+            B, L, c.num_kv_heads, hd)
+        v = dense(c.num_kv_heads * hd, "v_proj")(x).reshape(
+            B, L, c.num_kv_heads, hd)
+        cos, sin = rope_tables(L, hd, c.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if c.num_kv_heads != c.num_heads:
+            # GQA: expand kv heads to query heads (XLA turns the repeat
+            # into a broadcast; memory win is in the kv cache/proj, which
+            # stays at num_kv_heads).
+            rep = c.num_heads // c.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        out = mha_attention(q, k, v, causal=True, use_flash=c.use_flash)
+        out = out.reshape(B, L, c.num_heads * hd)
+        return dense(c.hidden_size, "o_proj")(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=c.dtype, name=name)
+        gate = dense(c.mlp_dim, "gate_proj")(x)
+        up = dense(c.mlp_dim, "up_proj")(x)
+        return dense(c.hidden_size, "down_proj")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        x = x + LlamaAttention(c, name="attn")(
+            RMSNorm(c.rms_eps, c.dtype, name="attn_norm")(x))
+        x = x + LlamaMLP(c, name="mlp")(
+            RMSNorm(c.rms_eps, c.dtype, name="mlp_norm")(x))
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        c = self.config
+        emb = nn.Embed(c.vocab_size, c.hidden_size,
+                       dtype=c.dtype, name="embed")
+        x = emb(input_ids)
+        for i in range(c.num_layers):
+            x = LlamaBlock(c, name=f"layer_{i}")(x)
+        x = RMSNorm(c.rms_eps, c.dtype, name="final_norm")(x)
+        # Untied LM head (llama convention), fp32 logits for the softmax.
+        logits = nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
+                          name="lm_head")(x.astype(jnp.float32))
+        return logits
+
+
+def llama_loss_fn(params, apply_fn, batch) -> jax.Array:
+    """Next-token cross-entropy (same contract as gpt2_loss_fn)."""
+    ids = batch["input_ids"]
+    logits = apply_fn({"params": params}, ids)[:, :-1]
+    labels = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -24,4 +24,5 @@ from ray_tpu.serve.api import (  # noqa: F401
     start_http_proxy,
 )
 from ray_tpu.serve.autoscaling import calculate_desired_num_replicas  # noqa: F401
+from ray_tpu.serve.asgi import ASGIAdapter, ingress  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401

@@ -272,15 +272,56 @@ class _HttpProxy:
         proxy = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_POST(self):
+            def _route(self):
+                from urllib.parse import urlsplit
+
                 length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                name = self.path.strip("/").split("/")[0]
+                body = self.rfile.read(length) if length else b""
+                split = urlsplit(self.path)
+                # Name comes from the PATH only — '/echo?x=1' must route
+                # to 'echo', not 404 on a name containing the query.
+                name = split.path.strip("/").split("/")[0]
                 dep = _deployments.get(name)
                 if dep is None or dep.handle is None:
                     self.send_response(404)
                     self.end_headers()
                     self.wfile.write(b'{"error": "no such deployment"}')
+                    return
+                if getattr(dep, "is_ingress", False):
+                    # ASGI path: ship the full request dict; the replica
+                    # drives the app and returns {status, headers, body}.
+                    sub = split.path[len(name) + 1:] or "/"
+                    req = {"method": self.command, "path": sub,
+                           "query_string": split.query,
+                           "headers": list(self.headers.items()),
+                           "body": body}
+                    try:
+                        resp = ray_tpu.get(dep.handle.remote(req))
+                    except Exception as e:  # noqa: BLE001
+                        out = json.dumps({"error": str(e)}).encode()
+                        self.send_response(500)
+                        self.send_header("Content-Length", str(len(out)))
+                        self.end_headers()
+                        self.wfile.write(out)
+                        return
+                    payload = resp.get("body") or b""
+                    self.send_response(resp.get("status", 200))
+                    hdrs = resp.get("headers") or []
+                    hdrs = hdrs.items() if isinstance(hdrs, dict) else hdrs
+                    for k, v in hdrs:
+                        if k.lower() != "content-length":
+                            self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                if self.command != "POST":
+                    # Plain JSON deployments keep the POST-only contract:
+                    # stray GETs (crawlers, health checks) must not invoke
+                    # user code with a None payload.
+                    self.send_response(405)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "POST only"}')
                     return
                 try:
                     payload = json.loads(body) if body else None
@@ -294,6 +335,8 @@ class _HttpProxy:
                 self.send_header("Content-Length", str(len(out)))
                 self.end_headers()
                 self.wfile.write(out)
+
+            do_POST = do_GET = do_PUT = do_DELETE = do_PATCH = _route
 
             def log_message(self, *a):
                 pass

@@ -1,0 +1,193 @@
+"""ASGI serve ingress + runtime_env working_dir (reference: serve.ingress
+/ http_util.py ASGI plumbing; runtime_env working_dir plugin)."""
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _asgi_echo_app():
+    """A minimal hand-written ASGI app (no framework needed)."""
+    async def app(scope, receive, send):
+        assert scope["type"] == "http"
+        msg = await receive()
+        body = msg.get("body", b"")
+        payload = {
+            "method": scope["method"],
+            "path": scope["path"],
+            "query": scope["query_string"].decode(),
+            "body_len": len(body),
+        }
+        out = json.dumps(payload).encode()
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": [(b"content-type", b"application/json"),
+                                (b"x-app", b"echo")]})
+        await send({"type": "http.response.body", "body": out})
+
+    return app
+
+
+def test_asgi_adapter_direct():
+    from ray_tpu.serve.asgi import ASGIAdapter
+
+    adapter = ASGIAdapter(_asgi_echo_app())
+    resp = adapter.handle({"method": "PUT", "path": "/x/y?a=1",
+                           "body": b"12345"})
+    assert resp["status"] == 200
+    assert dict(resp["headers"])["x-app"] == "echo"  # list of pairs
+    data = json.loads(resp["body"])
+    assert data == {"method": "PUT", "path": "/x/y", "query": "a=1",
+                    "body_len": 5}
+
+
+def test_asgi_ingress_through_proxy(cluster):
+    def echo_factory():  # nested: cloudpickles by value for the replica
+        async def app(scope, receive, send):
+            msg = await receive()
+            body = msg.get("body", b"")
+            out = json.dumps({"method": scope["method"],
+                              "path": scope["path"],
+                              "body_len": len(body)}).encode()
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": [(b"content-type", b"application/json"),
+                                    (b"x-app", b"echo")]})
+            await send({"type": "http.response.body", "body": out})
+
+        return app
+
+    dep = serve.ingress(echo_factory, name="echo")
+    serve.run(dep, name="echo")
+    port = serve.start_http_proxy(port=0)
+    base = f"http://127.0.0.1:{port}/echo"
+    with urllib.request.urlopen(base + "/hello?q=2", timeout=15) as r:
+        assert r.headers["x-app"] == "echo"
+        data = json.loads(r.read())
+    assert data["method"] == "GET" and data["path"] == "/hello"
+    req = urllib.request.Request(base + "/post", data=b"abc",
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        data = json.loads(r.read())
+    assert data["method"] == "POST" and data["body_len"] == 3
+
+
+def test_runtime_env_working_dir(cluster, tmp_path):
+    """Tasks chdir into working_dir and can import modules from it; the
+    pooled worker restores its cwd afterwards."""
+    (tmp_path / "helper_mod_rtpu.py").write_text("VALUE = 41\n")
+    (tmp_path / "data.txt").write_text("payload")
+
+    @ray_tpu.remote
+    def uses_workdir():
+        import os
+
+        import helper_mod_rtpu
+
+        return helper_mod_rtpu.VALUE + 1, os.path.basename(os.getcwd()), \
+            open("data.txt").read()
+
+    val, cwd, data = ray_tpu.get(
+        uses_workdir.options(
+            runtime_env={"working_dir": str(tmp_path)}).remote())
+    assert val == 42 and data == "payload"
+    assert cwd == tmp_path.name
+
+    @ray_tpu.remote
+    def plain_cwd():
+        import os
+
+        return os.getcwd()
+
+    # The overlay must not leak into tasks without the runtime env.
+    assert ray_tpu.get(plain_cwd.remote()) != str(tmp_path)
+
+
+def test_runtime_env_unsupported_fields_error(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="pip"):
+        ray_tpu.get(f.options(runtime_env={"pip": ["requests"]}).remote())
+
+
+def test_runtime_env_missing_working_dir_errors(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.TaskError,
+                       match="does not exist"):
+        ray_tpu.get(f.options(
+            runtime_env={"working_dir": "/no/such/dir"}).remote())
+
+
+def test_asgi_duplicate_headers_and_root_query(cluster):
+    """Duplicate Set-Cookie headers must survive the adapter+proxy, and a
+    mount-root request with a query string must route."""
+    def cookie_factory():
+        async def app(scope, receive, send):
+            await receive()
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": [(b"set-cookie", b"a=1"),
+                                    (b"set-cookie", b"b=2")]})
+            await send({"type": "http.response.body",
+                        "body": scope["query_string"]})
+
+        return app
+
+    serve.run(serve.ingress(cookie_factory, name="ck"), name="ck")
+    port = serve.start_http_proxy(port=0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/ck?x=1", timeout=15) as r:
+        cookies = r.headers.get_all("Set-Cookie")
+        body = r.read()
+    assert sorted(cookies) == ["a=1", "b=2"]
+    assert body == b"x=1"
+
+
+def test_plain_deployment_rejects_get(cluster):
+    @serve.deployment
+    def side_effecting(payload):
+        raise AssertionError("must not run on GET")
+
+    serve.run(side_effecting, name="plain")
+    port = serve.start_http_proxy(port=0)
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/plain", timeout=15)
+        assert False, "expected 405"
+    except urllib.error.HTTPError as e:
+        assert e.code == 405
+
+
+def test_working_dir_modules_do_not_leak_between_tasks(cluster, tmp_path):
+    """Same module name, different working_dirs: the second task must see
+    its own code, not the pooled worker's sys.modules cache."""
+    d1 = tmp_path / "d1"
+    d2 = tmp_path / "d2"
+    d1.mkdir()
+    d2.mkdir()
+    (d1 / "leakmod.py").write_text("VALUE = 1\n")
+    (d2 / "leakmod.py").write_text("VALUE = 2\n")
+
+    @ray_tpu.remote
+    def read_value():
+        import leakmod
+
+        return leakmod.VALUE
+
+    v1 = ray_tpu.get(read_value.options(
+        runtime_env={"working_dir": str(d1)}).remote())
+    v2 = ray_tpu.get(read_value.options(
+        runtime_env={"working_dir": str(d2)}).remote())
+    assert (v1, v2) == (1, 2)

@@ -133,3 +133,85 @@ def test_pipeline_grads_flow():
     g = jax.jit(jax.grad(loss))(stacked)
     assert np.all(np.isfinite(np.asarray(g["w"])))
     assert float(jnp.abs(g["w"]).sum()) > 0
+
+
+def test_llama_forward_loss_and_grads():
+    """Llama-family decoder: shapes, finite loss, nonzero grads, and RoPE
+    position sensitivity (the same token at different positions must
+    produce different logits — absolute-position-free but order-aware)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import Llama, LlamaConfig, llama_loss_fn
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    key = jax.random.PRNGKey(0)
+    ids = jax.random.randint(key, (2, 16), 0, cfg.vocab_size)
+    params = model.init(key, ids)["params"]
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    loss, grads = jax.value_and_grad(llama_loss_fn)(
+        params, model.apply, {"input_ids": ids})
+    assert bool(jnp.isfinite(loss))
+    gnorm = sum(float(jnp.abs(g).sum())
+                for g in jax.tree_util.tree_leaves(grads))
+    assert gnorm > 0
+
+    # RoPE: repeated token, different contexts -> different predictions.
+    seq = jnp.zeros((1, 8), jnp.int32).at[0, 4].set(7)
+    out = model.apply({"params": params}, seq)
+    assert not bool(jnp.allclose(out[0, 3], out[0, 5], atol=1e-5))
+
+
+def test_llama_gqa_param_shapes_and_sharding_axes():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import param_logical_axes
+    from ray_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)  # 4 q heads, 2 kv heads
+    model = Llama(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    att = params["layer_0"]["attn"]
+    hd = cfg.head_dim
+    assert att["q_proj"]["kernel"].shape == (64, 4 * hd)
+    assert att["k_proj"]["kernel"].shape == (64, 2 * hd)  # GQA: fewer kv
+    axes = param_logical_axes(params)
+    assert axes["layer_0"]["attn"]["q_proj"]["kernel"] == ("embed", "heads")
+    assert axes["layer_0"]["mlp"]["down_proj"]["kernel"] \
+        == ("mlp", "embed_fsdp")
+    assert axes["lm_head"]["kernel"] == ("embed", "vocab")
+
+
+def test_llama_learns_tiny_copy_task():
+    """Optimization sanity: loss drops fast on a repeated-sequence LM
+    task."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import Llama, LlamaConfig, llama_loss_fn
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    key = jax.random.PRNGKey(1)
+    ids = jnp.tile(jnp.arange(16, dtype=jnp.int32)[None, :], (4, 1)) % 16
+    params = model.init(key, ids)["params"]
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        loss, g = jax.value_and_grad(llama_loss_fn)(
+            params, model.apply, {"input_ids": ids})
+        upd, opt = tx.update(g, opt)
+        return optax.apply_updates(params, upd), opt, loss
+
+    losses = []
+    for _ in range(60):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
