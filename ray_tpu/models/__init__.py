@@ -1,4 +1,4 @@
-"""Model zoo (flax): GPT-2 family, ResNets, MLP, NatureCNN.
+"""Model zoo (flax): GPT-2 + Llama LM families, ResNets, MLP, NatureCNN.
 
 The reference's model layer is RLlib's ModelCatalog + torch/tf ModelV2
 (rllib/models/catalog.py, rllib/models/torch/*) plus whatever user code
@@ -7,6 +7,7 @@ shapes, bfloat16-friendly, logical sharding annotations exposed per model
 via `param_logical_axes`.
 """
 from ray_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn  # noqa: F401
+from ray_tpu.models.llama import Llama, LlamaConfig, llama_loss_fn  # noqa: F401
 from ray_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401
 from ray_tpu.models.mlp import MLP  # noqa: F401
 from ray_tpu.models.nature_cnn import NatureCNN  # noqa: F401

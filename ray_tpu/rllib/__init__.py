@@ -9,6 +9,7 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ppo_ma import MAPPO, MAPPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
 from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup  # noqa: F401
 from ray_tpu.rllib.core.rl_module import (  # noqa: F401
@@ -18,7 +19,7 @@ from ray_tpu.rllib.core.rl_module import (  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # noqa: F401
 
 ALGORITHMS = {"PPO": PPOConfig, "IMPALA": IMPALAConfig, "DQN": DQNConfig,
-              "SAC": SACConfig, "BC": BCConfig}
+              "SAC": SACConfig, "BC": BCConfig, "MAPPO": MAPPOConfig}
 
 
 def get_algorithm_config(name: str) -> AlgorithmConfig:

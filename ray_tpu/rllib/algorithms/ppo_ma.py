@@ -1,0 +1,177 @@
+"""Multi-agent PPO with parameter sharing, anakin-style.
+
+Reference: RLlib's multi-agent training with a shared policy
+(policy_mapping_fn returning one policy id for every agent,
+rllib/algorithms/algorithm_config.py multi_agent()).  The TPU redesign
+folds the agent axis into the batch: the rollout is a [G, M] scan (G
+games, M agents) on device, the shared policy evaluates all G*M agent
+observations in one forward, GAE runs per agent stream with the game's
+done broadcast, and the standard clipped-surrogate SGD consumes the
+flattened [T*G*M] batch.  Independent per-agent policies are the
+MultiAgentBatch/policy_mapping path on the actor stack; this module is
+the high-throughput shared-weights form.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+import functools
+
+from ray_tpu.rllib.algorithms.ppo import ppo_loss
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.multi_agent import (
+    ma_vector_reset,
+    ma_vector_step,
+    make_ma_env,
+)
+from ray_tpu.rllib.evaluation.postprocessing import gae_jax
+
+
+class MAPPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=MAPPO)
+        self.num_envs = 32  # games
+
+
+class MAState(NamedTuple):
+    params: Any
+    opt_state: Any
+    env_states: Any
+    obs: jax.Array            # [G, M, d]
+    rng: jax.Array
+    ep_return: jax.Array      # [G] summed over agents
+    done_return_sum: jax.Array
+    done_count: jax.Array
+
+
+def make_anakin_mappo(config: MAPPOConfig):
+    env = make_ma_env(config.env) if isinstance(config.env, str) \
+        else config.env
+    G, M, T = config.num_envs, env.num_agents, config.unroll_length
+    spec = RLModuleSpec(obs_dim=env.obs_dim, num_actions=env.num_actions,
+                        hiddens=tuple(config.hiddens))
+    module = spec.build()
+    tx_parts = []
+    if config.grad_clip:
+        tx_parts.append(optax.clip_by_global_norm(config.grad_clip))
+    tx_parts.append(optax.adam(config.lr))
+    tx = optax.chain(*tx_parts)
+
+    flat_n = G * M
+    batch_total = T * flat_n
+    mb_size = min(config.sgd_minibatch_size, batch_total)
+    num_mb = batch_total // mb_size
+
+    def init_fn(seed: int = 0) -> MAState:
+        rng = jax.random.PRNGKey(seed)
+        rng, k_init, k_env = jax.random.split(rng, 3)
+        env_states, obs = ma_vector_reset(env, k_env, G)
+        params = module.init(k_init, obs.reshape(flat_n, -1))
+        return MAState(params, tx.init(params), env_states, obs, rng,
+                       jnp.zeros(G), jnp.zeros(()), jnp.zeros(()))
+
+    def rollout_step(carry, _):
+        params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+        rng, k_act, k_step = jax.random.split(rng, 3)
+        flat_obs = obs.reshape(flat_n, -1)
+        action, logp, value = module.forward_exploration(
+            params, flat_obs, k_act)
+        actions_gm = action.reshape(G, M)
+        env_states, next_obs, rewards, done, _ = ma_vector_step(
+            env, env_states, actions_gm, k_step)
+        # Episode return: summed team reward per game.
+        ep_ret = ep_ret + rewards.sum(axis=-1)
+        dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        dcnt = dcnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        out = (flat_obs, action, logp, value,
+               rewards.reshape(flat_n),
+               jnp.repeat(done, M))  # game done → every agent stream
+        return (params, env_states, next_obs, rng, ep_ret, dsum, dcnt), out
+
+    def train_step(state: MAState) -> Tuple[MAState, Dict[str, jax.Array]]:
+        carry = (state.params, state.env_states, state.obs, state.rng,
+                 state.ep_return, state.done_return_sum, state.done_count)
+        carry, traj = jax.lax.scan(rollout_step, carry, None, length=T)
+        params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+        obs_t, act_t, logp_t, val_t, rew_t, done_t = traj  # [T, G*M, ...]
+
+        _, last_value = module.apply(params, obs.reshape(flat_n, -1))
+        adv, vtarg = gae_jax(rew_t, val_t, done_t, last_value,
+                             config.gamma, config.lambda_)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        flat = {
+            "obs": obs_t.reshape(batch_total, -1),
+            "actions": act_t.reshape(batch_total),
+            "action_logp": logp_t.reshape(batch_total),
+            "advantages": adv.reshape(batch_total),
+            "value_targets": vtarg.reshape(batch_total),
+        }
+
+        loss_fn = functools.partial(
+            ppo_loss, clip_param=config.clip_param,
+            vf_clip_param=config.vf_clip_param,
+            vf_loss_coeff=config.vf_loss_coeff,
+            entropy_coeff=config.entropy_coeff)
+
+        def sgd_epoch(carry, _):
+            params, opt_state, rng = carry
+            rng, k = jax.random.split(rng)
+            perm = jax.random.permutation(k, batch_total)
+
+            def mb_step(carry, idx):
+                params, opt_state = carry
+                mb = {k_: v[idx] for k_, v in flat.items()}
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, module, mb)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            idxs = perm[: num_mb * mb_size].reshape(num_mb, mb_size)
+            (params, opt_state), losses = jax.lax.scan(
+                mb_step, (params, opt_state), idxs)
+            return (params, opt_state, rng), losses.mean()
+
+        (params, opt_state, rng), losses = jax.lax.scan(
+            sgd_epoch, (params, state.opt_state, rng), None,
+            length=config.num_sgd_iter)
+        new_state = MAState(params, opt_state, env_states, obs, rng,
+                            ep_ret, dsum, dcnt)
+        metrics = {
+            "total_loss": losses.mean(),
+            "episode_return_sum": dsum,
+            "episode_count": dcnt,
+        }
+        return new_state, metrics
+
+    # Steps/iter reported as ENV steps (T*G): the agent axis must not
+    # inflate throughput accounting (agent steps = env steps * M).
+    return module, init_fn, jax.jit(train_step), T * G
+
+
+class MAPPO(Algorithm):
+    _default_config_cls = MAPPOConfig
+
+    def _setup_anakin(self):
+        (self.module, init_fn, self._train_step,
+         self._steps_per_iter) = make_anakin_mappo(self.config)
+        self._anakin_state = init_fn(self.config.seed)
+
+    def _training_step_anakin(self) -> Dict[str, Any]:
+        self._anakin_state, metrics = self._train_step(self._anakin_state)
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics = self._episode_counter_metrics(metrics)
+        metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
+        return metrics
+
+    def _setup_actor_mode(self):
+        raise NotImplementedError(
+            "MAPPO ships anakin-mode (shared policy); independent-policy "
+            "multi-agent training uses MultiAgentBatch on the actor stack")

@@ -1,0 +1,87 @@
+"""Jittable multi-agent envs.
+
+Reference surface: MultiAgentEnv (rllib/env/multi_agent_env.py) — dict
+obs/rewards keyed by agent id, per-agent done.  The TPU redesign keeps
+agents as a leading ARRAY axis instead of dict keys: ``reset -> obs
+[M, obs_dim]``, ``step(actions [M]) -> (obs [M, d], rewards [M], done)``
+— fixed agent count, fully vmappable, no dict traffic inside jit.
+
+``CoordinationGame``: the canonical shared-policy testbed.  M agents each
+pick an action; everyone is rewarded when ALL picked the SAME action.
+Observations carry the one-hot previous joint action plus the agent's own
+one-hot id, so a shared policy must use the id/history to coordinate —
+independent random play earns ~2^-(M-1), coordinated play earns 1 per
+step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class CoordinationGame:
+    num_agents = 2
+    num_actions = 2
+    max_steps = 16
+
+    @property
+    def obs_dim(self) -> int:
+        # one-hot previous joint action (A^M) + one-hot agent id (M)
+        return self.num_actions ** self.num_agents + self.num_agents
+
+    def _obs(self, prev_joint: jax.Array) -> jax.Array:
+        """[M, obs_dim] from the previous joint-action index."""
+        joint_oh = jax.nn.one_hot(
+            prev_joint, self.num_actions ** self.num_agents)
+        ids = jnp.eye(self.num_agents)
+        return jnp.concatenate(
+            [jnp.tile(joint_oh[None, :], (self.num_agents, 1)), ids],
+            axis=-1)
+
+    def reset(self, rng):
+        state = {
+            "prev_joint": jnp.zeros((), jnp.int32),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        return state, self._obs(state["prev_joint"])
+
+    def step(self, state, actions, rng):
+        """actions: [M] int32."""
+        match = jnp.all(actions == actions[0])
+        rewards = jnp.where(match, 1.0, 0.0) * jnp.ones(self.num_agents)
+        joint = jnp.sum(
+            actions * (self.num_actions
+                       ** jnp.arange(self.num_agents))).astype(jnp.int32)
+        t = state["t"] + 1
+        done = t >= self.max_steps
+        reset_state, reset_obs = self.reset(rng)
+        new_state = {
+            "prev_joint": jnp.where(done, reset_state["prev_joint"], joint),
+            "t": jnp.where(done, reset_state["t"], t),
+        }
+        obs = jnp.where(done, reset_obs, self._obs(joint))
+        return new_state, obs, rewards, done, {}
+
+
+MA_REGISTRY = {
+    "CoordinationGame-v0": CoordinationGame,
+}
+
+
+def make_ma_env(name: str):
+    if name not in MA_REGISTRY:
+        raise ValueError(
+            f"unknown multi-agent env {name!r}; have {list(MA_REGISTRY)}")
+    return MA_REGISTRY[name]()
+
+
+def ma_vector_reset(env, rng, num_games: int):
+    """[G] games → (states, obs [G, M, d])."""
+    return jax.vmap(env.reset)(jax.random.split(rng, num_games))
+
+
+def ma_vector_step(env, states, actions, rng):
+    """actions [G, M] → (states, obs [G, M, d], rewards [G, M], done [G])."""
+    num = actions.shape[0]
+    return jax.vmap(env.step)(states, actions,
+                              jax.random.split(rng, num))

@@ -1,0 +1,53 @@
+"""Multi-agent RL: jittable MA env + shared-policy PPO (reference:
+MultiAgentEnv + shared-policy policy_mapping_fn training)."""
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.ppo_ma import MAPPOConfig
+from ray_tpu.rllib.env.multi_agent import (
+    CoordinationGame,
+    ma_vector_reset,
+    ma_vector_step,
+)
+
+
+def test_coordination_game_mechanics():
+    env = CoordinationGame()
+    key = jax.random.PRNGKey(0)
+    states, obs = ma_vector_reset(env, key, 4)
+    assert obs.shape == (4, 2, env.obs_dim)
+    # Matching actions pay everyone; mismatched pay nobody.
+    match = jnp.zeros((4, 2), jnp.int32)
+    states, obs, rew, done, _ = ma_vector_step(env, states, match, key)
+    np.testing.assert_array_equal(np.asarray(rew), np.ones((4, 2)))
+    mixed = jnp.tile(jnp.array([[0, 1]], jnp.int32), (4, 1))
+    states, obs, rew, done, _ = ma_vector_step(env, states, mixed, key)
+    np.testing.assert_array_equal(np.asarray(rew), np.zeros((4, 2)))
+    # Obs encode the previous joint action: agents can see history.
+    assert obs.shape[-1] == env.num_actions ** 2 + 2
+
+
+def test_mappo_learns_coordination():
+    """Gate: the shared policy must coordinate — team return near the
+    16-step maximum of 32 (2 agents x 16 matched steps); independent
+    random play averages ~16."""
+    cfg = (MAPPOConfig()
+           .environment("CoordinationGame-v0")
+           .anakin(num_envs=32, unroll_length=32)
+           .training(lr=1e-3, num_sgd_iter=4, sgd_minibatch_size=512,
+                     entropy_coeff=0.01)
+           .debugging(seed=0))
+    algo = cfg.build()
+    best = -1.0
+    for _ in range(60):
+        m = algo.train()
+        r = m.get("episode_reward_mean", float("nan"))
+        if not math.isnan(r):
+            best = max(best, r)
+        if best >= 28:
+            break
+    assert best >= 28, f"shared policy failed to coordinate: best={best}"
