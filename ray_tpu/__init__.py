@@ -96,7 +96,7 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
     Reference: ray.init (python/ray/_private/worker.py:1043)."""
     global _head, _remote_driver
     with _head_lock:
-        if _head is not None or _remote_driver is not None:
+        if is_initialized():
             if ignore_reinit_error:
                 return
             raise RuntimeError("ray_tpu.init() called twice "
@@ -105,6 +105,15 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
             from ray_tpu._private.config import CONFIG
 
             CONFIG.apply_system_config(kwargs["_system_config"])
+        if kwargs.get("local_mode"):
+            # Inline debugging execution (reference:
+            # ray.init(local_mode=True)) — no head, no subprocesses.
+            from ray_tpu._private.local_mode import LocalModeWorker
+            from ray_tpu._private.worker import set_global_worker
+
+            w = LocalModeWorker()
+            set_global_worker(w)
+            return w
         if address == "auto":
             # Reference: ray.init(address="auto") — resolve from the env
             # the job manager / CLI sets for entrypoint subprocesses.
@@ -155,7 +164,10 @@ def _connect_remote_driver(address: str, authkey: Optional[bytes],
 
 
 def is_initialized() -> bool:
-    return _head is not None or _remote_driver is not None
+    from ray_tpu._private.worker import global_worker
+
+    return _head is not None or _remote_driver is not None or \
+        getattr(global_worker, "mode", None) == "local"
 
 
 def shutdown():
@@ -164,6 +176,8 @@ def shutdown():
 
     with _head_lock:
         if global_worker is not None:
+            if getattr(global_worker, "mode", None) == "local":
+                global_worker.shutdown()
             try:
                 global_worker._closed = True
             except Exception:

@@ -55,6 +55,10 @@ class ActorHandle:
 
         if global_worker is None:
             raise RuntimeError("ray_tpu.init() has not been called")
+        if getattr(global_worker, "mode", None) == "local":
+            return global_worker.call_actor(
+                self._actor_id, method_name, args, kwargs,
+                options.get("num_returns", 1))
         task_args, task_kwargs = global_worker.make_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -143,6 +147,10 @@ class ActorClass:
         if global_worker is None:
             raise RuntimeError("ray_tpu.init() has not been called")
         opts = self._options
+        if getattr(global_worker, "mode", None) == "local":
+            actor_id = global_worker.create_actor(
+                self._cls, args, kwargs, name=opts.get("name"))
+            return ActorHandle(actor_id, self._method_names, self.__name__)
         task_args, task_kwargs = global_worker.make_args(args, kwargs)
         actor_id = ActorID.of(global_worker.job_id)
         spec = TaskSpec(

@@ -91,6 +91,11 @@ class RemoteFunction:
         if global_worker is None:
             raise RuntimeError("ray_tpu.init() has not been called")
         opts = self._options
+        if getattr(global_worker, "mode", None) == "local":
+            # local_mode: run inline, no serialization, plain stack traces
+            # (reference: ray.init(local_mode=True)).
+            return global_worker.run_function(
+                self._function, args, kwargs, opts.get("num_returns", 1))
         task_args, task_kwargs = global_worker.make_args(args, kwargs)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
