@@ -137,6 +137,24 @@ class Dashboard:
                                if j["job_id"] not in known]
                 return req._send(200, listed)
             return req._send(200, self._state(what))
+        if path == "/api/serve":
+            from ray_tpu.serve.api import _deployments
+
+            out = []
+            # Snapshot: serve.run/delete mutate the dict from the driver
+            # thread while this route serves from the HTTP thread.
+            for name, dep in list(_deployments.items()):
+                h = dep.handle
+                entry = {"name": name,
+                         "is_ingress": bool(getattr(dep, "is_ingress",
+                                                    False)),
+                         "autoscaling": dep.autoscaling_config or None}
+                if h is not None:
+                    entry.update(h.queue_stats())  # incl. num_replicas
+                else:
+                    entry["num_replicas"] = 0
+                out.append(entry)
+            return req._send(200, out)
         if path == "/api/logs":
             return req._send(200, self._log_index())
         if path.startswith("/api/logs/"):

@@ -45,6 +45,30 @@ def _count_block(blk):
 
 
 @ray_tpu.remote
+def _write_block(blk, path: str, fmt: str) -> str:
+    import json as json_mod
+    import os
+
+    # Task-side: the writing node may not be the driver's host.
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(blk, path)
+    elif fmt == "csv":
+        import pyarrow.csv as pcsv
+
+        pcsv.write_csv(blk, path)
+    elif fmt == "json":
+        with open(path, "w") as f:
+            for row in blk.to_pylist():
+                f.write(json_mod.dumps(row) + "\n")
+    else:
+        raise ValueError(f"bad write format {fmt!r}")
+    return path
+
+
+@ray_tpu.remote
 def _concat(*blks):
     return concat_blocks(list(blks))
 
@@ -252,6 +276,39 @@ class Dataset:
         thunks = [(lambda r=r: r) for r in self._blocks]
         return StreamingDataset(thunks, store_budget=store_budget,
                                 max_inflight_blocks=max_inflight_blocks)
+
+    # ---------------- writes (reference: Dataset.write_parquet/csv/json,
+    # python/ray/data/dataset.py + file_datasink.py: one file per block,
+    # written by the task that holds the block) ----------------
+    def _write(self, path: str, fmt: str, ext: str, mode: str) -> List[str]:
+        import glob as glob_mod
+        import os
+
+        existing = glob_mod.glob(os.path.join(path, f"part-*.{ext}"))
+        if existing:
+            if mode == "overwrite":
+                for p in existing:
+                    os.remove(p)  # a shorter write must not leave a stale
+                    # tail that doubles rows on read-back
+            else:
+                raise FileExistsError(
+                    f"{path} already holds {len(existing)} part files; "
+                    "pass mode='overwrite' to replace them")
+        refs = [
+            _write_block.remote(
+                b, os.path.join(path, f"part-{i:05d}.{ext}"), fmt)
+            for i, b in enumerate(self._blocks)
+        ]
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str, mode: str = "error") -> List[str]:
+        return self._write(path, "parquet", "parquet", mode)
+
+    def write_csv(self, path: str, mode: str = "error") -> List[str]:
+        return self._write(path, "csv", "csv", mode)
+
+    def write_json(self, path: str, mode: str = "error") -> List[str]:
+        return self._write(path, "json", "json", mode)
 
     def stats(self) -> dict:
         return {"num_blocks": len(self._blocks), "count": self.count()}

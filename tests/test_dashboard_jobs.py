@@ -127,3 +127,20 @@ def test_cli_parser_smoke():
 
     with pytest.raises(SystemExit):
         main(["no-such-command"])
+
+
+def test_dashboard_serve_route(dash_cluster):
+    from ray_tpu import serve
+
+    @serve.deployment
+    def doubler(x):
+        return x * 2
+
+    serve.run(doubler, name="dbl")
+    try:
+        entries = _get(dash_cluster, "/api/serve")
+        entry = next(e for e in entries if e["name"] == "dbl")
+        assert entry["num_replicas"] == 1
+        assert entry["total_in_flight"] == 0.0
+    finally:
+        serve.shutdown()
