@@ -34,9 +34,19 @@ def _map_block(blk, fn, batch_format):
 @ray_tpu.remote
 def _filter_block(blk, fn):
     import pyarrow as pa
+    import pyarrow.compute as pc
 
-    mask = [bool(fn(row)) for row in blk.to_pylist()]
-    return blk.filter(pa.array(mask))
+    if isinstance(fn, pc.Expression):
+        # Vectorized fast path: the predicate compiles to arrow compute
+        # kernels, no Python per row (reference: Dataset.filter(expr=...)).
+        return blk.filter(fn)
+    # Row UDF: evaluate over zipped column values — same contract, but no
+    # to_pylist() dict materialization per row.
+    cols = {name: blk.column(name).to_pylist() for name in blk.column_names}
+    names = list(cols)
+    mask = [bool(fn(dict(zip(names, vals))))
+            for vals in zip(*cols.values())] if names else []
+    return blk.filter(pa.array(mask, type=pa.bool_()))
 
 
 @ray_tpu.remote
@@ -76,6 +86,15 @@ def _concat(*blks):
 @ray_tpu.remote
 def _slice_block(blk, start, end):
     return block_mod.block_slice(blk, start, end)
+
+
+@ray_tpu.remote
+def _concat_slices(ranges, *blks):
+    """Concatenate [start, end) slices of the given blocks (the
+    repartition reduce side: one output block's pieces only)."""
+    parts = [block_mod.block_slice(b, s, e)
+             for b, (s, e) in zip(blks, ranges)]
+    return concat_blocks(parts) if parts else block_mod.block_from_items([])
 
 
 @ray_tpu.remote
@@ -144,11 +163,32 @@ class Dataset:
         return Dataset([_filter_block.remote(b, fn) for b in self._blocks])
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        whole = _concat.remote(*self._blocks)
-        total = self.count()
+        """Block-parallel repartition via a slice plan: each output block
+        concatenates only the input slices it needs — no task ever holds
+        the whole dataset (the previous global-concat form bounded the
+        dataset by one worker's memory)."""
+        lengths = ray_tpu.get([_count_block.remote(b)
+                               for b in self._blocks])
+        total = int(sum(lengths))
+        starts = np.cumsum([0] + lengths)  # input block i covers
         bounds = np.linspace(0, total, num_blocks + 1, dtype=int)
-        return Dataset([_slice_block.remote(whole, a, b)
-                        for a, b in zip(bounds, bounds[1:])])
+        out = []
+        for a, b in zip(bounds, bounds[1:]):
+            pieces = []
+            for i, (s, ln) in enumerate(zip(starts, lengths)):
+                lo, hi = max(a, s), min(b, s + ln)
+                if hi > lo:
+                    pieces.append((self._blocks[i], int(lo - s),
+                                   int(hi - s)))
+            if pieces:
+                out.append(_concat_slices.remote(
+                    [p[1:] for p in pieces], *[p[0] for p in pieces]))
+            else:
+                # More output blocks than rows: an empty output must keep
+                # the dataset's SCHEMA (a 0-row slice of a real block), or
+                # schema()/iter_batches break on the placeholder type.
+                out.append(_slice_block.remote(self._blocks[0], 0, 0))
+        return Dataset(out)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
         def shuf(batch: dict):

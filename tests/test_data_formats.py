@@ -228,3 +228,34 @@ def test_write_refuses_stale_parts_unless_overwrite(cluster, tmp_path):
     back = rdata.read_parquet(str(tmp_path / "o"))
     # No stale tail from the 8-block write doubling the rows.
     assert back.count() == 40
+
+
+def test_filter_expression_fast_path_and_udf(cluster):
+    import pyarrow.compute as pc
+
+    ds = rdata.from_items([{"k": i, "v": i % 3} for i in range(300)],
+                          parallelism=6)
+    # Arrow expression: vectorized, no Python per row.
+    fast = ds.filter(pc.field("v") == 0)
+    assert fast.count() == 100
+    assert all(r["v"] == 0 for r in fast.iter_rows())
+    # Row UDF: same semantics.
+    slow = ds.filter(lambda r: r["v"] == 0)
+    assert slow.count() == 100
+
+
+def test_repartition_slice_plan_preserves_order(cluster):
+    ds = rdata.from_items([{"k": i} for i in range(103)], parallelism=7)
+    for n in (1, 3, 10):
+        rp = ds.repartition(n)
+        assert rp.num_blocks() == n
+        assert [r["k"] for r in rp.iter_rows()] == list(range(103))
+
+
+def test_repartition_more_blocks_than_rows_keeps_schema(cluster):
+    small = rdata.from_items([{"k": i} for i in range(5)], parallelism=2)
+    rp = small.repartition(8)
+    assert "k" in str(rp.schema())
+    batches = list(rp.iter_batches(batch_size=2))
+    got = [int(x) for b in batches for x in b["k"]]
+    assert got == list(range(5))
