@@ -57,16 +57,34 @@ def _merge_sorted(key: str, descending: bool, *parts):
     return t.take(order)
 
 
+def _stable_hash(col: np.ndarray) -> np.ndarray:
+    """Process-stable per-value hashes (python's str hash is salted per
+    process, which would send equal keys to different partitions across
+    workers).  Numeric dtypes vectorize through a splitmix64 finalizer;
+    objects/strings fall back to crc32 of the repr."""
+    if np.issubdtype(col.dtype, np.integer) \
+            or np.issubdtype(col.dtype, np.floating):
+        # ONE canonical numeric form: arrow promotes an int64 column to
+        # float64 when a block holds a null, so int and float paths must
+        # agree or the same key hashes differently across blocks and a
+        # group splits.  float64 bits lose int uniqueness above 2^53 —
+        # keys collide into one partition there, which only skews load,
+        # never correctness.
+        x = col.astype(np.float64).view(np.uint64)
+    else:
+        import zlib
+
+        return np.fromiter((zlib.crc32(repr(v).encode()) for v in col),
+                           dtype=np.uint64, count=len(col))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
 @ray_tpu.remote
 def _hash_partition(blk, key: str, num_parts: int):
-    import zlib
-
     col = blk.column(key).to_numpy(zero_copy_only=False)
-    # Stable per-value hashing (python's str hash is salted per-process,
-    # which would send equal keys to different partitions across workers).
-    h = np.array([zlib.crc32(repr(v).encode()) for v in col],
-                 dtype=np.uint64)
-    part = h % num_parts
+    part = _stable_hash(col) % num_parts
     return tuple(_mask_filter(blk, part == i) for i in range(num_parts))
 
 

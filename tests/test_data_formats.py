@@ -259,3 +259,39 @@ def test_repartition_more_blocks_than_rows_keeps_schema(cluster):
     batches = list(rp.iter_batches(batch_size=2))
     got = [int(x) for b in batches for x in b["k"]]
     assert got == list(range(5))
+
+
+def test_stable_hash_deterministic_and_spread():
+    from ray_tpu.data.grouped import _stable_hash
+
+    ints = np.arange(10_000)
+    h1, h2 = _stable_hash(ints), _stable_hash(ints)
+    np.testing.assert_array_equal(h1, h2)  # deterministic
+    parts = h1 % 8
+    counts = np.bincount(parts.astype(int), minlength=8)
+    assert counts.min() > 800  # reasonably balanced
+    floats = np.linspace(0, 1, 1000)
+    assert len(np.unique(_stable_hash(floats) % 8)) == 8
+    strs = np.array([f"key{i}" for i in range(100)], dtype=object)
+    np.testing.assert_array_equal(_stable_hash(strs), _stable_hash(strs))
+
+
+def test_stable_hash_int_float_promotion_agrees(cluster):
+    """A null in one block promotes int64 -> float64 there; the same key
+    must still hash to the same partition (else a group splits)."""
+    from ray_tpu.data.grouped import _stable_hash
+
+    ints = np.array([7, 8, 9], dtype=np.int64)
+    floats = ints.astype(np.float64)  # the null-promoted form
+    np.testing.assert_array_equal(_stable_hash(ints), _stable_hash(floats))
+
+    import pyarrow as pa
+
+    b1 = pa.table({"k": pa.array([7, 7, 8], pa.int64()),
+                   "v": [1.0, 1.0, 1.0]})
+    b2 = pa.table({"k": pa.array([7, None, 8], pa.int64()),
+                   "v": [1.0, 1.0, 1.0]})
+    ds = rdata.Dataset([ray_tpu.put(b1), ray_tpu.put(b2)])
+    rows = [r for r in ds.groupby("k", num_partitions=4).sum("v").iter_rows()
+            if r["k"] == 7]
+    assert len(rows) == 1 and rows[0]["v_sum"] == 3.0  # one group, not two
