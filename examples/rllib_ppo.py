@@ -1,0 +1,28 @@
+"""PPO on CartPole, fully on-device (anakin) — the headline RL path.
+
+Run: python examples/rllib_ppo.py
+Try: the actor path with .rollouts(num_rollout_workers=2), the LSTM with
+.training(model={"use_lstm": True}), or SAC/DQN/MAPPO configs.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a source tree
+from ray_tpu.rllib import PPOConfig
+
+if __name__ == "__main__":
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .anakin(num_envs=64, unroll_length=64)
+            .training(lr=3e-4, num_sgd_iter=4, sgd_minibatch_size=1024,
+                      entropy_coeff=0.01)
+            .build())
+    for i in range(40):
+        m = algo.train()
+        if i % 5 == 0:
+            print(f"iter {i:3d}  reward={m.get('episode_reward_mean', float('nan')):7.1f}  "
+                  f"steps/s={m['num_env_steps_sampled_this_iter'] / m['time_this_iter_s']:,.0f}")
+        if m.get("episode_reward_mean", 0) >= 300:
+            print("solved")
+            break

@@ -1,0 +1,42 @@
+"""Serve a jax model with adaptive batching + autoscaling.
+
+Run: python examples/serve_batched_inference.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a source tree
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                                      "target_num_ongoing_requests_per_replica": 4})
+class Scorer:
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        self._w = jnp.ones((8, 1))
+        self._fn = jax.jit(lambda x: jnp.asarray(x) @ self._w)
+
+    @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.02)
+    def __call__(self, xs):
+        # xs: list of [8] vectors — batched into ONE pjit call.
+        import numpy as _np
+
+        out = self._fn(_np.stack(xs))
+        return [float(v) for v in out[:, 0]]
+
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    handle = serve.run(Scorer.bind(), name="scorer")
+    xs = [np.random.default_rng(i).normal(size=8) for i in range(64)]
+    scores = ray_tpu.get([handle.remote(x) for x in xs])
+    print("scored", len(scores), "requests; first:", round(scores[0], 4))
+    serve.shutdown()
+    ray_tpu.shutdown()

@@ -1,0 +1,41 @@
+"""Hyperparameter search with the native TPE searcher + ASHA.
+
+Run: python examples/tune_tpe.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # run from a source tree
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import session
+
+
+def objective(config):
+    # A noisy 2-D bowl; reports improve over "training iterations".
+    import random
+
+    base = (config["x"] - 3) ** 2 + (config["y"] + 1) ** 2
+    for it in range(1, 11):
+        score = -base - random.random() / it
+        session.report({"score": score, "training_iteration": it})
+
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    searcher = tune.TPESearch(
+        {"x": tune.uniform(-10, 10), "y": tune.uniform(-10, 10)},
+        n_initial_points=8, seed=0)
+    tuner = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=25,
+            search_alg=searcher,
+            scheduler=tune.AsyncHyperBandScheduler(
+                metric="score", mode="max", max_t=10, grace_period=2)))
+    results = tuner.fit()
+    best = results.get_best_result()
+    print("best config:", {k: round(v, 3) for k, v in
+                           best.metrics.items() if k == "score"})
+    ray_tpu.shutdown()
