@@ -143,4 +143,16 @@ CONFIG \
              "Persist GCS tables every N seconds (0 = disabled).") \
     .declare("tracing_enabled", bool, False,
              "Instrument task submit/execute with OpenTelemetry spans "
-             "(API-only; wire a TracerProvider to export).")
+             "(API-only; wire a TracerProvider to export).") \
+    .declare("memory_usage_threshold", float, 0.95,
+             "Host/cgroup memory fraction above which the monitor kills "
+             "a worker (reference: memory_usage_threshold).") \
+    .declare("memory_monitor_refresh_ms", int, 250,
+             "Memory-pressure check period (0 disables the monitor; "
+             "reference: memory_monitor_refresh_ms).") \
+    .declare("worker_killing_policy", str, "retriable_lifo",
+             "OOM victim selection: retriable_lifo | group_by_owner "
+             "(reference default: ray_config_def.h:103).") \
+    .declare("memory_monitor_test_file", str, "",
+             "Test hook: read usage fraction from this file instead of "
+             "/proc (mirrors the reference's fake-memory test mode).")

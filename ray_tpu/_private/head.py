@@ -85,6 +85,7 @@ class Head:
         self._arena_leases: Dict[ObjectID, Dict[bytes, int]] = defaultdict(dict)
         self._arena_pending_free: set = set()
         self._cancelled: set = set()  # task ids cancelled while running
+        self._oom_killed: set = set()  # task ids killed by the memory monitor
         self._shutdown = False
         # ---- multi-host plane ----
         # Host identity: object resolutions are host-aware — same host means
@@ -124,6 +125,11 @@ class Head:
         # closing their connection (e.g. failed to start at all) — the
         # equivalent of the reference's GCS health checks
         # (gcs_health_check_manager.h:39).
+        # Memory-pressure policing (reference: memory_monitor.h:52 +
+        # worker_killing_policy.h:33): evaluated from the same monitor loop.
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        self.memory_monitor = MemoryMonitor(self)
         self._monitor_thread = threading.Thread(target=self._monitor_loop,
                                                 name="rtpu-monitor", daemon=True)
         self._monitor_thread.start()
@@ -158,9 +164,19 @@ class Head:
     def _monitor_loop(self):
         import time as _time
 
+        from ray_tpu._private.config import CONFIG
+
+        # The loop paces both worker-liveness checks and memory-pressure
+        # ticks: honor the faster of the two periods so a sub-500ms
+        # memory_monitor_refresh_ms is actually achieved.
+        period = CONFIG.health_check_period_s
+        if self.memory_monitor.enabled:
+            period = min(period, self.memory_monitor.period_s)
+        period = max(0.02, period)  # floor: never busy-spin the head lock
         while not self._shutdown:
-            _time.sleep(0.5)
+            _time.sleep(period)
             with self._lock:
+                self.memory_monitor.tick()
                 for raylet in list(self.raylets.values()):
                     for h in list(raylet.workers.values()):
                         if h.proc is not None and h.proc.poll() is not None:
@@ -827,6 +843,10 @@ class Head:
         task_id = TaskID(msg["task_id"])
         with self._lock:
             spec_worker = self.running.pop(task_id, None)
+            # Completion can race an OOM kill decision (the monitor marked the
+            # task just as its result message arrived) — drop the mark so the
+            # set can't grow unboundedly.
+            self._oom_killed.discard(task_id)
             worker_id = WorkerID(msg["worker_id"])
             raylet, handle = self._find_worker(worker_id)
             spec: Optional[TaskSpec] = msg.get("spec") or (
@@ -1012,12 +1032,18 @@ class Head:
             self.scheduler.return_resources(handle.node_id, spec)
             self.running.pop(spec.task_id, None)
             cancelled = spec.task_id in self._cancelled
+            oom = spec.task_id in self._oom_killed
+            self._oom_killed.discard(spec.task_id)
             if cancelled:
                 self._cancelled.discard(spec.task_id)
                 self._fail_task(spec, exc.RayTpuError("task cancelled"))
             elif spec.attempt < spec.max_retries:
                 spec.attempt += 1
                 self._schedule(spec)
+            elif oom:
+                self._fail_task(spec, exc.OutOfMemoryError(
+                    "task was killed by the memory monitor under host "
+                    "memory pressure and exhausted its retries"))
             else:
                 self._fail_task(spec, exc.WorkerCrashedError(cause))
         # Collect in-flight actor tasks bound to this worker: the actor FSM

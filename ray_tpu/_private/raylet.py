@@ -27,7 +27,8 @@ IDLE_WORKER_TTL_S = 300.0
 
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "busy", "actor_id", "node_id",
-                 "current_task", "idle_since", "tpu_visible", "tpu_chips")
+                 "current_task", "idle_since", "tpu_visible", "tpu_chips",
+                 "task_started_at")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -40,6 +41,7 @@ class WorkerHandle:
         self.idle_since = time.monotonic()
         self.tpu_visible = False
         self.tpu_chips: tuple = ()  # chip indices this worker may touch
+        self.task_started_at = 0.0  # dispatch time of current_task
 
 
 class Raylet:
@@ -254,6 +256,7 @@ class Raylet:
                 progress = True
                 worker.busy = True
                 worker.current_task = spec
+                worker.task_started_at = time.monotonic()
                 if spec.task_type == TaskType.ACTOR_CREATION:
                     worker.actor_id = spec.actor_id
                 self.head.send_to_worker(worker, {"type": "execute", "spec": spec})

@@ -62,6 +62,12 @@ class RuntimeEnvSetupError(RayTpuError):
     pass
 
 
+class OutOfMemoryError(RayTpuError):
+    """Worker was killed by the memory monitor under host memory pressure
+    and the task's retry budget is exhausted (reference:
+    src/ray/common/memory_monitor.h:52 + worker_killing_policy.h:33)."""
+
+
 class PlacementGroupSchedulingError(RayTpuError):
     """Placement group could not be reserved (infeasible or timeout)."""
 
