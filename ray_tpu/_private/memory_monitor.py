@@ -205,7 +205,11 @@ class MemoryMonitor:
                 # task are eligible: killing an actor loses state the FSM
                 # would have to rebuild, so actors are spared like the
                 # reference's policy spares non-retriable groups until last.
+                # proc.poll() is None filters corpses: a worker killed on
+                # the previous tick may not be reaped yet (the liveness
+                # scan runs after this tick), and re-selecting it would
+                # waste the one-kill-per-period pacing on a dead process.
                 if (h.current_task is not None and h.actor_id is None
-                        and h.proc is not None):
+                        and h.proc is not None and h.proc.poll() is None):
                     out.append((h, h.current_task, h.task_started_at))
         return out

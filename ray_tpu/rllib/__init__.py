@@ -5,12 +5,15 @@ program — the throughput path) and `actor` (CPU rollout actors feeding the
 mesh learner — the generality path, shaped like the reference)."""
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo_ma import MAPPO, MAPPOConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.td3 import DDPG, TD3, DDPGConfig, TD3Config  # noqa: F401
 from ray_tpu.rllib.core.learner import JaxLearner, LearnerGroup  # noqa: F401
 from ray_tpu.rllib.core.rl_module import (  # noqa: F401
     DiscreteActorCritic,
@@ -19,7 +22,9 @@ from ray_tpu.rllib.core.rl_module import (  # noqa: F401
 from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # noqa: F401
 
 ALGORITHMS = {"PPO": PPOConfig, "IMPALA": IMPALAConfig, "DQN": DQNConfig,
-              "SAC": SACConfig, "BC": BCConfig, "MAPPO": MAPPOConfig}
+              "SAC": SACConfig, "BC": BCConfig, "MAPPO": MAPPOConfig,
+              "APPO": APPOConfig, "TD3": TD3Config, "DDPG": DDPGConfig,
+              "MARWIL": MARWILConfig}
 
 
 def get_algorithm_config(name: str) -> AlgorithmConfig:

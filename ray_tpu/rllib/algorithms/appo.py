@@ -1,0 +1,69 @@
+"""APPO: asynchronous PPO — IMPALA's dataflow with PPO's clipped
+surrogate on V-trace-corrected advantages.
+
+Reference: rllib/algorithms/appo/appo.py (APPO = IMPALA subclass with
+use_critic/use_kl_loss/clip_param config surface; loss
+appo_torch_policy.py — importance ratios against the behaviour policy,
+V-trace returns as the critic target, PPO clipping on the policy term).
+Here the whole thing is the IMPALA class with one swapped loss: the
+anakin mode runs the env + V-trace + clipped update in a single jitted
+step, the actor mode feeds async CPU rollouts through the same loss on
+the learner mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.utils.vtrace import vtrace
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.2
+        self.lr = 5e-4
+        self.num_sgd_iter = 1
+
+
+def appo_loss(params, module, batch, *, gamma, clip_rho, clip_c,
+              vf_loss_coeff, entropy_coeff, clip_param):
+    """Time-major [T, N, ...] batch like impala_loss; the policy term is
+    PPO's clipped surrogate with the importance ratio taken against the
+    behaviour policy and the advantage from V-trace."""
+    T, N = batch["actions"].shape
+    obs = batch["obs"].reshape(T * N, -1)
+    actions = batch["actions"].reshape(T * N)
+    logp, value, entropy = module.forward_train(params, obs, actions)
+    logp = logp.reshape(T, N)
+    value = value.reshape(T, N)
+    vs, pg_adv = vtrace(batch["behaviour_logp"], logp, batch["rewards"],
+                        jax.lax.stop_gradient(value), batch["dones"],
+                        batch["last_value"], gamma, clip_rho, clip_c)
+    adv = jax.lax.stop_gradient(pg_adv)
+    ratio = jnp.exp(logp - batch["behaviour_logp"])
+    policy_loss = -jnp.mean(jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv))
+    vf_loss = 0.5 * jnp.mean((value - vs) ** 2)
+    ent = jnp.mean(entropy)
+    total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * ent
+    return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                   "entropy": ent}
+
+
+class APPO(IMPALA):
+    _default_config_cls = APPOConfig
+
+    def _make_loss(self):
+        c = self.config
+        return functools.partial(appo_loss, gamma=c.gamma,
+                                 clip_rho=c.vtrace_clip_rho,
+                                 clip_c=c.vtrace_clip_c,
+                                 vf_loss_coeff=c.vf_loss_coeff,
+                                 entropy_coeff=c.entropy_coeff,
+                                 clip_param=c.clip_param)

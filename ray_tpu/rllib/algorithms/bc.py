@@ -41,6 +41,35 @@ class BCState(NamedTuple):
     rng: jax.Array
 
 
+def make_greedy_eval_rollout(env, module, num_eval_envs: int = 16):
+    """Jitted greedy in-env rollout returning mean completed-episode
+    return — the offline algorithms' (BC, MARWIL) shared evaluator."""
+
+    def eval_rollout(params, key, num_steps: int):
+        k_env, k_run = jax.random.split(key)
+        env_states, obs = vector_reset(env, k_env, num_eval_envs)
+
+        def step(carry, _):
+            env_states, obs, rng, ep_ret, dsum, dcnt = carry
+            rng, k_s = jax.random.split(rng)
+            action = module.forward_inference(params, obs)
+            env_states, obs, reward, done, _ = vector_step(
+                env, env_states, action, k_s)
+            ep_ret = ep_ret + reward
+            dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+            dcnt = dcnt + jnp.sum(done)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            return (env_states, obs, rng, ep_ret, dsum, dcnt), None
+
+        carry = (env_states, obs, k_run, jnp.zeros(num_eval_envs),
+                 jnp.zeros(()), jnp.zeros(()))
+        carry, _ = jax.lax.scan(step, carry, None, length=num_steps)
+        _env_states, _obs, _rng, _ep, dsum, dcnt = carry
+        return dsum / jnp.maximum(dcnt, 1.0)
+
+    return jax.jit(eval_rollout, static_argnums=2)
+
+
 class BC(Algorithm):
     _default_config_cls = BCConfig
 
@@ -98,32 +127,7 @@ class BC(Algorithm):
         self._anakin_state = BCState(params, tx.init(params), rng)
         self._train_step = jax.jit(train_step)
 
-        num_eval_envs = 16
-
-        def eval_rollout(params, key, num_steps: int):
-            """Greedy rollout; returns mean completed-episode return."""
-            k_env, k_run = jax.random.split(key)
-            env_states, obs = vector_reset(env, k_env, num_eval_envs)
-
-            def step(carry, _):
-                env_states, obs, rng, ep_ret, dsum, dcnt = carry
-                rng, k_s = jax.random.split(rng)
-                action = self.module.forward_inference(params, obs)
-                env_states, obs, reward, done, _ = vector_step(
-                    env, env_states, action, k_s)
-                ep_ret = ep_ret + reward
-                dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
-                dcnt = dcnt + jnp.sum(done)
-                ep_ret = jnp.where(done, 0.0, ep_ret)
-                return (env_states, obs, rng, ep_ret, dsum, dcnt), None
-
-            carry = (env_states, obs, k_run, jnp.zeros(num_eval_envs),
-                     jnp.zeros(()), jnp.zeros(()))
-            carry, _ = jax.lax.scan(step, carry, None, length=num_steps)
-            _env_states, _obs, _rng, _ep, dsum, dcnt = carry
-            return dsum / jnp.maximum(dcnt, 1.0)
-
-        self._eval_rollout = jax.jit(eval_rollout, static_argnums=2)
+        self._eval_rollout = make_greedy_eval_rollout(env, self.module)
         self._eval_key = rng
 
     def train(self) -> Dict[str, Any]:

@@ -99,6 +99,30 @@ def make_replay_state(buffer_size: int, n_insert: int, obs_dim: int,
     )
 
 
+def make_offpolicy_rollout(env, act_fn):
+    """Shared env-interaction scan body for the replay-family algorithms
+    (SAC, TD3/DDPG): `act_fn(params, obs, key) -> action` is the only
+    per-algorithm piece; the episode-return accounting (accumulate,
+    fold into done-sums, reset on done) is the single copy all of them
+    feed into Algorithm._episode_counter_metrics."""
+    def rollout_step(carry, _):
+        params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+        rng, k_act, k_step = jax.random.split(rng, 3)
+        action = act_fn(params, obs, k_act)
+        env_states, next_obs, reward, done, _ = vector_step(
+            env, env_states, action, k_step)
+        ep_ret = ep_ret + reward
+        dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        dcnt = dcnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        out = {"obs": obs, "actions": action, "rewards": reward,
+               "next_obs": next_obs, "dones": done.astype(jnp.float32)}
+        return (params, env_states, next_obs, rng, ep_ret, dsum,
+                dcnt), out
+
+    return rollout_step
+
+
 def _replay_insert(replay: ReplayState, batch: Dict[str, jax.Array]
                    ) -> ReplayState:
     """Insert [N] transitions at the circular cursor (N divides capacity)."""

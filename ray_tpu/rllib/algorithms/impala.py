@@ -59,10 +59,19 @@ def impala_loss(params, module, batch, *, gamma, clip_rho, clip_c,
 class IMPALA(Algorithm):
     _default_config_cls = IMPALAConfig
 
+    def _make_loss(self):
+        """Loss-fn hook: APPO overrides this to swap in the clipped
+        surrogate while reusing the whole IMPALA dataflow (anakin and
+        actor modes both call it)."""
+        c = self.config
+        return functools.partial(impala_loss, gamma=c.gamma,
+                                 clip_rho=c.vtrace_clip_rho,
+                                 clip_c=c.vtrace_clip_c,
+                                 vf_loss_coeff=c.vf_loss_coeff,
+                                 entropy_coeff=c.entropy_coeff)
+
     # ---- anakin mode: on-device rollout + V-trace update in one jit ----
     def _setup_anakin(self):
-        import functools as ft
-
         from ray_tpu.rllib.algorithms import ppo as ppo_mod
         from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
 
@@ -76,11 +85,7 @@ class IMPALA(Algorithm):
             optax.clip_by_global_norm(config.grad_clip or 1e9),
             optax.adam(config.lr))
         N, T = config.num_envs, config.unroll_length
-        loss_fn = ft.partial(impala_loss, gamma=config.gamma,
-                             clip_rho=config.vtrace_clip_rho,
-                             clip_c=config.vtrace_clip_c,
-                             vf_loss_coeff=config.vf_loss_coeff,
-                             entropy_coeff=config.entropy_coeff)
+        loss_fn = self._make_loss()
 
         def init_fn(seed=0):
             rng = jax.random.PRNGKey(seed)
@@ -161,12 +166,7 @@ class IMPALA(Algorithm):
             optax.clip_by_global_norm(self.config.grad_clip or 1e9),
             optax.adam(self.config.lr))
         self.learner = JaxLearner(
-            self.module,
-            functools.partial(impala_loss, gamma=self.config.gamma,
-                              clip_rho=self.config.vtrace_clip_rho,
-                              clip_c=self.config.vtrace_clip_c,
-                              vf_loss_coeff=self.config.vf_loss_coeff,
-                              entropy_coeff=self.config.entropy_coeff),
+            self.module, self._make_loss(),
             optimizer=tx, example_obs=example, seed=self.config.seed)
         self.workers = WorkerSet(self.config, spec)
         self.workers.sync_weights(self.learner.get_weights())

@@ -1,0 +1,170 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning (offline).
+
+Reference: rllib/algorithms/marwil/marwil.py (+ marwil_torch_policy.py):
+exponentially advantage-weighted behavior cloning — policy loss
+-E[exp(beta * A / c) * logp], advantages A = R - V(s) against a jointly
+trained value head, c a running RMS normalizer of A (ma_adv_norm,
+moving_average_sqd_adv_norm in the reference); beta=0 degenerates to BC
+(which the reference implements as exactly this class).
+
+Structure mirrors bc.py: the dataset loads once to device, discounted
+MC returns are computed per episode at load time (numpy backward scan),
+and each train() is one jitted minibatch-sweep step carrying the
+advantage normalizer in the algorithm state.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc import BCConfig, make_greedy_eval_rollout
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.jax_envs import make_jax_env
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0                 # 0 => plain BC
+        self.vf_coeff = 1.0
+        self.ma_adv_norm_rate = 1e-2    # reference: moving_average update 1e-8*lr-ish; practical here
+        self.marwil_minibatch_size = 256
+
+
+def discounted_returns(rewards: np.ndarray, dones: np.ndarray,
+                       gamma: float) -> np.ndarray:
+    """Per-episode discounted reward-to-go; the final (possibly truncated)
+    episode treats end-of-data as terminal (reference:
+    postprocessing.compute_advantages with use_gae=False)."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        if dones[t]:
+            acc = 0.0
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
+class MARWILState(NamedTuple):
+    params: Any
+    opt_state: Any
+    ma_adv_norm: jax.Array
+    rng: jax.Array
+
+
+class MARWIL(Algorithm):
+    _default_config_cls = MARWILConfig
+
+    def setup(self):
+        from ray_tpu.rllib.offline import JsonReader
+
+        config = self.config
+        env = make_jax_env(config.env) if isinstance(config.env, str) \
+            else config.env
+        self._env = env
+        spec = RLModuleSpec(obs_dim=env.obs_dim,
+                            num_actions=env.num_actions,
+                            hiddens=tuple(config.hiddens))
+        self.module = spec.build()
+        if config.offline_input is None:
+            raise ValueError(
+                "MARWIL requires config.offline_data(input_=path)")
+        data = JsonReader(config.offline_input).read_all()
+        obs = np.asarray(data["obs"], np.float32)
+        actions = np.asarray(data["actions"], np.int32)
+        rewards = np.asarray(data["rewards"], np.float32)
+        dones = np.asarray(data.get("dones", np.zeros(len(rewards))),
+                           np.float32)
+        returns = discounted_returns(rewards, dones, config.gamma)
+        self._obs = jnp.asarray(obs)
+        self._actions = jnp.asarray(actions)
+        self._returns = jnp.asarray(returns)
+        n = self._obs.shape[0]
+        mb = min(config.marwil_minibatch_size, n)
+
+        tx_parts = []
+        if config.grad_clip:
+            tx_parts.append(optax.clip_by_global_norm(config.grad_clip))
+        tx_parts.append(optax.adam(config.lr))
+        tx = optax.chain(*tx_parts)
+        beta, vf_coeff = config.beta, config.vf_coeff
+        rate = config.ma_adv_norm_rate
+        obs_all, act_all, ret_all = self._obs, self._actions, self._returns
+
+        def loss_fn(params, ma_adv_norm, obs, actions, returns):
+            logp, value, _ent = self.module.forward_train(
+                params, obs, actions)
+            adv = returns - value
+            vf_loss = jnp.mean(adv ** 2)
+            adv_sg = jax.lax.stop_gradient(adv)
+            new_norm = ma_adv_norm + rate * (
+                jnp.mean(adv_sg ** 2) - ma_adv_norm)
+            if beta != 0.0:
+                # exp-weighted imitation, weights normalized by the running
+                # RMS of the advantage and clipped for stability (the
+                # reference clips the exponent at 20 implicitly via fp32;
+                # we cap the weight explicitly).
+                w = jnp.exp(jnp.clip(
+                    beta * adv_sg / jnp.sqrt(jnp.maximum(new_norm, 1e-8)),
+                    -10.0, 10.0))
+            else:
+                w = jnp.ones_like(adv_sg)
+            policy_loss = -jnp.mean(w * logp)
+            total = policy_loss + vf_coeff * vf_loss
+            return total, (policy_loss, vf_loss, new_norm)
+
+        def train_step(state: MARWILState):
+            def one_update(carry, key):
+                params, opt_state, ma = carry
+                idx = jax.random.randint(key, (mb,), 0, n)
+                (loss, (pl, vl, ma)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, ma, obs_all[idx],
+                                           act_all[idx], ret_all[idx])
+                updates, opt_state = tx.update(grads, opt_state)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, ma), (loss, pl, vl)
+
+            rng, k = jax.random.split(state.rng)
+            keys = jax.random.split(k, config.num_sgd_per_iter)
+            (params, opt_state, ma), (losses, pls, vls) = jax.lax.scan(
+                one_update, (state.params, state.opt_state,
+                             state.ma_adv_norm), keys)
+            return (MARWILState(params, opt_state, ma, rng),
+                    losses.mean(), pls.mean(), vls.mean())
+
+        rng = jax.random.PRNGKey(config.seed)
+        rng, k_init = jax.random.split(rng)
+        params = self.module.init(k_init, self._obs[:1])
+        self._anakin_state = MARWILState(params, tx.init(params),
+                                         jnp.ones(()), rng)
+        self._train_step = jax.jit(train_step)
+
+        self._eval_rollout = make_greedy_eval_rollout(env, self.module)
+        self._eval_key = rng
+
+    def train(self) -> Dict[str, Any]:
+        import time
+
+        t0 = time.perf_counter()
+        (self._anakin_state, loss, pl, vl) = self._train_step(
+            self._anakin_state)
+        self.iteration += 1
+        return {"training_iteration": self.iteration,
+                "marwil_loss": float(loss),
+                "policy_loss": float(pl),
+                "vf_loss": float(vl),
+                "ma_adv_norm": float(self._anakin_state.ma_adv_norm),
+                "time_this_iter_s": time.perf_counter() - t0}
+
+    def evaluate(self, num_steps: int = 1000) -> Dict[str, float]:
+        self._eval_key, k = jax.random.split(self._eval_key)
+        r = self._eval_rollout(self._anakin_state.params, k, num_steps)
+        return {"episode_reward_mean": float(r)}

@@ -22,7 +22,7 @@ from ray_tpu.models.mlp import MLP
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import ReplayState, make_replay_state
-from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset
 
 LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
 
@@ -158,22 +158,11 @@ def make_anakin_sac(config: SACConfig):
             a_tx.init(jnp.zeros(())), env_states, obs, rng, replay,
             jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
 
-    from ray_tpu.rllib.algorithms.dqn import _replay_insert
+    from ray_tpu.rllib.algorithms.dqn import (_replay_insert,
+                                              make_offpolicy_rollout)
 
-    def rollout_step(carry, _):
-        pi_params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
-        rng, k_act, k_step = jax.random.split(rng, 3)
-        action, _ = pi.sample(pi_params, obs, k_act)
-        env_states, next_obs, reward, done, _ = vector_step(
-            env, env_states, action, k_step)
-        ep_ret = ep_ret + reward
-        dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
-        dcnt = dcnt + jnp.sum(done)
-        ep_ret = jnp.where(done, 0.0, ep_ret)
-        out = {"obs": obs, "actions": action, "rewards": reward,
-               "next_obs": next_obs, "dones": done.astype(jnp.float32)}
-        return (pi_params, env_states, next_obs, rng, ep_ret, dsum,
-                dcnt), out
+    rollout_step = make_offpolicy_rollout(
+        env, lambda p, obs, key: pi.sample(p, obs, key)[0])
 
     def q_loss(q_params, q_target, pi_params, log_alpha, batch, key):
         next_a, next_logp = pi.sample(pi_params, batch["next_obs"], key)

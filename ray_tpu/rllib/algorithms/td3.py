@@ -1,0 +1,271 @@
+"""TD3 / DDPG: deterministic-policy continuous control, anakin-style.
+
+Reference: rllib/algorithms/ddpg/ (ddpg.py config surface: twin_q,
+policy_delay, smooth_target_policy, target_noise/clip, tau,
+ou/gaussian exploration) and rllib/algorithms/td3/td3.py (TD3 = DDPG
+with twin_q=True, policy_delay=2, smooth_target_policy=True defaults —
+the same relationship holds here).  Loss structure per
+ddpg_torch_policy.py: critic regresses the polyak target network's
+Bellman backup, actor ascends Q1 of its own action.
+
+TPU redesign mirrors SAC's: env stepping, HBM replay, twin-Q and
+delayed policy updates all inside ONE jitted step; the policy delay is
+a counter-masked update (no data-dependent control flow under jit).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models.mlp import MLP
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import (ReplayState, _replay_insert,
+                                          make_offpolicy_rollout,
+                                          make_replay_state)
+from ray_tpu.rllib.algorithms.sac import TwinQ
+from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=TD3)
+        self.lr = 1e-3
+        self.buffer_size = 100_000
+        self.learning_starts = 1_000
+        self.tau = 0.005
+        self.twin_q = True
+        self.policy_delay = 2
+        self.smooth_target_policy = True
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+        self.exploration_noise = 0.1
+        self.num_updates_per_iter = 8
+        self.td3_batch_size = 256
+
+
+class DDPGConfig(TD3Config):
+    """Reference relationship inverted but equivalent: DDPG is TD3 minus
+    the three TD3 tricks (rllib/algorithms/td3/td3.py defaults)."""
+
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DDPG
+        self.twin_q = False
+        self.policy_delay = 1
+        self.smooth_target_policy = False
+
+
+class DeterministicPolicy:
+    """MLP → tanh-squashed action scaled to the bounds."""
+
+    def __init__(self, action_dim: int, hiddens, low, high):
+        self.net = MLP(tuple(hiddens), action_dim, name="pi")
+        self.scale = (high - low) / 2.0
+        self.center = (high + low) / 2.0
+
+    def init(self, key, obs):
+        return self.net.init(key, obs)
+
+    def apply(self, params, obs):
+        return jnp.tanh(self.net.apply(params, obs)) * self.scale \
+            + self.center
+
+    # Algorithm.compute_single_action protocol
+    def mode(self, params, obs):
+        return self.apply(params, obs)
+
+
+class TD3State(NamedTuple):
+    pi_params: Any
+    pi_target: Any
+    q_params: Any
+    q_target: Any
+    pi_opt: Any
+    q_opt: Any
+    update_count: jax.Array
+    env_states: Any
+    obs: jax.Array
+    rng: jax.Array
+    replay: ReplayState
+    ep_return: jax.Array
+    done_return_sum: jax.Array
+    done_count: jax.Array
+
+
+def make_anakin_td3(config: TD3Config):
+    env = make_jax_env(config.env) if isinstance(config.env, str) \
+        else config.env
+    adim = env.action_dim
+    low = jnp.asarray(env.action_low, jnp.float32)
+    high = jnp.asarray(env.action_high, jnp.float32)
+    scale = (high - low) / 2.0
+    pi = DeterministicPolicy(adim, config.hiddens, low, high)
+    q = TwinQ(config.hiddens)
+
+    def make_tx():
+        parts = []
+        if config.grad_clip:
+            parts.append(optax.clip_by_global_norm(config.grad_clip))
+        parts.append(optax.adam(config.lr))
+        return optax.chain(*parts)
+
+    pi_tx, q_tx = make_tx(), make_tx()
+    N, T = config.num_envs, config.unroll_length
+    n_insert = N * T
+
+    def init_fn(seed: int = 0) -> TD3State:
+        rng = jax.random.PRNGKey(seed)
+        rng, k_pi, k_q, k_env = jax.random.split(rng, 4)
+        env_states, obs = vector_reset(env, k_env, N)
+        pi_params = pi.init(k_pi, obs)
+        q_params = q.init(k_q, obs, jnp.zeros((N, adim)))
+        replay = make_replay_state(config.buffer_size, n_insert,
+                                   env.obs_dim, action_shape=(adim,),
+                                   action_dtype=jnp.float32)
+        return TD3State(pi_params, pi_params, q_params, q_params,
+                        pi_tx.init(pi_params), q_tx.init(q_params),
+                        jnp.zeros((), jnp.int32), env_states, obs, rng,
+                        replay, jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
+
+    def explore(pi_params, obs, key):
+        action = pi.apply(pi_params, obs)
+        noise = config.exploration_noise * scale \
+            * jax.random.normal(key, action.shape)
+        return jnp.clip(action + noise, low, high)
+
+    rollout_step = make_offpolicy_rollout(env, explore)
+
+    def q_loss(q_params, q_target, pi_target, batch, key):
+        next_a = pi.apply(pi_target, batch["next_obs"])
+        if config.smooth_target_policy:
+            # Target policy smoothing (TD3 trick #3): clipped noise on the
+            # target action regularizes the critic against sharp Q peaks.
+            eps = jnp.clip(
+                config.target_noise * scale
+                * jax.random.normal(key, next_a.shape),
+                -config.target_noise_clip * scale,
+                config.target_noise_clip * scale)
+            next_a = jnp.clip(next_a + eps, low, high)
+        tq1, tq2 = q.apply(q_target, batch["next_obs"], next_a)
+        target_v = jnp.minimum(tq1, tq2) if config.twin_q else tq1
+        target = batch["rewards"] + config.gamma * (1 - batch["dones"]) \
+            * jax.lax.stop_gradient(target_v)
+        q1, q2 = q.apply(q_params, batch["obs"], batch["actions"])
+        loss = jnp.mean((q1 - target) ** 2)
+        if config.twin_q:
+            loss = loss + jnp.mean((q2 - target) ** 2)
+        return loss
+
+    def pi_loss(pi_params, q_params, batch):
+        a = pi.apply(pi_params, batch["obs"])
+        q1, _ = q.apply(q_params, batch["obs"], a)
+        return -jnp.mean(q1)
+
+    def train_step(state: TD3State) -> Tuple[TD3State, Dict[str, jax.Array]]:
+        carry = (state.pi_params, state.env_states, state.obs, state.rng,
+                 state.ep_return, state.done_return_sum, state.done_count)
+        carry, traj = jax.lax.scan(rollout_step, carry, None, length=T)
+        pi_params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+        flat = {k: v.reshape((n_insert,) + v.shape[2:])
+                for k, v in traj.items()}
+        replay = _replay_insert(state.replay, flat)
+
+        def update(carry, key):
+            (pi_params, pi_target, q_params, q_target, pi_opt, q_opt,
+             count) = carry
+            k_idx, k_q = jax.random.split(key)
+            idx = jax.random.randint(k_idx, (config.td3_batch_size,), 0,
+                                     jnp.maximum(replay.size, 1))
+            batch = {k: getattr(replay, k)[idx]
+                     for k in ("obs", "actions", "rewards", "next_obs",
+                               "dones")}
+            ql, q_grads = jax.value_and_grad(q_loss)(
+                q_params, q_target, pi_target, batch, k_q)
+            qu, q_opt = q_tx.update(q_grads, q_opt)
+            q_params = optax.apply_updates(q_params, qu)
+            # Delayed policy update (TD3 trick #2): grads computed every
+            # step, applied only when count % policy_delay == 0 — a masked
+            # update keeps the scan shape static.
+            pl, pi_grads = jax.value_and_grad(pi_loss)(
+                pi_params, q_params, batch)
+            pu, new_pi_opt = pi_tx.update(pi_grads, pi_opt)
+            new_pi = optax.apply_updates(pi_params, pu)
+            apply_pi = (count % config.policy_delay) == 0
+            pi_params = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(apply_pi, n, o), new_pi, pi_params)
+            pi_opt = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(apply_pi, n, o), new_pi_opt, pi_opt)
+            tau = config.tau
+            polyak = lambda t, p: (1 - tau) * t + tau * p  # noqa: E731
+            q_target = jax.tree_util.tree_map(polyak, q_target, q_params)
+            pi_target = jax.tree_util.tree_map(
+                lambda t, p: jnp.where(apply_pi, polyak(t, p), t),
+                pi_target, pi_params)
+            return (pi_params, pi_target, q_params, q_target, pi_opt,
+                    q_opt, count + 1), (ql, pl)
+
+        rng, k = jax.random.split(rng)
+        keys = jax.random.split(k, config.num_updates_per_iter)
+        warm = replay.size >= config.learning_starts
+        start = (pi_params, state.pi_target, state.q_params, state.q_target,
+                 state.pi_opt, state.q_opt, state.update_count)
+        new_carry, (qls, pls) = jax.lax.scan(update, start, keys)
+        # Before learning_starts: collect only, discard the updates.
+        (pi_params, pi_target, q_params, q_target, pi_opt, q_opt,
+         count) = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(warm, new, old), new_carry, start)
+
+        new_state = TD3State(pi_params, pi_target, q_params, q_target,
+                             pi_opt, q_opt, count, env_states, obs, rng,
+                             replay, ep_ret, dsum, dcnt)
+        metrics = {"critic_loss": qls.mean(), "actor_loss": pls.mean(),
+                   "replay_size": replay.size,
+                   "episode_return_sum": dsum, "episode_count": dcnt}
+        return new_state, metrics
+
+    return pi, init_fn, jax.jit(train_step), n_insert
+
+
+class TD3(Algorithm):
+    _default_config_cls = TD3Config
+
+    def _setup_anakin(self):
+        (self.module, init_fn, self._train_step,
+         self._steps_per_iter) = make_anakin_td3(self.config)
+        self._anakin_state = init_fn(self.config.seed)
+
+    def _training_step_anakin(self) -> Dict[str, Any]:
+        self._anakin_state, metrics = self._train_step(self._anakin_state)
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics = self._episode_counter_metrics(metrics)
+        metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
+        return metrics
+
+    def _setup_actor_mode(self):
+        raise NotImplementedError(
+            "TD3/DDPG ship anakin-mode only (off-policy replay is "
+            "on-device; the actor-path sampling stack serves PPO/IMPALA)")
+
+    def save_checkpoint(self):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        s = self._anakin_state
+        return Checkpoint.from_pytree(
+            {"pi": s.pi_params, "pi_target": s.pi_target,
+             "q": s.q_params, "q_target": s.q_target},
+            extra={"iteration": self.iteration})
+
+    def load_checkpoint(self, checkpoint):
+        tree = checkpoint.to_pytree()
+        self.iteration = checkpoint.extra().get("iteration", 0)
+        self._anakin_state = self._anakin_state._replace(
+            pi_params=tree["pi"], pi_target=tree["pi_target"],
+            q_params=tree["q"], q_target=tree["q_target"])
+
+
+class DDPG(TD3):
+    _default_config_cls = DDPGConfig
