@@ -69,6 +69,17 @@ def _boot_head(resources: Dict[str, float], labels=None,
         return _head.add_node(resources, labels, store_capacity=store_capacity)
 
 
+def _apply_job_config(worker, job_config: Optional[dict]) -> None:
+    """Job-level defaults → driver worker state (reference: JobConfig's
+    ray_namespace/runtime_env semantics): per-call options still win."""
+    if not job_config:
+        return
+    if job_config.get("namespace"):
+        worker.namespace = job_config["namespace"]
+    if job_config.get("runtime_env"):
+        worker.default_runtime_env = job_config["runtime_env"]
+
+
 def _connect_driver(job_config: Optional[dict] = None):
     from ray_tpu._private.worker import CoreWorker, DirectTransport, set_global_worker
 
@@ -78,6 +89,7 @@ def _connect_driver(job_config: Optional[dict] = None):
         node_id = next(iter(_head.raylets))
         transport = DirectTransport(_head, worker_id)
         worker = CoreWorker(worker_id, node_id, job_id, transport, mode="driver")
+        _apply_job_config(worker, job_config)
         set_global_worker(worker)
         _head.gcs.add_job(job_id, job_config or {})
     return worker
@@ -123,7 +135,10 @@ def init(num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
                     'init(address="auto") needs RAY_TPU_ADDRESS in the env '
                     "(set by the job manager / ray_tpu CLI)")
         if address is not None:
-            return _connect_remote_driver(address, _authkey,
+            from ray_tpu.util.client import normalize_address
+
+            return _connect_remote_driver(normalize_address(address),
+                                          _authkey,
                                           kwargs.get("job_config"))
         res = dict(resources or {})
         res["CPU"] = float(num_cpus) if num_cpus is not None else _default_num_cpus()
@@ -158,9 +173,18 @@ def _connect_remote_driver(address: str, authkey: Optional[bytes],
     rt = RemoteDriverRuntime(address, authkey, job_config=job_config)
     worker = CoreWorker(rt.worker_id, rt.node_id, rt.job_id, rt.transport,
                         mode="driver")
+    _apply_job_config(worker, job_config)
     set_global_worker(worker)
     _remote_driver = rt
     return worker
+
+
+def client(address: str):
+    """Ray-Client-style builder: ``ray_tpu.client("ray://host:port")
+    .connect()`` (reference: ray.client, python/ray/client_builder.py)."""
+    from ray_tpu.util.client import ClientBuilder
+
+    return ClientBuilder(address)
 
 
 def is_initialized() -> bool:
@@ -238,8 +262,11 @@ def cancel(ref: ObjectRef, force: bool = False):
     _worker().transport.request("cancel", {"task_id": ref.id.task_id()})
 
 
-def get_actor(name: str, namespace: str = "default") -> ActorHandle:
-    info = _worker().transport.request(
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    w = _worker()
+    if namespace is None:  # fall back to the job's namespace (JobConfig)
+        namespace = getattr(w, "namespace", None) or "default"
+    info = w.transport.request(
         "get_actor", {"name": name, "namespace": namespace})
     spec = info["creation_spec"]
     return ActorHandle(info["actor_id"], spec.actor_method_names,

@@ -329,6 +329,11 @@ class CoreWorker:
         self.job_id = job_id
         self.transport = transport
         self.mode = mode  # "driver" | "worker" | "local"
+        # Job-level defaults (reference: JobConfig — ray_namespace +
+        # runtime_env applied to every task/actor the driver submits
+        # unless per-call options override them).
+        self.namespace = "default"
+        self.default_runtime_env: Optional[dict] = None
         self.ctx = TaskContext()
         self.driver_task_id = TaskID.for_driver(job_id)
         self._local_refs: Dict[ObjectID, int] = {}

@@ -172,9 +172,10 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", 1),
             actor_name=opts.get("name"),
             actor_method_names=self._method_names,
-            namespace=opts.get("namespace"),
+            namespace=opts.get("namespace") or global_worker.namespace,
             lifetime=opts.get("lifetime"),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=opts.get("runtime_env")
+            or global_worker.default_runtime_env,
         )
         spec.owner_worker_id = global_worker.worker_id
         spec.parent_task_id = global_worker.current_task_id()

@@ -111,7 +111,8 @@ class RemoteFunction:
             scheduling_strategy=_strategy_from_options(opts),
             max_retries=opts.get("max_retries", 3),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=opts.get("runtime_env")
+            or global_worker.default_runtime_env,
         )
         refs = global_worker.submit_task(spec)
         if spec.num_returns == 0:

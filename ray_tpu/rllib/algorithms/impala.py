@@ -159,6 +159,8 @@ class IMPALA(Algorithm):
         spec = RLModuleSpec(obs_dim=probe.obs_dim,
                             num_actions=probe.num_actions,
                             hiddens=tuple(self.config.hiddens))
+        if hasattr(probe, "close"):  # dimension probe only — release now
+            probe.close()
         self.module = spec.build()
         self._spec = spec
         example = np.zeros((1, probe.obs_dim), np.float32)

@@ -238,6 +238,8 @@ class PPO(Algorithm):
                             hiddens=tuple(self.config.hiddens))
         self.module = spec.build()
         example = np.zeros((1, probe.obs_dim), np.float32)
+        if hasattr(probe, "close"):  # dimension probe only — release now
+            probe.close()
         tx = optax.chain(optax.clip_by_global_norm(self.config.grad_clip or 1e9),
                          optax.adam(self.config.lr))
         self.learner = JaxLearner(

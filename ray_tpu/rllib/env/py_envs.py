@@ -50,12 +50,72 @@ class PyCartPole:
 PY_REGISTRY = {"CartPole-v1": PyCartPole}
 
 
+class GymEnvAdapter:
+    """Bridge to gymnasium (reference: rllib's gym env integration,
+    rllib/env/wrappers/ + algorithm_config.environment(str)): wraps any
+    gymnasium env with a Discrete action space and flattenable Box
+    observations into the py-env contract the actor-path rollout stack
+    speaks (reset(seed)->obs, step(a)->(obs, r, terminated, truncated,
+    info))."""
+
+    def __init__(self, name: str, seed: Optional[int] = None, **make_kwargs):
+        import gymnasium
+        from gymnasium import spaces
+
+        self.env = gymnasium.make(name, **make_kwargs)
+        space = self.env.observation_space
+        if not isinstance(space, spaces.Box):
+            # Discrete/MultiDiscrete obs have a shape too, but flattening
+            # a state INDEX to one float is a near-meaningless encoding —
+            # reject instead of silently training on it.
+            raise ValueError(
+                f"gym env {name!r}: only Box observation spaces are "
+                f"bridgeable (one-hot/embed discrete states in a wrapper "
+                f"first), got {space}")
+        self.obs_dim = int(np.prod(space.shape))
+        act = self.env.action_space
+        if not hasattr(act, "n"):
+            raise ValueError(
+                f"gym env {name!r}: only Discrete action spaces are "
+                f"bridgeable here (continuous control runs anakin-side "
+                f"via the SAC/TD3 family), got {act}")
+        self.num_actions = int(act.n)
+        self._next_seed = seed
+
+    def _flat(self, obs) -> np.ndarray:
+        return np.asarray(obs, np.float32).reshape(-1)
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is None:
+            seed = self._next_seed
+        self._next_seed = None  # gymnasium reseeds only when asked
+        obs, _info = self.env.reset(seed=seed)
+        return self._flat(obs)
+
+    def step(self, action: int):
+        obs, reward, terminated, truncated, info = self.env.step(int(action))
+        return (self._flat(obs), float(reward), bool(terminated),
+                bool(truncated), info)
+
+    def close(self):
+        self.env.close()
+
+
 def make_py_env(name: str, seed: Optional[int] = None):
+    """Native registry first; anything else is resolved through the
+    gymnasium bridge (so `.environment("Acrobot-v1")` in actor mode just
+    works when gymnasium is installed)."""
     if callable(name):
         return name()
-    if name not in PY_REGISTRY:
-        raise ValueError(f"unknown env {name!r}")
-    return PY_REGISTRY[name](seed)
+    if name in PY_REGISTRY:
+        return PY_REGISTRY[name](seed)
+    try:
+        import gymnasium  # noqa: F401
+    except ImportError:
+        raise ValueError(
+            f"unknown env {name!r} (native registry: {list(PY_REGISTRY)}; "
+            f"install gymnasium for the gym bridge)") from None
+    return GymEnvAdapter(name, seed)
 
 
 class VectorEnv:
@@ -83,3 +143,8 @@ class VectorEnv:
             infos.append(info)
         return (np.stack(obs), np.asarray(rews, np.float32),
                 np.asarray(dones), infos)
+
+    def close(self):
+        for e in self.envs:
+            if hasattr(e, "close"):
+                e.close()

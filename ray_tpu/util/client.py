@@ -1,0 +1,85 @@
+"""Ray-Client-style connection builder (reference: ray.client /
+python/ray/client_builder.py — ``ray.client("ray://host:port").connect()``
+returning a ClientContext usable as a context manager).
+
+The transport underneath is the framework's native TCP remote-driver
+plane (``ray_tpu.init(address=...)``), not a separate gRPC proxy: the
+same control protocol the head speaks locally is what remote drivers
+speak over the wire, so the "client" here is a thin, API-compatible
+front on that — no second protocol to keep in sync."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def normalize_address(address: str) -> str:
+    """Strip the ``ray://`` client scheme; the single place this happens
+    (init(address=...) and ClientBuilder both route through here)."""
+    if address.startswith("ray://"):
+        address = address[len("ray://"):]
+    return address
+
+
+class ClientContext:
+    """What ``connect()`` returns; disconnecting (or leaving the ``with``
+    block) tears down the remote-driver session."""
+
+    def __init__(self, address: str):
+        self.address = address
+
+    def __enter__(self) -> "ClientContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disconnect()
+
+    def disconnect(self) -> None:
+        import ray_tpu
+
+        ray_tpu.shutdown()
+
+
+class ClientBuilder:
+    """Fluent builder: ``ray_tpu.client("ray://host:port")
+    .env({"env_vars": {...}}).connect()``."""
+
+    def __init__(self, address: str):
+        self._address = normalize_address(address)
+        self._runtime_env: Optional[dict] = None
+        self._authkey: Optional[bytes] = None
+        self._namespace: Optional[str] = None
+
+    def env(self, runtime_env: dict) -> "ClientBuilder":
+        self._runtime_env = runtime_env
+        return self
+
+    def namespace(self, namespace: str) -> "ClientBuilder":
+        self._namespace = namespace
+        return self
+
+    def authkey(self, authkey: bytes) -> "ClientBuilder":
+        """Not part of the reference surface: the reference's client
+        server is unauthenticated inside the cluster perimeter; this
+        plane requires the head's authkey (or RAY_TPU_AUTHKEY in the
+        env)."""
+        self._authkey = authkey
+        return self
+
+    def connect(self) -> ClientContext:
+        import ray_tpu
+
+        job_config = None
+        if self._runtime_env or self._namespace:
+            job_config = {}
+            if self._runtime_env:
+                job_config["runtime_env"] = self._runtime_env
+            if self._namespace:
+                job_config["namespace"] = self._namespace
+        ray_tpu.init(address=self._address, _authkey=self._authkey,
+                     job_config=job_config)
+        return ClientContext(self._address)
+
+
+def client(address: str) -> ClientBuilder:
+    """Entry point (reference: ray.client(address))."""
+    return ClientBuilder(address)

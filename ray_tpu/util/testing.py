@@ -1,0 +1,157 @@
+"""Public test scaffolding (reference: the N18 mock/test layer —
+src/mock/ray/* gmock headers, python/ray/_private/test_utils.py,
+python/ray/cluster_utils.py — the pieces user test suites build on).
+
+What the reference ships as C++ gmock interfaces dissolves here into a
+small set of Python fakes and fixtures:
+
+- ``local_cluster`` / ``remote_node_agents``: context managers for a
+  fresh in-process cluster, optionally with real node-agent
+  subprocesses (each its own host key → every cross-node object read
+  exercises the TCP transfer plane).
+- ``fake_tpu_env``: env-var dict for an N-device virtual CPU mesh (the
+  JAX equivalent of the reference's _fake_gpus mode).
+- ``TestConfig`` (re-export of ray_tpu.train.backend.TestConfig): the
+  do-nothing Train backend for executor tests (reference:
+  python/ray/train/tests/test_backend.py:45).
+- ``wait_for_condition``: the reference's canonical poll helper
+  (python/ray/_private/test_utils.py).
+- ``inject_memory_pressure``: drive the memory monitor's test hook.
+"""
+from __future__ import annotations
+
+import contextlib
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, Iterator, Optional
+
+
+def wait_for_condition(condition: Callable[[], bool], timeout: float = 30.0,
+                       retry_interval_ms: float = 100.0) -> None:
+    """Poll until `condition()` is truthy (reference:
+    test_utils.wait_for_condition — same signature)."""
+    deadline = time.monotonic() + timeout
+    last_exc: Optional[BaseException] = None
+    while time.monotonic() < deadline:
+        try:
+            if condition():
+                return
+            last_exc = None
+        except Exception as e:  # noqa: BLE001 — condition may race startup
+            last_exc = e
+        time.sleep(retry_interval_ms / 1000.0)
+    raise TimeoutError(
+        f"condition not met within {timeout}s"
+        + (f" (last error: {last_exc})" if last_exc else ""))
+
+
+@contextlib.contextmanager
+def local_cluster(num_cpus: float = 4, num_tpus: float = 0,
+                  object_store_memory: int = 256 * 1024**2,
+                  **init_kwargs) -> Iterator[object]:
+    """Fresh single-process cluster, torn down on exit; yields the Head."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=num_cpus, num_tpus=num_tpus,
+                 object_store_memory=object_store_memory, **init_kwargs)
+    try:
+        yield ray_tpu._head
+    finally:
+        ray_tpu.shutdown()
+
+
+def start_node_agent(head, num_cpus: int = 2,
+                     resources: Optional[Dict[str, float]] = None,
+                     store_capacity: int = 256 * 1024**2,
+                     tpu_chips: int = 0) -> subprocess.Popen:
+    """Spawn a real node-agent subprocess joined to `head` over TCP —
+    a distinct host key, store, and worker pool (the multi-host test
+    substrate; reference: ray.cluster_utils.Cluster.add_node)."""
+    import json
+
+    args = [sys.executable, "-m", "ray_tpu._private.node_agent",
+            "--address", f"127.0.0.1:{head.tcp_port}",
+            "--authkey", head.authkey.hex(),
+            "--num-cpus", str(num_cpus),
+            "--store-capacity", str(store_capacity)]
+    if resources:
+        args += ["--resources", json.dumps(resources)]
+    if tpu_chips:
+        args += ["--num-tpus", str(tpu_chips)]
+    return subprocess.Popen(args)
+
+
+@contextlib.contextmanager
+def remote_node_agents(head, n: int = 2, num_cpus: int = 2,
+                       timeout: float = 30.0) -> Iterator[list]:
+    """N node-agent subprocesses attached to `head`, reaped on exit."""
+    baseline = len(head.raylets)  # capture before any agent can register
+    agents = [start_node_agent(head, num_cpus=num_cpus) for _ in range(n)]
+    try:
+        wait_for_condition(
+            lambda: len(head.raylets) >= baseline + n, timeout=timeout)
+        yield agents
+    finally:
+        for a in agents:
+            with contextlib.suppress(Exception):
+                a.kill()
+
+
+def fake_tpu_env(n_devices: int = 8) -> Dict[str, str]:
+    """Env overlay exposing an n-device virtual CPU mesh to a fresh
+    python process (set BEFORE jax import; reference analogue:
+    _fake_gpus, rllib/algorithms/algorithm_config.py:243)."""
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n_devices}",
+    }
+
+
+def _test_config():
+    from ray_tpu.train.backend import TestConfig
+
+    return TestConfig
+
+
+# Lazy import avoids pulling the Train stack in at module import; resolved
+# on first attribute access below.
+def __getattr__(name: str):
+    if name == "TestConfig":
+        return _test_config()
+    raise AttributeError(name)
+
+
+@contextlib.contextmanager
+def inject_memory_pressure(tmp_dir: str, threshold: float = 0.9,
+                           refresh_ms: int = 100) -> Iterator[Callable[[float], None]]:
+    """Arrange (BEFORE init) for the memory monitor to read pressure from
+    a file; yields `set_usage(fraction)`.  Restores flags on exit."""
+    import os
+
+    from ray_tpu._private.config import CONFIG
+
+    gauge = os.path.join(tmp_dir, "memory_usage_gauge")
+
+    def set_usage(fraction: float) -> None:
+        with open(gauge, "w") as f:
+            f.write(str(fraction))
+
+    set_usage(0.0)
+    saved = {k: os.environ.get(k) for k in
+             ("RAY_TPU_MEMORY_MONITOR_TEST_FILE",
+              "RAY_TPU_MEMORY_MONITOR_REFRESH_MS",
+              "RAY_TPU_MEMORY_USAGE_THRESHOLD")}
+    os.environ["RAY_TPU_MEMORY_MONITOR_TEST_FILE"] = gauge
+    os.environ["RAY_TPU_MEMORY_MONITOR_REFRESH_MS"] = str(refresh_ms)
+    os.environ["RAY_TPU_MEMORY_USAGE_THRESHOLD"] = str(threshold)
+    CONFIG.reset()
+    try:
+        yield set_usage
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        CONFIG.reset()

@@ -1,0 +1,220 @@
+"""Gym bridge (reference: rllib's gym env integration), Ray-Client-style
+builder (reference: ray.client / python/ray/client_builder.py), and the
+public test-scaffolding module (reference: N18 — test_utils.py,
+cluster_utils.py, Train's TestConfig)."""
+import math
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+# ---------------------------------------------------------------------------
+# Gym bridge
+# ---------------------------------------------------------------------------
+class TestGymBridge:
+    def test_adapter_wraps_acrobot(self):
+        from ray_tpu.rllib.env.py_envs import GymEnvAdapter, make_py_env
+
+        env = make_py_env("Acrobot-v1", seed=0)
+        assert isinstance(env, GymEnvAdapter)
+        assert env.obs_dim == 6 and env.num_actions == 3
+        obs = env.reset(seed=0)
+        assert obs.shape == (6,) and obs.dtype == np.float32
+        obs2, r, term, trunc, _ = env.step(1)
+        assert obs2.shape == (6,) and math.isfinite(r)
+        assert isinstance(term, bool) and isinstance(trunc, bool)
+
+    def test_native_registry_still_wins(self):
+        from ray_tpu.rllib.env.py_envs import PyCartPole, make_py_env
+
+        assert isinstance(make_py_env("CartPole-v1"), PyCartPole)
+
+    def test_continuous_action_space_rejected(self):
+        from ray_tpu.rllib.env.py_envs import make_py_env
+
+        with pytest.raises(ValueError, match="Discrete"):
+            make_py_env("Pendulum-v1")
+
+    def test_discrete_observation_space_rejected(self):
+        # FrozenLake's Discrete(16) obs would flatten to one meaningless
+        # float — must be rejected, not silently trained on.
+        from ray_tpu.rllib.env.py_envs import make_py_env
+
+        with pytest.raises(ValueError, match="Box"):
+            make_py_env("FrozenLake-v1")
+
+    def test_unknown_env_raises(self):
+        from ray_tpu.rllib.env.py_envs import make_py_env
+
+        with pytest.raises(Exception):
+            make_py_env("DefinitelyNotAnEnv-v999")
+
+    def test_vector_env_over_gym(self):
+        from ray_tpu.rllib.env.py_envs import GymEnvAdapter, VectorEnv
+
+        v = VectorEnv(lambda: GymEnvAdapter("Acrobot-v1"), num_envs=3)
+        obs = v.reset_all()
+        assert obs.shape == (3, 6)
+        obs, rews, dones, infos = v.step([0, 1, 2])
+        assert obs.shape == (3, 6) and rews.shape == (3,)
+
+    def test_ppo_actor_mode_trains_on_gym_env(self, ray_start_regular):
+        """The full actor path (rollout workers sampling a real gymnasium
+        env) produces finite losses."""
+        from ray_tpu.rllib import PPOConfig
+
+        algo = (PPOConfig().environment("Acrobot-v1")
+                .rollouts(num_rollout_workers=1, rollout_fragment_length=64)
+                .training(train_batch_size=128, sgd_minibatch_size=64)
+                .debugging(seed=0).build())
+        m = algo.train()
+        assert math.isfinite(m.get("total_loss", float("nan")))
+
+
+# ---------------------------------------------------------------------------
+# Client builder
+# ---------------------------------------------------------------------------
+class TestClientBuilder:
+    def test_builder_parses_ray_scheme(self):
+        from ray_tpu.util.client import ClientBuilder
+
+        b = ray_tpu.client("ray://10.0.0.1:6379")
+        assert isinstance(b, ClientBuilder)
+        assert b._address == "10.0.0.1:6379"
+
+    def test_connect_against_real_head(self, shutdown_only):
+        """Boot a head, then connect a client session to its TCP port the
+        way a laptop user would (the remote-driver plane under the
+        client API)."""
+        ray_tpu.init(num_cpus=2)
+        head = ray_tpu._head
+        addr, key = f"127.0.0.1:{head.tcp_port}", head.authkey
+
+        import subprocess
+        import sys
+
+        code = f"""
+import sys; sys.path.insert(0, {repr(__file__.rsplit('/tests', 1)[0])})
+import ray_tpu
+with ray_tpu.client("ray://{addr}").authkey(bytes.fromhex("{key.hex()}")).connect():
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+    assert ray_tpu.get(f.remote(41)) == 42
+print("CLIENT_OK")
+"""
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120,
+                             env={**__import__("os").environ,
+                                  "JAX_PLATFORMS": "cpu"})
+        assert "CLIENT_OK" in out.stdout, out.stderr[-2000:]
+
+
+class TestJobConfig:
+    def test_namespace_and_runtime_env_defaults_apply(self, shutdown_only):
+        """job_config is not a dead record: its namespace scopes named
+        actors and its runtime_env becomes the per-task default."""
+        import os
+
+        ray_tpu.init(num_cpus=2, job_config={
+            "namespace": "teamspace",
+            "runtime_env": {"env_vars": {"JOBCONF_MARK": "on"}}})
+
+        @ray_tpu.remote
+        def read_env():
+            return os.environ.get("JOBCONF_MARK")
+
+        assert ray_tpu.get(read_env.remote()) == "on"
+
+        @ray_tpu.remote
+        class Named:
+            def ping(self):
+                return "pong"
+
+        Named.options(name="svc", lifetime="detached").remote()
+        # No explicit namespace: resolves in the job's namespace.
+        h = ray_tpu.get_actor("svc")
+        assert ray_tpu.get(h.ping.remote()) == "pong"
+        # Another namespace does not see it.
+        with pytest.raises(Exception):
+            ray_tpu.get_actor("svc", namespace="other")
+
+    def test_per_call_options_override_job_defaults(self, shutdown_only):
+        import os
+
+        ray_tpu.init(num_cpus=2, job_config={
+            "runtime_env": {"env_vars": {"JOBCONF_MARK": "on"}}})
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"OTHER": "x"}})
+        def read_env():
+            return os.environ.get("JOBCONF_MARK"), os.environ.get("OTHER")
+
+        mark, other = ray_tpu.get(read_env.remote())
+        assert other == "x" and mark is None
+
+
+# ---------------------------------------------------------------------------
+# Test scaffolding module
+# ---------------------------------------------------------------------------
+class TestScaffolding:
+    def test_wait_for_condition(self):
+        from ray_tpu.util.testing import wait_for_condition
+
+        state = {"n": 0}
+
+        def cond():
+            state["n"] += 1
+            return state["n"] >= 3
+
+        wait_for_condition(cond, timeout=5, retry_interval_ms=1)
+        with pytest.raises(TimeoutError):
+            wait_for_condition(lambda: False, timeout=0.2,
+                               retry_interval_ms=10)
+
+    def test_local_cluster_context(self):
+        from ray_tpu.util.testing import local_cluster
+
+        with local_cluster(num_cpus=2) as head:
+            assert head is ray_tpu._head
+
+            @ray_tpu.remote
+            def f():
+                return "ok"
+
+            assert ray_tpu.get(f.remote()) == "ok"
+        assert not ray_tpu.is_initialized()
+
+    def test_fake_tpu_env_shape(self):
+        from ray_tpu.util.testing import fake_tpu_env
+
+        env = fake_tpu_env(4)
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "device_count=4" in env["XLA_FLAGS"]
+
+    def test_test_config_reexport(self):
+        from ray_tpu.train.backend import TestConfig as TrainTestConfig
+        from ray_tpu.util import testing
+
+        assert testing.TestConfig is TrainTestConfig
+
+    def test_inject_memory_pressure(self, tmp_path, shutdown_only):
+        import time
+
+        from ray_tpu.util.testing import inject_memory_pressure
+
+        with inject_memory_pressure(str(tmp_path)) as set_usage:
+            ray_tpu.init(num_cpus=2)
+            head = ray_tpu._head
+            assert head.memory_monitor._test_file
+
+            @ray_tpu.remote(max_retries=0)
+            def hog():
+                time.sleep(120)
+
+            ref = hog.remote()
+            time.sleep(2)
+            set_usage(0.99)
+            with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+                ray_tpu.get(ref, timeout=60)
