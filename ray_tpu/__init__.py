@@ -182,9 +182,9 @@ def _connect_remote_driver(address: str, authkey: Optional[bytes],
 def client(address: str):
     """Ray-Client-style builder: ``ray_tpu.client("ray://host:port")
     .connect()`` (reference: ray.client, python/ray/client_builder.py)."""
-    from ray_tpu.util.client import ClientBuilder
+    from ray_tpu.util.client import client as _client
 
-    return ClientBuilder(address)
+    return _client(address)
 
 
 def is_initialized() -> bool:

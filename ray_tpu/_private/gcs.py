@@ -227,6 +227,11 @@ class GCS:
             return [n for n in self.nodes.values() if n.alive]
 
     # ---------------- jobs ----------------
+    def get_job_config(self, job_id: JobID) -> dict:
+        with self._lock:
+            info = self.jobs.get(job_id)
+            return dict((info or {}).get("config") or {})
+
     def add_job(self, job_id: JobID, config: dict):
         with self._lock:
             self.jobs[job_id] = {"job_id": job_id, "config": config,

@@ -617,6 +617,11 @@ class Head:
                 self._free_object(oid)
         reply(True)
 
+    def req_job_config(self, payload, reply, caller):
+        from ray_tpu._private.ids import JobID as _JobID
+
+        reply(self.gcs.get_job_config(_JobID(payload["job_id"])))
+
     def req_kv(self, payload, reply, caller):
         verb = payload["verb"]
         ns = payload.get("namespace", "default")

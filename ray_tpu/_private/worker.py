@@ -331,9 +331,12 @@ class CoreWorker:
         self.mode = mode  # "driver" | "worker" | "local"
         # Job-level defaults (reference: JobConfig — ray_namespace +
         # runtime_env applied to every task/actor the driver submits
-        # unless per-call options override them).
+        # unless per-call options override them).  Drivers get these set
+        # at connect; pooled workers adopt them per executed task from
+        # the task's job (see execute_task), cached per job id.
         self.namespace = "default"
         self.default_runtime_env: Optional[dict] = None
+        self._job_config_cache: Dict[JobID, dict] = {}
         self.ctx = TaskContext()
         self.driver_task_id = TaskID.for_driver(job_id)
         self._local_refs: Dict[ObjectID, int] = {}
@@ -731,6 +734,20 @@ class CoreWorker:
         return fn
 
     # ---- task execution ----
+    def _job_config(self, job_id: JobID) -> dict:
+        """Fetch-and-cache the job's config so nested submissions and
+        named-actor lookups inside workers see the job's namespace and
+        runtime_env defaults (reference: JobConfig propagation)."""
+        cfg = self._job_config_cache.get(job_id)
+        if cfg is None:
+            try:
+                cfg = self.transport.request(
+                    "job_config", {"job_id": job_id.binary()}) or {}
+            except Exception:
+                cfg = {}
+            self._job_config_cache[job_id] = cfg
+        return cfg
+
     def execute_task(self, spec: TaskSpec) -> dict:
         """Run a task and build the task_done message (does not send it)."""
         import time as _time
@@ -738,6 +755,15 @@ class CoreWorker:
         self.ctx.task_id = spec.task_id
         self.ctx.task_name = spec.name
         self.ctx.put_counter = 0
+        # Adopt the submitting job's defaults for the task's duration
+        # (pooled workers serve many jobs; restored in the finally).
+        saved_job_defaults = (self.namespace, self.default_runtime_env)
+        job_cfg = self._job_config(spec.job_id) if self.mode == "worker" \
+            else {}
+        if job_cfg.get("namespace"):
+            self.namespace = job_cfg["namespace"]
+        if job_cfg.get("runtime_env"):
+            self.default_runtime_env = job_cfg["runtime_env"]
         start_ts = _time.time()
         error = None
         error_str = None
@@ -814,6 +840,11 @@ class CoreWorker:
                     _workdir_overlay.adopt()
                 else:
                     _workdir_overlay.restore()
+            if spec.task_type == TaskType.ACTOR_CREATION:
+                # The worker is dedicated to this actor's job from here on.
+                pass
+            else:
+                self.namespace, self.default_runtime_env = saved_job_defaults
             self.ctx.task_id = None
         return {
             "type": "task_done",

@@ -172,10 +172,16 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", 1),
             actor_name=opts.get("name"),
             actor_method_names=self._method_names,
-            namespace=opts.get("namespace") or global_worker.namespace,
+            # Explicit per-call values win even when falsy; only
+            # None/absent falls back to the job defaults.
+            namespace=(opts.get("namespace")
+                       if opts.get("namespace") is not None
+                       else getattr(global_worker, "namespace", None)),
             lifetime=opts.get("lifetime"),
-            runtime_env=opts.get("runtime_env")
-            or global_worker.default_runtime_env,
+            runtime_env=(opts.get("runtime_env")
+                         if opts.get("runtime_env") is not None
+                         else getattr(global_worker, "default_runtime_env",
+                                      None)),
         )
         spec.owner_worker_id = global_worker.worker_id
         spec.parent_task_id = global_worker.current_task_id()

@@ -111,8 +111,13 @@ class RemoteFunction:
             scheduling_strategy=_strategy_from_options(opts),
             max_retries=opts.get("max_retries", 3),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            runtime_env=opts.get("runtime_env")
-            or global_worker.default_runtime_env,
+            # Explicit per-call values win even when falsy (runtime_env={}
+            # deliberately clears the job default); only None/absent falls
+            # back (reference: JobConfig default semantics).
+            runtime_env=(opts.get("runtime_env")
+                         if opts.get("runtime_env") is not None
+                         else getattr(global_worker, "default_runtime_env",
+                                      None)),
         )
         refs = global_worker.submit_task(spec)
         if spec.num_returns == 0:

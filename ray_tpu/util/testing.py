@@ -96,6 +96,9 @@ def remote_node_agents(head, n: int = 2, num_cpus: int = 2,
         for a in agents:
             with contextlib.suppress(Exception):
                 a.kill()
+        for a in agents:  # reap: kill() alone leaves zombies
+            with contextlib.suppress(Exception):
+                a.wait(timeout=10)
 
 
 def fake_tpu_env(n_devices: int = 8) -> Dict[str, str]:

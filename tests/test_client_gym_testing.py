@@ -141,6 +141,51 @@ class TestJobConfig:
         with pytest.raises(Exception):
             ray_tpu.get_actor("svc", namespace="other")
 
+    def test_worker_side_lookup_sees_job_namespace(self, shutdown_only):
+        """Nested calls inside workers adopt the job's namespace: a task
+        can get_actor() a name the driver registered in the job's
+        namespace, and nested tasks inherit the job runtime_env."""
+        import os
+
+        # 4 CPUs: the detached actor + outer task + nested inner task all
+        # need a worker slot at once.
+        ray_tpu.init(num_cpus=4, job_config={
+            "namespace": "teamspace",
+            "runtime_env": {"env_vars": {"JOBCONF_MARK": "deep"}}})
+
+        @ray_tpu.remote
+        class Named:
+            def ping(self):
+                return "pong"
+
+        Named.options(name="svc2", lifetime="detached").remote()
+
+        @ray_tpu.remote
+        def outer():
+            h = ray_tpu.get_actor("svc2")  # resolves in job namespace
+
+            @ray_tpu.remote
+            def inner():
+                return os.environ.get("JOBCONF_MARK")
+
+            return ray_tpu.get(h.ping.remote()), ray_tpu.get(inner.remote())
+
+        pong, mark = ray_tpu.get(outer.remote(), timeout=60)
+        assert pong == "pong" and mark == "deep"
+
+    def test_explicit_empty_runtime_env_clears_job_default(self,
+                                                           shutdown_only):
+        import os
+
+        ray_tpu.init(num_cpus=2, job_config={
+            "runtime_env": {"env_vars": {"JOBCONF_MARK": "on"}}})
+
+        @ray_tpu.remote(runtime_env={})
+        def read_env():
+            return os.environ.get("JOBCONF_MARK")
+
+        assert ray_tpu.get(read_env.remote()) is None
+
     def test_per_call_options_override_job_defaults(self, shutdown_only):
         import os
 
