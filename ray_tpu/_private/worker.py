@@ -744,7 +744,10 @@ class CoreWorker:
                 cfg = self.transport.request(
                     "job_config", {"job_id": job_id.binary()}) or {}
             except Exception:
-                cfg = {}
+                # Transient head trouble: fall back for THIS task but do
+                # not cache — caching {} would silently strip the job's
+                # namespace/runtime_env for the rest of the worker's life.
+                return {}
             self._job_config_cache[job_id] = cfg
         return cfg
 
@@ -840,10 +843,9 @@ class CoreWorker:
                     _workdir_overlay.adopt()
                 else:
                     _workdir_overlay.restore()
-            if spec.task_type == TaskType.ACTOR_CREATION:
-                # The worker is dedicated to this actor's job from here on.
-                pass
-            else:
+            # Actor creation keeps the adopted defaults: the worker is
+            # dedicated to this actor's job from here on.
+            if spec.task_type != TaskType.ACTOR_CREATION:
                 self.namespace, self.default_runtime_env = saved_job_defaults
             self.ctx.task_id = None
         return {

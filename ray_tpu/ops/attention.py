@@ -32,14 +32,22 @@ def mha_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     the d<128 lane padding around the custom call costs more than the
     [L, L] materialization it avoids."""
     if use_flash is None:
+        b, lq, h, _ = q.shape
+        lk = k.shape[1]
+        # [B, H, Lq, Lk] score-matrix footprint the XLA path materializes.
+        score_bytes = b * h * lq * lk * q.dtype.itemsize
         use_flash = (jax.default_backend() not in ("cpu",)
-                     and q.shape[1] >= 2048 and q.shape[1] % 128 == 0
-                     and k.shape[1] % 128 == 0
+                     and lq % 128 == 0 and lk % 128 == 0
+                     # Speed crossover is ~2k ctx (below it the XLA path
+                     # wins), but memory forces flash earlier: per-layer
+                     # score matrices past ~512MB OOM real training steps
+                     # (e.g. GPT-2 batch 32 @ 1k ctx on a 16G chip).
+                     and (lq >= 2048 or score_bytes > 512 * 1024 * 1024)
                      # Flash's causal mask is diagonal-aligned (self-
                      # attention); the XLA path's is bottom-right-aligned
                      # for lq != lk (decode), so only lq == lk may
                      # auto-dispatch.
-                     and (not causal or q.shape[1] == k.shape[1]))
+                     and (not causal or lq == lk))
     if use_flash:
         try:
             return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)

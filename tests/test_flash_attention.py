@@ -80,13 +80,27 @@ def test_auto_dispatch_uses_xla_on_cpu():
     assert bool(jnp.all(jnp.isfinite(g)))
 
 
-def test_flash_second_derivative_not_needed_but_vjp_composable():
-    """vmap/jit compose over the custom VJP."""
+def test_flash_vjp_composes_with_jit_and_vmap():
+    """jit(grad(...)) and vmap over the custom VJP both work and match
+    the XLA reference (the residual plumbing must survive both
+    transforms)."""
     q, k, v = _rand_qkv(2, 256, 2, 32)
 
-    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=True))
     with jax.default_matmul_precision("float32"):
-        out = f(q, k, v)
-        ref = _xla_attention(q, k, v, True, None)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+        gf = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+            jnp.sin(flash_attention(q, k, v, interpret=True))),
+            argnums=(0, 1, 2)))(q, k, v)
+        gx = jax.grad(lambda q, k, v: jnp.sum(
+            jnp.sin(_xla_attention(q, k, v, True, None))),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=1e-3)
+        # vmap over a leading ensemble axis.
+        qs = jnp.stack([q, q * 0.5])
+        vm = jax.vmap(lambda qq: flash_attention(qq, k, v,
+                                                 interpret=True))(qs)
+        ref = jnp.stack([_xla_attention(q, k, v, True, None),
+                         _xla_attention(q * 0.5, k, v, True, None)])
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
