@@ -41,6 +41,15 @@ class AlgorithmConfig:
         self.hiddens = (64, 64)
         self.use_lstm = False
         self.lstm_cell_size = 128
+        # attention memory (reference model-config keys: use_attention,
+        # attention_dim, attention_num_heads,
+        # attention_num_transformer_units; window replaces the reference's
+        # attention_memory_inference/training pair)
+        self.use_attention = False
+        self.attention_dim = 64
+        self.attention_num_heads = 4
+        self.attention_window = 8
+        self.attention_num_layers = 1
         # resources / misc
         self.seed = 0
         self.framework_str = "jax"
@@ -84,12 +93,37 @@ class AlgorithmConfig:
     def training(self, **kw):
         for k, v in kw.items():
             if k == "model" and isinstance(v, dict):
+                known = {"fcnet_hiddens", "use_lstm", "lstm_cell_size",
+                         "use_attention", "attention_dim",
+                         "attention_num_heads", "attention_window",
+                         "attention_num_layers",
+                         "attention_num_transformer_units"}
+                unknown = set(v) - known
+                if unknown:
+                    # Same loudness as typo'd top-level params: a silent
+                    # default fallback trains the wrong model.
+                    raise ValueError(
+                        f"unknown model config keys {sorted(unknown)}; "
+                        f"known: {sorted(known)}")
                 self.hiddens = tuple(v.get("fcnet_hiddens", self.hiddens))
                 # Recurrent policy knobs (reference model config:
                 # use_lstm / lstm_cell_size, catalog.py MODEL_DEFAULTS).
                 self.use_lstm = bool(v.get("use_lstm", self.use_lstm))
                 self.lstm_cell_size = int(v.get("lstm_cell_size",
                                                 self.lstm_cell_size))
+                # Attention-memory knobs (GTrXL path).
+                self.use_attention = bool(v.get("use_attention",
+                                                self.use_attention))
+                self.attention_dim = int(v.get("attention_dim",
+                                               self.attention_dim))
+                self.attention_num_heads = int(
+                    v.get("attention_num_heads", self.attention_num_heads))
+                self.attention_window = int(
+                    v.get("attention_window", self.attention_window))
+                self.attention_num_layers = int(
+                    v.get("attention_num_transformer_units",
+                          v.get("attention_num_layers",
+                                self.attention_num_layers)))
                 continue
             if not hasattr(self, k):
                 raise ValueError(f"unknown training param {k!r}")

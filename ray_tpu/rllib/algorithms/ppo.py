@@ -66,6 +66,37 @@ def ppo_loss(params, module, batch, *, clip_param, vf_clip_param,
                          entropy_coeff=entropy_coeff)
 
 
+def run_ppo_sgd(params, opt_state, rng, loss_fn, make_mb, total, mb_size,
+                num_mb, num_sgd_iter, tx):
+    """The shared permute→minibatch→update scaffolding for every PPO
+    variant (feedforward, recurrent, attention): `make_mb(idx)` maps an
+    index vector over `total` items (steps or env sequences) to a loss
+    batch; `loss_fn(params, mb) -> (loss, aux)`.  One copy so fixes to
+    the minibatch loop (e.g. the perm remainder drop) land everywhere."""
+    def sgd_epoch(carry, _):
+        params, opt_state, rng = carry
+        rng, k = jax.random.split(rng)
+        perm = jax.random.permutation(k, total)
+
+        def mb_step(carry, idx):
+            params, opt_state = carry
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, make_mb(idx))
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), (loss, aux)
+
+        idxs = perm[: num_mb * mb_size].reshape(num_mb, mb_size)
+        (params, opt_state), (losses, auxes) = jax.lax.scan(
+            mb_step, (params, opt_state), idxs)
+        return (params, opt_state, rng), (losses.mean(),
+                                          {k_: v.mean() for k_, v in
+                                           auxes.items()})
+
+    return jax.lax.scan(sgd_epoch, (params, opt_state, rng), None,
+                        length=num_sgd_iter)
+
+
 class AnakinState(NamedTuple):
     params: Any
     opt_state: Any
@@ -149,30 +180,11 @@ def make_anakin_ppo(config: AlgorithmConfig):
             "value_targets": vtarg.reshape(batch_total),
         }
 
-        def sgd_epoch(carry, _):
-            params, opt_state, rng = carry
-            rng, k = jax.random.split(rng)
-            perm = jax.random.permutation(k, batch_total)
-
-            def mb_step(carry, idx):
-                params, opt_state = carry
-                mb = {k_: v[idx] for k_, v in flat.items()}
-                (loss, aux), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, module, mb)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), (loss, aux)
-
-            idxs = perm[: num_mb * mb_size].reshape(num_mb, mb_size)
-            (params, opt_state), (losses, auxes) = jax.lax.scan(
-                mb_step, (params, opt_state), idxs)
-            return (params, opt_state, rng), (losses.mean(),
-                                              {k_: v.mean() for k_, v in
-                                               auxes.items()})
-
-        (params, opt_state, rng), (losses, auxes) = jax.lax.scan(
-            sgd_epoch, (params, state.opt_state, rng), None,
-            length=config.num_sgd_iter)
+        (params, opt_state, rng), (losses, auxes) = run_ppo_sgd(
+            params, state.opt_state, rng,
+            lambda p, mb: loss_fn(p, module, mb),
+            lambda idx: {k_: v[idx] for k_, v in flat.items()},
+            batch_total, mb_size, num_mb, config.num_sgd_iter, tx)
 
         new_state = AnakinState(params, opt_state, env_states, obs, rng,
                                 ep_ret, dsum, dcnt)
@@ -197,11 +209,18 @@ class PPO(Algorithm):
 
     # ---- anakin mode ----
     def _setup_anakin(self):
+        if self.config.use_lstm and self.config.use_attention:
+            raise ValueError("use_lstm and use_attention are exclusive")
         if self.config.use_lstm:
             from ray_tpu.rllib.algorithms.ppo_rnn import make_anakin_ppo_rnn
 
             (self.module, init_fn, self._train_step,
              self._steps_per_iter) = make_anakin_ppo_rnn(self.config)
+        elif self.config.use_attention:
+            from ray_tpu.rllib.algorithms.ppo_attn import make_anakin_ppo_attn
+
+            (self.module, init_fn, self._train_step,
+             self._steps_per_iter) = make_anakin_ppo_attn(self.config)
         else:
             (self.module, init_fn, self._train_step,
              self._steps_per_iter) = make_anakin_ppo(self.config)
@@ -232,6 +251,12 @@ class PPO(Algorithm):
         from ray_tpu.rllib.evaluation.worker_set import WorkerSet
         from ray_tpu.rllib.env.py_envs import make_py_env
 
+        if self.config.use_lstm or self.config.use_attention:
+            # Silently training a memoryless MLP on a memory task is the
+            # worst failure mode — refuse loudly instead.
+            raise NotImplementedError(
+                "use_lstm/use_attention policies run in anakin mode only; "
+                "the actor-path sampling stack is feedforward")
         probe = make_py_env(self.config.env)
         spec = RLModuleSpec(obs_dim=probe.obs_dim,
                             num_actions=probe.num_actions,

@@ -1,0 +1,254 @@
+"""Attention-memory (GTrXL-style) PPO, anakin-style.
+
+Reference: the use_attention model path (rllib model config
+use_attention/attention_dim/attention_num_transformer_units etc.,
+models/catalog.py MODEL_DEFAULTS; torch GTrXL
+models/torch/attention_net.py — gated transformer-XL blocks over a
+memory of past inputs, per Parisotto et al.'s "Stabilizing Transformers
+for RL").
+
+TPU redesign: instead of the reference's recurrent memory tensors
+(state_in/state_out columns + view-requirement machinery), the policy
+attends over a fixed sliding WINDOW of the last K observations carried
+on device through the rollout scan (cleared at episode boundaries).
+That makes training feedforward — each timestep's forward depends only
+on its own window, so minibatches are arbitrary flat slices like
+vanilla PPO: no sequence replay, no seq_lens, no padding.  The blocks
+are GTrXL's: pre-LayerNorm attention/MLP with GRU-type gates biased
+toward the identity skip, which is what makes transformer policies
+trainable with RL gradients.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.evaluation.postprocessing import gae_jax
+from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+
+
+class GRUGate(nn.Module):
+    """GTrXL's GRU-style residual gate; bias > 0 on the update gate makes
+    the block start as (near-)identity, the paper's key stabilizer."""
+
+    d: int
+    bias: float = 2.0
+
+    @nn.compact
+    def __call__(self, x, y):
+        # x: the residual stream, y: the transformed candidate.
+        r = nn.sigmoid(nn.Dense(self.d, use_bias=False, name="wr")(y)
+                       + nn.Dense(self.d, use_bias=False, name="ur")(x))
+        z = nn.sigmoid(nn.Dense(self.d, use_bias=False, name="wz")(y)
+                       + nn.Dense(self.d, use_bias=False, name="uz")(x)
+                       - self.bias)
+        h = nn.tanh(nn.Dense(self.d, use_bias=False, name="wh")(y)
+                    + nn.Dense(self.d, use_bias=False, name="uh")(r * x))
+        return (1 - z) * x + z * h
+
+
+class GTrXLBlock(nn.Module):
+    d: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x, mask):
+        h = nn.LayerNorm()(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, qkv_features=self.d, name="mha")(
+                h, h, mask=mask)
+        x = GRUGate(self.d, name="gate_attn")(x, h)
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(4 * self.d, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d, name="mlp_out")(h)
+        return GRUGate(self.d, name="gate_mlp")(x, h)
+
+
+class AttentionActorCritic(nn.Module):
+    """Window of K observations → separate GTrXL trunks → heads (separate
+    pi/vf trunks for the same reason the LSTM module uses them: early
+    value-error gradients wreck a shared representation)."""
+
+    num_actions: int
+    window: int
+    d_model: int = 64
+    heads: int = 4
+    layers: int = 1
+
+    @nn.compact
+    def __call__(self, obs_win, valid):
+        """obs_win [B, K, obs_dim]; valid [B, K] bool (False = empty slot
+        after an episode boundary).  Returns (logits [B, A], value [B])."""
+        K = self.window
+        causal = jnp.tril(jnp.ones((K, K), bool))
+        # Rows may only attend to valid columns (and themselves via the
+        # diagonal, which is always valid: slot K-1 holds the current obs).
+        mask = causal[None, None] & valid[:, None, None, :]
+
+        def trunk(tag):
+            x = nn.Dense(self.d_model, name=f"embed_{tag}")(obs_win)
+            x = x + self.param(f"pos_{tag}",
+                               nn.initializers.normal(0.02),
+                               (K, self.d_model))
+            for i in range(self.layers):
+                x = GTrXLBlock(self.d_model, self.heads,
+                               name=f"block_{tag}_{i}")(x, mask)
+            return x[:, -1]
+
+        logits = nn.Dense(self.num_actions, name="pi")(trunk("pi"))
+        value = nn.Dense(1, name="vf")(trunk("vf"))[..., 0]
+        return logits, value
+
+
+class AttnAnakinState(NamedTuple):
+    params: Any
+    opt_state: Any
+    env_states: Any
+    obs: jax.Array
+    hist: jax.Array       # [N, K, obs_dim] sliding window (newest last)
+    valid: jax.Array      # [N, K] bool
+    prev_done: jax.Array  # [N] — clear the window before the NEXT step
+    rng: jax.Array
+    ep_return: jax.Array
+    done_return_sum: jax.Array
+    done_count: jax.Array
+
+
+def make_anakin_ppo_attn(config):
+    """Builds (module, init_fn, jitted train_step, steps/iter) for
+    attention-memory PPO; mirrors make_anakin_ppo with window threading."""
+    from ray_tpu.rllib.algorithms.ppo import ppo_surrogate
+
+    env = make_jax_env(config.env) if isinstance(config.env, str) \
+        else config.env
+    if getattr(env, "obs_shape", None) is not None:
+        raise ValueError(
+            "use_attention supports flat-observation envs only (a "
+            "CNN+attention trunk is not wired); got pixel env "
+            f"{config.env!r} with obs_shape={env.obs_shape}")
+    if env.num_actions is None:
+        raise ValueError(
+            "use_attention supports discrete action spaces only; "
+            f"continuous env {config.env!r} belongs to the SAC family")
+    K = config.attention_window
+    module = AttentionActorCritic(
+        num_actions=env.num_actions, window=K,
+        d_model=config.attention_dim, heads=config.attention_num_heads,
+        layers=config.attention_num_layers)
+    tx_parts = []
+    if config.grad_clip:
+        tx_parts.append(optax.clip_by_global_norm(config.grad_clip))
+    tx_parts.append(optax.adam(config.lr))
+    tx = optax.chain(*tx_parts)
+
+    N, T = config.num_envs, config.unroll_length
+    batch_total = N * T
+    mb_size = min(config.sgd_minibatch_size, batch_total)
+    num_mb = batch_total // mb_size
+
+    def push(hist, valid, obs, prev_done):
+        """Clear windows of just-reset envs, then append the current obs
+        into slot K-1."""
+        keep = ~prev_done
+        hist = hist * keep[:, None, None]
+        valid = valid & keep[:, None]
+        hist = jnp.concatenate([hist[:, 1:], obs[:, None]], axis=1)
+        valid = jnp.concatenate(
+            [valid[:, 1:], jnp.ones((N, 1), bool)], axis=1)
+        return hist, valid
+
+    def init_fn(seed: int = 0) -> AttnAnakinState:
+        rng = jax.random.PRNGKey(seed)
+        rng, k_init, k_env = jax.random.split(rng, 3)
+        env_states, obs = vector_reset(env, k_env, N)
+        hist = jnp.zeros((N, K, env.obs_dim))
+        valid = jnp.zeros((N, K), bool)
+        params = module.init(k_init, hist, valid)
+        return AttnAnakinState(params, tx.init(params), env_states, obs,
+                               hist, valid, jnp.zeros(N, bool), rng,
+                               jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
+
+    def rollout_step(carry, _):
+        (params, env_states, obs, hist, valid, prev_done, rng, ep_ret,
+         dsum, dcnt) = carry
+        rng, k_act, k_step = jax.random.split(rng, 3)
+        hist, valid = push(hist, valid, obs, prev_done)
+        logits, value = module.apply(params, hist, valid)
+        action = jax.random.categorical(k_act, logits)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(logp_all, action[:, None], -1)[:, 0]
+        env_states, next_obs, reward, done, _ = vector_step(
+            env, env_states, action, k_step)
+        ep_ret = ep_ret + reward
+        dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+        dcnt = dcnt + jnp.sum(done)
+        ep_ret = jnp.where(done, 0.0, ep_ret)
+        out = (hist, valid, action, logp, value, reward, done)
+        return (params, env_states, next_obs, hist, valid, done, rng,
+                ep_ret, dsum, dcnt), out
+
+    def attn_ppo_loss(params, batch):
+        logits, value = module.apply(params, batch["hist"], batch["valid"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], -1)[:, 0]
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+        return ppo_surrogate(logp, value, entropy, batch,
+                             clip_param=config.clip_param,
+                             vf_clip_param=config.vf_clip_param,
+                             vf_loss_coeff=config.vf_loss_coeff,
+                             entropy_coeff=config.entropy_coeff)
+
+    def train_step(state: AttnAnakinState
+                   ) -> Tuple[AttnAnakinState, Dict[str, jax.Array]]:
+        carry = (state.params, state.env_states, state.obs, state.hist,
+                 state.valid, state.prev_done, state.rng, state.ep_return,
+                 state.done_return_sum, state.done_count)
+        carry, traj = jax.lax.scan(rollout_step, carry, None, length=T)
+        (params, env_states, obs, hist, valid, prev_done, rng, ep_ret,
+         dsum, dcnt) = carry
+        hist_t, valid_t, act_t, logp_t, val_t, rew_t, done_t = traj
+
+        nhist, nvalid = push(hist, valid, obs, prev_done)
+        _, last_value = module.apply(params, nhist, nvalid)
+        adv, vtarg = gae_jax(rew_t, val_t, done_t, last_value,
+                             config.gamma, config.lambda_)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        # Feedforward training: every step's forward depends only on its
+        # own window — flatten [T, N] and minibatch arbitrarily.
+        flat = {
+            "hist": hist_t.reshape(batch_total, K, -1),
+            "valid": valid_t.reshape(batch_total, K),
+            "actions": act_t.reshape(batch_total),
+            "action_logp": logp_t.reshape(batch_total),
+            "advantages": adv.reshape(batch_total),
+            "value_targets": vtarg.reshape(batch_total),
+        }
+
+        from ray_tpu.rllib.algorithms.ppo import run_ppo_sgd
+
+        (params, opt_state, rng), (losses, auxes) = run_ppo_sgd(
+            params, state.opt_state, rng, attn_ppo_loss,
+            lambda idx: {k_: v[idx] for k_, v in flat.items()},
+            batch_total, mb_size, num_mb, config.num_sgd_iter, tx)
+
+        new_state = AttnAnakinState(params, opt_state, env_states, obs,
+                                    hist, valid, prev_done, rng, ep_ret,
+                                    dsum, dcnt)
+        metrics = {
+            "total_loss": losses.mean(),
+            "policy_loss": auxes["policy_loss"].mean(),
+            "vf_loss": auxes["vf_loss"].mean(),
+            "entropy": auxes["entropy"].mean(),
+            "episode_return_sum": dsum,
+            "episode_count": dcnt,
+        }
+        return new_state, metrics
+
+    return module, init_fn, jax.jit(train_step), batch_total

@@ -184,39 +184,24 @@ def make_anakin_ppo_rnn(config):
                              config.gamma, config.lambda_)
         adv = (adv - adv.mean()) / (adv.std() + 1e-8)
 
-        def sgd_epoch(carry_sgd, _):
-            params, opt_state, rng = carry_sgd
-            rng, k = jax.random.split(rng)
-            perm = jax.random.permutation(k, N)
+        from ray_tpu.rllib.algorithms.ppo import run_ppo_sgd
 
-            def mb_step(carry_mb, env_idx):
-                params, opt_state = carry_mb
-                mb = {
-                    "carry0": jax.tree_util.tree_map(
-                        lambda c: c[env_idx], carry0),
-                    "obs": obs_t[:, env_idx],
-                    "resets": reset_t[:, env_idx],
-                    "actions": act_t[:, env_idx],
-                    "action_logp": logp_t[:, env_idx],
-                    "advantages": adv[:, env_idx],
-                    "value_targets": vtarg[:, env_idx],
-                }
-                (loss, aux), grads = jax.value_and_grad(
-                    seq_ppo_loss, has_aux=True)(params, mb)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), (loss, aux)
+        def make_mb(env_idx):
+            # Minibatches cut across the ENV axis: whole sequences intact.
+            return {
+                "carry0": jax.tree_util.tree_map(
+                    lambda c: c[env_idx], carry0),
+                "obs": obs_t[:, env_idx],
+                "resets": reset_t[:, env_idx],
+                "actions": act_t[:, env_idx],
+                "action_logp": logp_t[:, env_idx],
+                "advantages": adv[:, env_idx],
+                "value_targets": vtarg[:, env_idx],
+            }
 
-            idxs = perm[: num_mb * envs_per_mb].reshape(num_mb, envs_per_mb)
-            (params, opt_state), (losses, auxes) = jax.lax.scan(
-                mb_step, (params, opt_state), idxs)
-            return (params, opt_state, rng), (losses.mean(),
-                                              {k_: v.mean() for k_, v
-                                               in auxes.items()})
-
-        (params, opt_state, rng), (losses, auxes) = jax.lax.scan(
-            sgd_epoch, (params, state.opt_state, rng), None,
-            length=config.num_sgd_iter)
+        (params, opt_state, rng), (losses, auxes) = run_ppo_sgd(
+            params, state.opt_state, rng, seq_ppo_loss, make_mb,
+            N, envs_per_mb, num_mb, config.num_sgd_iter, tx)
 
         new_state = RNNAnakinState(params, opt_state, env_states, obs,
                                    carry, prev_done, rng, ep_ret, dsum,

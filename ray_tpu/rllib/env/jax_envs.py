@@ -221,6 +221,122 @@ class Breakout:
         return out_state, obs, reward, done, {}
 
 
+class SpaceInvaders:
+    """Atari-class pixel Space Invaders on a 10x10 board (MinAtar-scale,
+    clean-room from the published game description, like Breakout above).
+
+    A cannon on the bottom row moves left/right and fires; a marching
+    alien block descends one row each time it hits a side wall; random
+    alive aliens drop bullets.  Reward +1 per alien shot.  Episode ends
+    when an enemy bullet reaches the cannon, the aliens reach the bottom
+    row, or at max_steps; a cleared wave respawns.  Observation:
+    [10, 10, 4] float channels {cannon, aliens, friendly bullets, enemy
+    bullets} — same CNN trunk as Breakout.  Actions: 0 noop, 1 left,
+    2 right, 3 fire (cooldown-limited).  Fully jittable: flat pytree
+    state, all branching via jnp.where.
+    """
+
+    num_actions = 4
+    obs_shape = (10, 10, 4)
+    H = 10
+    W = 10
+    max_steps = 1000
+    move_interval = 4     # alien march period in env steps
+    shot_cooldown = 4     # min steps between cannon shots
+    enemy_fire_prob = 0.2
+
+    def _initial_aliens(self):
+        return jnp.zeros((self.H, self.W), jnp.bool_).at[1:5, 2:8].set(True)
+
+    def reset(self, rng):
+        state = {
+            "pos": jnp.array(self.W // 2, jnp.int32),
+            "aliens": self._initial_aliens(),
+            "dir": jnp.array(1, jnp.int32),
+            "move_t": jnp.zeros((), jnp.int32),
+            "shot_t": jnp.zeros((), jnp.int32),
+            "fbul": jnp.zeros((self.H, self.W), jnp.bool_),
+            "ebul": jnp.zeros((self.H, self.W), jnp.bool_),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        return state, self._obs(state)
+
+    def _obs(self, s):
+        obs = jnp.zeros(self.obs_shape, jnp.float32)
+        obs = obs.at[self.H - 1, s["pos"], 0].set(1.0)
+        obs = obs.at[:, :, 1].set(s["aliens"].astype(jnp.float32))
+        obs = obs.at[:, :, 2].set(s["fbul"].astype(jnp.float32))
+        obs = obs.at[:, :, 3].set(s["ebul"].astype(jnp.float32))
+        return obs
+
+    @staticmethod
+    def _shift_up(m):
+        return jnp.concatenate([m[1:], jnp.zeros_like(m[:1])], axis=0)
+
+    @staticmethod
+    def _shift_down(m):
+        return jnp.concatenate([jnp.zeros_like(m[:1]), m[:-1]], axis=0)
+
+    @staticmethod
+    def _shift_x(m, d):
+        left = jnp.concatenate([m[:, 1:], jnp.zeros_like(m[:, :1])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(m[:, :1]), m[:, :-1]], axis=1)
+        return jnp.where(d > 0, right, left)
+
+    def step(self, s, action, rng):
+        k_fire, k_col = jax.random.split(rng)
+        pos = jnp.clip(s["pos"] - (action == 1) + (action == 2),
+                       0, self.W - 1).astype(jnp.int32)
+        # Bullets travel one cell per step; in-flight bullets move BEFORE
+        # the new shot spawns, so a fresh bullet really starts at row H-2
+        # (spawning first would advance it to H-3 on its spawn turn and
+        # make aliens on row H-2 unhittable).
+        fbul = self._shift_up(s["fbul"])
+        ebul = self._shift_down(s["ebul"])
+        # Cannon fire (cooldown-limited): bullet spawns above the cannon.
+        can_fire = (action == 3) & (s["shot_t"] <= 0)
+        fbul = fbul.at[self.H - 2, pos].max(can_fire)
+        shot_t = jnp.where(can_fire, self.shot_cooldown,
+                           jnp.maximum(s["shot_t"] - 1, 0)).astype(jnp.int32)
+        # Alien march: sideways each interval; edge hit -> descend + flip.
+        move_now = s["move_t"] + 1 >= self.move_interval
+        aliens = s["aliens"]
+        at_edge = jnp.where(s["dir"] > 0, aliens[:, -1].any(),
+                            aliens[:, 0].any())
+        descend = move_now & at_edge
+        new_dir = jnp.where(descend, -s["dir"], s["dir"]).astype(jnp.int32)
+        aliens = jnp.where(
+            descend, self._shift_down(aliens),
+            jnp.where(move_now, self._shift_x(aliens, s["dir"]), aliens))
+        move_t = jnp.where(move_now, 0, s["move_t"] + 1).astype(jnp.int32)
+        # A random alive alien drops a bullet.
+        fire = jax.random.bernoulli(k_fire, self.enemy_fire_prob) \
+            & aliens.any()
+        flat_logits = jnp.where(aliens.reshape(-1), 0.0, -1e9)
+        idx = jax.random.categorical(k_col, flat_logits)
+        ebul = jnp.where(
+            fire, ebul.at[idx // self.W, idx % self.W].set(True), ebul)
+        # Friendly bullets hitting aliens: both vanish, +1 each.
+        hits = fbul & aliens
+        reward = jnp.sum(hits).astype(jnp.float32)
+        aliens = aliens & ~hits
+        fbul = fbul & ~hits
+        # Death: enemy bullet on the cannon, or invasion reaches bottom.
+        dead = ebul[self.H - 1, pos] | aliens[self.H - 1].any()
+        # Cleared wave respawns.
+        aliens = jnp.where(aliens.any(), aliens, self._initial_aliens())
+        t = s["t"] + 1
+        done = dead | (t >= self.max_steps)
+        new_state = {"pos": pos, "aliens": aliens, "dir": new_dir,
+                     "move_t": move_t, "shot_t": shot_t, "fbul": fbul,
+                     "ebul": ebul, "t": t}
+        reset_state, reset_obs = self.reset(rng)
+        out_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, a, b), reset_state, new_state)
+        obs = jnp.where(done, reset_obs, self._obs(new_state))
+        return out_state, obs, reward, done, {}
+
+
 class StatelessCartPole(CartPole):
     """CartPole with the velocity components hidden (obs = [x, theta]) —
     the classic recurrent-policy testbed: a memoryless policy cannot infer
@@ -256,6 +372,7 @@ REGISTRY = {
     "Pendulum-v1": Pendulum,
     "PendulumContinuous-v1": PendulumContinuous,
     "Breakout-MinAtar-v0": Breakout,
+    "SpaceInvaders-MinAtar-v0": SpaceInvaders,
 }
 
 

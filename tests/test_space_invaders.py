@@ -1,0 +1,112 @@
+"""SpaceInvaders-MinAtar: jittable env dynamics invariants + PPO learning
+gate (reference pattern: per-algorithm/per-env learning tests,
+rllib/utils/test_utils.py:57; env is a clean-room MinAtar-scale game like
+the Breakout board)."""
+import math
+
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env.jax_envs import (SpaceInvaders, make_jax_env,
+                                        vector_reset, vector_step)
+
+
+def test_registry_and_shapes():
+    env = make_jax_env("SpaceInvaders-MinAtar-v0")
+    assert isinstance(env, SpaceInvaders)
+    key = jax.random.PRNGKey(0)
+    states, obs = vector_reset(env, key, 4)
+    assert obs.shape == (4, 10, 10, 4)
+    states, obs, r, d, _ = vector_step(
+        env, states, jnp.zeros(4, jnp.int32), key)
+    assert obs.shape == (4, 10, 10, 4) and r.shape == (4,)
+
+
+def test_cannon_moves_and_fires():
+    env = SpaceInvaders()
+    key = jax.random.PRNGKey(0)
+    s, _ = env.reset(key)
+    x0 = int(s["pos"])
+    s, *_ = env.step(s, jnp.array(1), key)  # left
+    assert int(s["pos"]) == max(0, x0 - 1)
+    s, *_ = env.step(s, jnp.array(2), key)  # right
+    assert int(s["pos"]) == x0
+    s, *_ = env.step(s, jnp.array(3), key)  # fire
+    assert bool(s["fbul"].any()), "fire must spawn a friendly bullet"
+    assert int(s["shot_t"]) > 0, "cooldown must arm after firing"
+
+
+def test_aliens_march_and_descend():
+    env = SpaceInvaders()
+    key = jax.random.PRNGKey(0)
+    s, _ = env.reset(key)
+    rows0 = jnp.where(s["aliens"].any(axis=1))[0]
+    # March long enough to force at least one edge descent.
+    for i in range(env.move_interval * 12):
+        s, *_ = env.step(s, jnp.array(0), jax.random.fold_in(key, i))
+        if bool(s["t"] == 0):  # episode restarted (invasion/death)
+            break
+    rows = jnp.where(s["aliens"].any(axis=1))[0]
+    assert int(rows.min()) != int(rows0.min()) or bool(s["t"] == 0), \
+        "aliens never descended"
+
+
+def test_shooting_aliens_scores():
+    """Park the cannon under the alien block and fire: a reward must land
+    within a few steps as the bullet travels up."""
+    env = SpaceInvaders()
+    key = jax.random.PRNGKey(1)
+    s, _ = env.reset(key)
+    total = 0.0
+    for i in range(40):
+        a = jnp.array(3)  # fire repeatedly from the centre
+        s, _o, r, d, _ = env.step(s, a, jax.random.fold_in(key, i))
+        total += float(r)
+        if total > 0:
+            break
+    assert total > 0, "shots straight into the block never scored"
+
+
+def test_episode_terminates():
+    env = SpaceInvaders()
+    key = jax.random.PRNGKey(2)
+    states, _ = vector_reset(env, key, 16)
+
+    @jax.jit
+    def run(states, key):
+        def body(carry, i):
+            states, key, dones = carry
+            key, ka, ks = jax.random.split(key, 3)
+            acts = jax.random.randint(ka, (16,), 0, 4)
+            states, _o, _r, d, _ = vector_step(env, states, acts, ks)
+            return (states, key, dones + d.sum()), None
+
+        (states, key, dones), _ = jax.lax.scan(
+            body, (states, key, 0.0), jnp.arange(600))
+        return dones
+
+    assert float(run(states, key)) > 0
+
+
+def test_anakin_ppo_space_invaders_learns():
+    """Fast gate: clear 6.0 mean reward (random play scores ~4.7; trained
+    runs reach ~10) within 40 iters on the CPU mesh."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("SpaceInvaders-MinAtar-v0")
+            .anakin(num_envs=128, unroll_length=64)
+            .training(num_sgd_iter=2, sgd_minibatch_size=2048, lr=3e-4,
+                      entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build())
+    best = 0.0
+    for _ in range(40):
+        m = algo.train()
+        r = m.get("episode_reward_mean", float("nan"))
+        if not math.isnan(r):
+            best = max(best, r)
+        if best >= 6.0:
+            break
+    assert best >= 6.0, f"no learning on space invaders: best={best}"
