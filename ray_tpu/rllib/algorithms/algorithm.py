@@ -48,6 +48,44 @@ class Algorithm:
         if workers is not None:
             workers.stop()
 
+    def evaluate(self, num_steps: int = 1000) -> Dict[str, float]:
+        """Greedy in-env evaluation (reference: Algorithm.evaluate /
+        the `rllib evaluate` CLI).  Default covers anakin algorithms
+        whose module speaks the RLModule forward_inference protocol;
+        offline algorithms override with their own evaluators."""
+        module = getattr(self, "module", None)
+        if self.config.mode != "anakin" or module is None \
+                or not hasattr(module, "forward_inference"):
+            raise NotImplementedError(
+                f"{type(self).__name__} has no generic evaluator (needs "
+                "anakin mode + an RLModule with forward_inference)")
+        import jax
+
+        from ray_tpu.rllib.algorithms.bc import make_greedy_eval_rollout
+        from ray_tpu.rllib.env.jax_envs import make_jax_env
+
+        if getattr(self, "_eval_rollout_fn", None) is None:
+            try:
+                env = make_jax_env(self.config.env) \
+                    if isinstance(self.config.env, str) else self.config.env
+            except ValueError:
+                # e.g. multi-agent env names live in their own registry
+                # and speak a different rollout protocol.
+                raise NotImplementedError(
+                    f"no generic evaluator for env {self.config.env!r} "
+                    "(not a single-agent jittable env)") from None
+            if getattr(env, "obs_dim", None) is None \
+                    and getattr(env, "obs_shape", None) is None:
+                raise NotImplementedError(
+                    f"env {type(env).__name__} does not speak the "
+                    "single-agent jittable protocol")
+            self._eval_rollout_fn = make_greedy_eval_rollout(env, module)
+            self._eval_rollout_key = jax.random.PRNGKey(
+                self.config.seed + 1)
+        self._eval_rollout_key, k = jax.random.split(self._eval_rollout_key)
+        r = self._eval_rollout_fn(self._anakin_state.params, k, num_steps)
+        return {"episode_reward_mean": float(r)}
+
     # ---- checkpointing (Trainable protocol) ----
     def save_checkpoint(self) -> Checkpoint:
         if self.config.mode == "anakin":

@@ -120,6 +120,11 @@ class AlgorithmConfig:
                     v.get("attention_num_heads", self.attention_num_heads))
                 self.attention_window = int(
                     v.get("attention_window", self.attention_window))
+                if ("attention_num_transformer_units" in v
+                        and "attention_num_layers" in v):
+                    raise ValueError(
+                        "pass attention_num_transformer_units (reference "
+                        "key) OR attention_num_layers, not both")
                 self.attention_num_layers = int(
                     v.get("attention_num_transformer_units",
                           v.get("attention_num_layers",

@@ -4,7 +4,9 @@ from ray_tpu.train.base_trainer import (  # noqa: F401
     BaseTrainer,
     DataParallelTrainer,
 )
+from ray_tpu.train.gbdt import LightGBMTrainer, XGBoostTrainer  # noqa: F401
 from ray_tpu.train.jax import JaxConfig, JaxTrainer  # noqa: F401
+from ray_tpu.train.sklearn import SklearnPredictor, SklearnTrainer  # noqa: F401
 from ray_tpu.train._internal.backend_executor import (  # noqa: F401
     BackendExecutor,
     TrainingWorkerError,

@@ -1,0 +1,59 @@
+"""XGBoost / LightGBM trainers (reference:
+python/ray/train/xgboost/xgboost_trainer.py, lightgbm/, gbdt_trainer.py).
+
+Both libraries speak the sklearn fit/predict/score contract, so the
+trainers are thin subclasses of SklearnTrainer that construct the
+library's sklearn-API estimator.  Neither library ships in this image
+(no package egress), so construction is import-gated with an actionable
+error instead of failing deep inside a fit worker.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.sklearn import SklearnTrainer
+
+
+def _require(module_name: str, trainer_name: str):
+    try:
+        return __import__(module_name)
+    except ImportError:
+        raise ImportError(
+            f"{trainer_name} requires the '{module_name}' package, which "
+            f"is not installed in this environment. Install it (pip "
+            f"install {module_name}) or use SklearnTrainer with e.g. "
+            f"sklearn.ensemble.HistGradientBoostingRegressor — the "
+            f"in-tree gradient-boosting estimator with the same "
+            f"contract.") from None
+
+
+class XGBoostTrainer(SklearnTrainer):
+    """reference: XGBoostTrainer (train/xgboost/xgboost_trainer.py)."""
+
+    def __init__(self, *, params: Optional[Dict[str, Any]] = None,
+                 objective: str = "reg:squarederror",
+                 datasets: Dict[str, Any], label_column: Optional[str] = None,
+                 **kwargs):
+        xgb = _require("xgboost", "XGBoostTrainer")
+        params = dict(params or {})
+        cls = (xgb.XGBClassifier if objective.startswith(("binary", "multi"))
+               else xgb.XGBRegressor)
+        super().__init__(estimator=cls(objective=objective, **params),
+                         datasets=datasets, label_column=label_column,
+                         **kwargs)
+
+
+class LightGBMTrainer(SklearnTrainer):
+    """reference: LightGBMTrainer (train/lightgbm/lightgbm_trainer.py)."""
+
+    def __init__(self, *, params: Optional[Dict[str, Any]] = None,
+                 objective: str = "regression",
+                 datasets: Dict[str, Any], label_column: Optional[str] = None,
+                 **kwargs):
+        lgb = _require("lightgbm", "LightGBMTrainer")
+        params = dict(params or {})
+        cls = (lgb.LGBMClassifier if objective in ("binary", "multiclass")
+               else lgb.LGBMRegressor)
+        super().__init__(estimator=cls(objective=objective, **params),
+                         datasets=datasets, label_column=label_column,
+                         **kwargs)

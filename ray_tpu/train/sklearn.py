@@ -1,0 +1,105 @@
+"""SklearnTrainer + SklearnPredictor (reference:
+python/ray/train/sklearn/sklearn_trainer.py — fit an sklearn-API
+estimator on a Dataset in a remote worker, score it, and checkpoint the
+pickled model; sklearn_predictor.py for batch inference).
+
+The same `_fit_remote` path backs the gated XGBoost/LightGBM trainers
+(train/gbdt.py): anything with the sklearn fit/predict/score contract
+trains through here.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.result import Result
+from ray_tpu.train.base_trainer import BaseTrainer
+from ray_tpu.train.predictor import Predictor
+
+_MODEL_KEY = "_sklearn_model"
+
+
+def _dataset_to_xy(ds, label_column: str):
+    """Materialize a (possibly distributed) Dataset into X, y arrays —
+    sklearn estimators are single-process, so the fit worker gathers."""
+    rows = ds.take_all() if hasattr(ds, "take_all") else list(ds)
+    if not rows:
+        raise ValueError("empty training dataset")
+    feature_keys = [k for k in rows[0] if k != label_column]
+    X = np.asarray([[row[k] for k in feature_keys] for row in rows])
+    y = np.asarray([row[label_column] for row in rows])
+    return X, y, feature_keys
+
+
+class SklearnTrainer(BaseTrainer):
+    """Fits `estimator` on datasets["train"] (a ray_tpu Dataset, or a
+    dict of numpy arrays {"x": ..., "y": ...}); optional "valid" dataset
+    adds a validation score.  The fit runs in a remote worker so driver
+    memory/GIL stay free (reference runs it in a trainable actor)."""
+
+    def __init__(self, *, estimator, datasets: Dict[str, Any],
+                 label_column: Optional[str] = None, **kwargs):
+        super().__init__(**kwargs)
+        if "train" not in datasets:
+            raise ValueError('datasets must contain a "train" entry')
+        self.estimator = estimator
+        self.datasets = datasets
+        self.label_column = label_column
+
+    def _run(self, checkpoint: Optional[Checkpoint]) -> Result:
+        import ray_tpu
+
+        estimator, datasets, label = (self.estimator, self.datasets,
+                                      self.label_column)
+
+        @ray_tpu.remote(max_retries=0)
+        def fit_remote():
+            import pickle
+
+            def to_xy(d):
+                if isinstance(d, dict):
+                    return np.asarray(d["x"]), np.asarray(d["y"]), None
+                return _dataset_to_xy(d, label)
+
+            X, y, feats = to_xy(datasets["train"])
+            estimator.fit(X, y)
+            metrics = {"train_score": float(estimator.score(X, y)),
+                       "n_samples": int(len(y))}
+            if "valid" in datasets:
+                Xv, yv, _ = to_xy(datasets["valid"])
+                metrics["valid_score"] = float(estimator.score(Xv, yv))
+            return metrics, pickle.dumps(estimator), feats
+
+        metrics, blob, feats = ray_tpu.get(fit_remote.remote())
+        ckpt = Checkpoint.from_dict({_MODEL_KEY: blob,
+                                     "feature_keys": feats})
+        self._latest_checkpoint = ckpt
+        return Result(metrics=metrics, checkpoint=ckpt,
+                      metrics_history=[metrics])
+
+
+class SklearnPredictor(Predictor):
+    """Batch inference over a fitted estimator (reference:
+    sklearn_predictor.py); plugs into BatchPredictor."""
+
+    def __init__(self, model, feature_keys=None):
+        self.model = model
+        self.feature_keys = feature_keys
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kw):
+        import pickle
+
+        d = checkpoint.to_dict()
+        return cls(pickle.loads(d[_MODEL_KEY]),
+                   feature_keys=d.get("feature_keys"), **kw)
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        if "x" in batch:
+            X = np.asarray(batch["x"])
+        else:
+            keys = self.feature_keys or sorted(batch)
+            X = np.stack([np.asarray(batch[k]) for k in keys], axis=1)
+        return {"predictions": np.asarray(self.model.predict(X))}

@@ -1,0 +1,175 @@
+"""rllib train/evaluate CLIs (reference: rllib/train.py, rllib/evaluate.py,
+tuned_examples yaml format) and the sklearn/GBDT trainer family
+(reference: train/sklearn/, train/xgboost/, train/gbdt_trainer.py)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(mod, *args, timeout=600):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    return subprocess.run([sys.executable, "-m", mod, *args],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env)
+
+
+class TestRllibCLI:
+    def test_train_flags_then_evaluate_checkpoint(self, tmp_path):
+        """Full CLI round trip: train PPO briefly, checkpoint, evaluate."""
+        ckpt_dir = str(tmp_path / "ckpt")
+        out = _run_cli("ray_tpu.rllib.train", "--algo", "PPO",
+                       "--env", "CartPole-v1", "--stop-iters", "3",
+                       "--config", '{"num_envs": 16, "unroll_length": 16}',
+                       "--checkpoint-dir", ckpt_dir)
+        assert out.returncode == 0, out.stderr[-2000:]
+        metrics = json.loads(out.stdout.strip().splitlines()[-1])
+        assert metrics["training_iteration"] == 3
+        assert metrics["checkpoint_path"]
+
+        ev = _run_cli("ray_tpu.rllib.evaluate", metrics["checkpoint_path"],
+                      "--algo", "PPO", "--env", "CartPole-v1",
+                      "--config", '{"num_envs": 16, "unroll_length": 16}',
+                      "--steps", "300")
+        assert ev.returncode == 0, ev.stderr[-2000:]
+        result = json.loads(ev.stdout.strip().splitlines()[-1])
+        assert "episode_reward_mean" in result
+
+    def test_train_from_yaml_file(self, tmp_path):
+        cfg = tmp_path / "exp.yaml"
+        cfg.write_text(
+            "tiny-ppo:\n"
+            "  run: PPO\n"
+            "  env: CartPole-v1\n"
+            "  stop: {training_iteration: 2}\n"
+            "  config:\n"
+            "    num_envs: 16\n"
+            "    unroll_length: 16\n")
+        out = _run_cli("ray_tpu.rllib.train", "-f", str(cfg))
+        assert out.returncode == 0, out.stderr[-2000:]
+        results = json.loads(out.stdout.strip().splitlines()[-1])
+        assert results["tiny-ppo"]["training_iteration"] == 2
+
+    def test_tuned_examples_parse_and_reference_known_configs(self):
+        import yaml
+
+        from ray_tpu.rllib import ALGORITHMS
+        from ray_tpu.rllib.env.jax_envs import REGISTRY
+        from ray_tpu.rllib.train import apply_config
+        from ray_tpu.rllib import get_algorithm_config
+
+        ex_dir = os.path.join(REPO, "ray_tpu", "rllib", "tuned_examples")
+        files = [f for f in os.listdir(ex_dir) if f.endswith(".yaml")]
+        assert len(files) >= 5
+        for fname in files:
+            with open(os.path.join(ex_dir, fname)) as f:
+                experiments = yaml.safe_load(f)
+            for name, exp in experiments.items():
+                assert exp["run"] in ALGORITHMS, (fname, name)
+                assert exp["env"] in REGISTRY, (fname, name)
+                # The config must apply cleanly (typo guard).
+                cfg = get_algorithm_config(exp["run"]).environment(exp["env"])
+                apply_config(cfg, exp.get("config", {}))
+
+    def test_unknown_config_key_fails_loudly(self):
+        from ray_tpu.rllib import get_algorithm_config
+        from ray_tpu.rllib.train import apply_config
+
+        with pytest.raises(ValueError, match="unknown config key"):
+            apply_config(get_algorithm_config("PPO"), {"lrr": 1e-3})
+
+    def test_generic_evaluate_on_trained_algo(self):
+        from ray_tpu.rllib import PPOConfig
+
+        algo = (PPOConfig().environment("CartPole-v1")
+                .anakin(num_envs=16, unroll_length=16).build())
+        algo.train()
+        out = algo.evaluate(num_steps=200)
+        assert np.isfinite(out["episode_reward_mean"])
+
+    def test_generic_evaluate_rejects_multi_agent(self):
+        """MAPPO passes the module guard but its envs speak a different
+        rollout protocol — evaluate must refuse, not mis-rollout."""
+        from ray_tpu.rllib import MAPPOConfig
+        from ray_tpu.rllib.env.multi_agent import MA_REGISTRY
+
+        name = next(iter(MA_REGISTRY))
+        algo = (MAPPOConfig().environment(name)
+                .anakin(num_envs=8, unroll_length=8).build())
+        with pytest.raises(NotImplementedError):
+            algo.evaluate(num_steps=50)
+
+    def test_conflicting_attention_layer_keys_rejected(self):
+        from ray_tpu.rllib import PPOConfig
+
+        with pytest.raises(ValueError, match="not both"):
+            (PPOConfig().training(
+                model={"attention_num_layers": 4,
+                       "attention_num_transformer_units": 1}))
+
+
+class TestSklearnTrainers:
+    def _toy(self, n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 3))
+        y = X @ np.array([1.0, -2.0, 0.5]) + 0.01 * rng.normal(size=n)
+        return X, y
+
+    def test_sklearn_trainer_numpy_datasets(self, ray_start_regular):
+        from sklearn.linear_model import LinearRegression
+
+        from ray_tpu.train import SklearnPredictor, SklearnTrainer
+
+        X, y = self._toy()
+        trainer = SklearnTrainer(
+            estimator=LinearRegression(),
+            datasets={"train": {"x": X, "y": y},
+                      "valid": {"x": X[:50], "y": y[:50]}})
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["train_score"] > 0.99
+        assert result.metrics["valid_score"] > 0.99
+        pred = SklearnPredictor.from_checkpoint(result.checkpoint)
+        out = pred.predict({"x": X[:5]})
+        np.testing.assert_allclose(out["predictions"], y[:5], atol=0.2)
+
+    def test_sklearn_trainer_on_dataset(self, ray_start_regular):
+        from sklearn.linear_model import LogisticRegression
+
+        import ray_tpu.data as rdata
+        from ray_tpu.train import SklearnTrainer
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 2))
+        y = (X[:, 0] + X[:, 1] > 0).astype(int)
+        ds = rdata.from_items(
+            [{"a": float(a), "b": float(b), "label": int(c)}
+             for (a, b), c in zip(X, y)])
+        trainer = SklearnTrainer(estimator=LogisticRegression(),
+                                 datasets={"train": ds},
+                                 label_column="label")
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["train_score"] > 0.9
+
+    def test_gbdt_trainers_gated_without_libs(self):
+        from ray_tpu.train import LightGBMTrainer, XGBoostTrainer
+
+        with pytest.raises(ImportError, match="xgboost"):
+            XGBoostTrainer(datasets={"train": {"x": [[0.0]], "y": [0.0]}})
+        with pytest.raises(ImportError, match="lightgbm"):
+            LightGBMTrainer(datasets={"train": {"x": [[0.0]], "y": [0.0]}})
+
+    def test_missing_train_dataset_rejected(self):
+        from sklearn.linear_model import LinearRegression
+
+        from ray_tpu.train import SklearnTrainer
+
+        with pytest.raises(ValueError, match="train"):
+            SklearnTrainer(estimator=LinearRegression(), datasets={})
