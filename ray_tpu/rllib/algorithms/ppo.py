@@ -226,6 +226,40 @@ class PPO(Algorithm):
              self._steps_per_iter) = make_anakin_ppo(self.config)
         self._anakin_state = init_fn(self.config.seed)
 
+    def evaluate(self, num_steps: int = 1000) -> Dict[str, Any]:
+        """Extends the generic evaluator to the memory policies: the
+        LSTM/attention modules need their carry/window threaded through
+        the greedy rollout."""
+        if self.config.mode == "anakin" and (self.config.use_lstm
+                                             or self.config.use_attention):
+            import jax
+
+            from ray_tpu.rllib.env.jax_envs import make_jax_env
+
+            if getattr(self, "_eval_rollout_fn", None) is None:
+                env = make_jax_env(self.config.env) \
+                    if isinstance(self.config.env, str) else self.config.env
+                if self.config.use_lstm:
+                    from ray_tpu.rllib.algorithms.ppo_rnn import \
+                        make_rnn_eval_rollout
+
+                    self._eval_rollout_fn = make_rnn_eval_rollout(
+                        env, self.module, self.config.lstm_cell_size)
+                else:
+                    from ray_tpu.rllib.algorithms.ppo_attn import \
+                        make_attn_eval_rollout
+
+                    self._eval_rollout_fn = make_attn_eval_rollout(
+                        env, self.module, self.config.attention_window)
+                self._eval_rollout_key = jax.random.PRNGKey(
+                    self.config.seed + 1)
+            self._eval_rollout_key, k = jax.random.split(
+                self._eval_rollout_key)
+            r = self._eval_rollout_fn(self._anakin_state.params, k,
+                                      num_steps)
+            return {"episode_reward_mean": float(r)}
+        return super().evaluate(num_steps)
+
     def _training_step_anakin(self) -> Dict[str, Any]:
         self._anakin_state, metrics = self._train_step(self._anakin_state)
         # ONE host fetch for every metric: each separate device->host read

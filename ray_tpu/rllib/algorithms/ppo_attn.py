@@ -105,6 +105,49 @@ class AttentionActorCritic(nn.Module):
         return logits, value
 
 
+def make_attn_eval_rollout(env, module, window: int,
+                           num_eval_envs: int = 16):
+    """Greedy in-env rollout threading the observation window — the
+    attention-policy analogue of bc.make_greedy_eval_rollout (used by
+    Algorithm.evaluate / the `rllib evaluate` CLI)."""
+
+    def eval_rollout(params, key, num_steps: int):
+        k_env, k_run = jax.random.split(key)
+        env_states, obs = vector_reset(env, k_env, num_eval_envs)
+
+        def step(carry, _):
+            (env_states, obs, hist, valid, prev_done, rng, ep_ret, dsum,
+             dcnt) = carry
+            rng, k_s = jax.random.split(rng)
+            keep = ~prev_done
+            hist = hist * keep[:, None, None]
+            valid = valid & keep[:, None]
+            hist = jnp.concatenate([hist[:, 1:], obs[:, None]], axis=1)
+            valid = jnp.concatenate(
+                [valid[:, 1:], jnp.ones((num_eval_envs, 1), bool)], axis=1)
+            logits, _ = module.apply(params, hist, valid)
+            action = jnp.argmax(logits, axis=-1)
+            env_states, obs, reward, done, _ = vector_step(
+                env, env_states, action, k_s)
+            ep_ret = ep_ret + reward
+            dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+            dcnt = dcnt + jnp.sum(done)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            return (env_states, obs, hist, valid, done, rng, ep_ret,
+                    dsum, dcnt), None
+
+        carry = (env_states, obs,
+                 jnp.zeros((num_eval_envs, window, env.obs_dim)),
+                 jnp.zeros((num_eval_envs, window), bool),
+                 jnp.zeros(num_eval_envs, bool), k_run,
+                 jnp.zeros(num_eval_envs), jnp.zeros(()), jnp.zeros(()))
+        carry, _ = jax.lax.scan(step, carry, None, length=num_steps)
+        dsum, dcnt = carry[-2], carry[-1]
+        return dsum / jnp.maximum(dcnt, 1.0)
+
+    return jax.jit(eval_rollout, static_argnums=2)
+
+
 class AttnAnakinState(NamedTuple):
     params: Any
     opt_state: Any

@@ -65,6 +65,41 @@ def zero_carry(n: int, lstm_size: int):
     return (one, one)
 
 
+def make_rnn_eval_rollout(env, module, lstm_size: int,
+                          num_eval_envs: int = 16):
+    """Greedy in-env rollout threading the LSTM carry — the recurrent
+    analogue of bc.make_greedy_eval_rollout (used by Algorithm.evaluate
+    / the `rllib evaluate` CLI)."""
+
+    def eval_rollout(params, key, num_steps: int):
+        k_env, k_run = jax.random.split(key)
+        env_states, obs = vector_reset(env, k_env, num_eval_envs)
+
+        def step(carry_all, _):
+            (env_states, obs, carry, prev_done, rng, ep_ret, dsum,
+             dcnt) = carry_all
+            rng, k_s = jax.random.split(rng)
+            carry, logits, _ = module.apply(params, carry, obs, prev_done)
+            action = jnp.argmax(logits, axis=-1)
+            env_states, obs, reward, done, _ = vector_step(
+                env, env_states, action, k_s)
+            ep_ret = ep_ret + reward
+            dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+            dcnt = dcnt + jnp.sum(done)
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            return (env_states, obs, carry, done, rng, ep_ret, dsum,
+                    dcnt), None
+
+        carry = (env_states, obs, zero_carry(num_eval_envs, lstm_size),
+                 jnp.zeros(num_eval_envs, bool), k_run,
+                 jnp.zeros(num_eval_envs), jnp.zeros(()), jnp.zeros(()))
+        carry, _ = jax.lax.scan(step, carry, None, length=num_steps)
+        dsum, dcnt = carry[-2], carry[-1]
+        return dsum / jnp.maximum(dcnt, 1.0)
+
+    return jax.jit(eval_rollout, static_argnums=2)
+
+
 class RNNAnakinState(NamedTuple):
     params: Any
     opt_state: Any

@@ -82,6 +82,20 @@ def run_experiment(run: str, env: str, config: Optional[Dict[str, Any]] = None,
     return metrics
 
 
+def _json_safe(obj):
+    """NaN/±inf → None: json.dumps would otherwise emit literals that
+    strict JSON consumers (jq, most non-Python parsers) reject."""
+    import math
+
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
 def _load_experiments(path: str) -> Dict[str, dict]:
     import yaml
 
@@ -106,14 +120,20 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.file:
+        import os
+
         experiments = _load_experiments(args.file)
         out = {}
         for name, exp in experiments.items():
             print(f"== running {name} ==", file=sys.stderr)
+            # Per-experiment subdirectory: a shared dir would overwrite
+            # earlier experiments' checkpoints.
+            ckpt = (os.path.join(args.checkpoint_dir, name)
+                    if args.checkpoint_dir else None)
             out[name] = run_experiment(
                 exp["run"], exp["env"], exp.get("config"),
-                exp.get("stop"), args.checkpoint_dir)
-        print(json.dumps(out, default=str))
+                exp.get("stop"), ckpt)
+        print(json.dumps(_json_safe(out), default=str))
         return 0
     if not args.algo or not args.env:
         p.error("either -f FILE or both --algo and --env are required")
@@ -125,7 +145,7 @@ def main(argv=None) -> int:
     metrics = run_experiment(args.algo, args.env,
                              json.loads(args.config), stop,
                              args.checkpoint_dir)
-    print(json.dumps(metrics, default=str))
+    print(json.dumps(_json_safe(metrics), default=str))
     return 0
 
 

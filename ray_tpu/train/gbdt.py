@@ -52,7 +52,13 @@ class LightGBMTrainer(SklearnTrainer):
                  **kwargs):
         lgb = _require("lightgbm", "LightGBMTrainer")
         params = dict(params or {})
-        cls = (lgb.LGBMClassifier if objective in ("binary", "multiclass")
+        # LightGBM's classification objectives and their aliases (the
+        # library accepts several names per task).
+        classification = {"binary", "multiclass", "multiclassova",
+                          "multiclass_ova", "ova", "ovr",
+                          "cross_entropy", "xentropy",
+                          "cross_entropy_lambda", "xentlambda"}
+        cls = (lgb.LGBMClassifier if objective in classification
                else lgb.LGBMRegressor)
         super().__init__(estimator=cls(objective=objective, **params),
                          datasets=datasets, label_column=label_column,

@@ -44,6 +44,15 @@ class SklearnTrainer(BaseTrainer):
         super().__init__(**kwargs)
         if "train" not in datasets:
             raise ValueError('datasets must contain a "train" entry')
+        for name, d in datasets.items():
+            if not isinstance(d, dict) and label_column is None:
+                # Fail at construction, not with a KeyError(None) deep in
+                # the remote fit worker.
+                raise ValueError(
+                    f'dataset "{name}" is a Dataset of rows — pass '
+                    "label_column= to name the target column "
+                    '(numpy-dict datasets {"x": ..., "y": ...} do not '
+                    "need it)")
         self.estimator = estimator
         self.datasets = datasets
         self.label_column = label_column

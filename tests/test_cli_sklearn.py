@@ -105,6 +105,45 @@ class TestRllibCLI:
         with pytest.raises(NotImplementedError):
             algo.evaluate(num_steps=50)
 
+    def test_evaluate_memory_policies(self):
+        """The tuned attention example must have a working
+        train→checkpoint→evaluate round trip (and the LSTM path too)."""
+        from ray_tpu.rllib import PPOConfig
+
+        for model in ({"use_attention": True, "attention_window": 4},
+                      {"use_lstm": True, "lstm_cell_size": 32}):
+            algo = (PPOConfig().environment("StatelessCartPole-v1")
+                    .anakin(num_envs=8, unroll_length=8)
+                    .training(model=model).build())
+            algo.train()
+            ckpt = algo.save_checkpoint()
+            algo2 = (PPOConfig().environment("StatelessCartPole-v1")
+                     .anakin(num_envs=8, unroll_length=8)
+                     .training(model=model).build())
+            algo2.load_checkpoint(ckpt)
+            out = algo2.evaluate(num_steps=100)
+            assert np.isfinite(out["episode_reward_mean"]), model
+
+    def test_cli_json_output_is_strict_json(self):
+        from ray_tpu.rllib.train import _json_safe
+
+        out = _json_safe({"a": float("nan"), "b": [float("-inf"), 1.0],
+                          "c": {"d": float("inf")}})
+        assert out == {"a": None, "b": [None, 1.0], "c": {"d": None}}
+        json.dumps(out, allow_nan=False)  # must not raise
+
+    def test_sklearn_dataset_without_label_column_rejected(
+            self, ray_start_regular):
+        from sklearn.linear_model import LinearRegression
+
+        import ray_tpu.data as rdata
+        from ray_tpu.train import SklearnTrainer
+
+        ds = rdata.from_items([{"a": 1.0, "label": 0}])
+        with pytest.raises(ValueError, match="label_column"):
+            SklearnTrainer(estimator=LinearRegression(),
+                           datasets={"train": ds})
+
     def test_conflicting_attention_layer_keys_rejected(self):
         from ray_tpu.rllib import PPOConfig
 
