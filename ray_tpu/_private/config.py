@@ -155,4 +155,7 @@ CONFIG \
              "(reference default: ray_config_def.h:103).") \
     .declare("memory_monitor_test_file", str, "",
              "Test hook: read usage fraction from this file instead of "
-             "/proc (mirrors the reference's fake-memory test mode).")
+             "/proc (mirrors the reference's fake-memory test mode).") \
+    .declare("node_stats_period_s", float, 2.0,
+             "Per-node cpu/mem/store usage snapshot period "
+             "(0 disables; reference: the dashboard reporter agent).")

@@ -77,7 +77,8 @@ class ActorInfo:
 
 
 class NodeInfo:
-    __slots__ = ("node_id", "resources", "alive", "labels", "address", "last_heartbeat")
+    __slots__ = ("node_id", "resources", "alive", "labels", "address",
+                 "last_heartbeat", "stats")
 
     def __init__(self, node_id: NodeID, resources: Dict[str, float], labels=None):
         self.node_id = node_id
@@ -86,6 +87,7 @@ class NodeInfo:
         self.labels = labels or {}
         self.address = None
         self.last_heartbeat = time.monotonic()
+        self.stats: Dict[str, float] = {}  # cpu/mem/store usage snapshot
 
 
 class ObjectEntry:
@@ -442,6 +444,15 @@ class GCS:
                 for a in self.actors.values()
             ]
 
+    def update_node_stats(self, node_id: NodeID, stats: dict):
+        """Per-node usage snapshot from the monitor loop / node agent
+        (reference: the reporter agent feeding the dashboard)."""
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is not None:
+                info.stats = dict(stats)
+                info.last_heartbeat = time.monotonic()
+
     def list_nodes(self) -> List[dict]:
         with self._lock:
             return [
@@ -450,6 +461,7 @@ class GCS:
                     "alive": n.alive,
                     "resources": dict(n.resources),
                     "labels": dict(n.labels),
+                    "stats": dict(n.stats),
                 }
                 for n in self.nodes.values()
             ]

@@ -173,8 +173,34 @@ class Head:
         if self.memory_monitor.enabled:
             period = min(period, self.memory_monitor.period_s)
         period = max(0.02, period)  # floor: never busy-spin the head lock
+        stats_period = CONFIG.node_stats_period_s
+        last_stats = 0.0
         while not self._shutdown:
             _time.sleep(period)
+            # Local node stats (reference: the per-node reporter agent;
+            # local raylets share this host, so one host snapshot + each
+            # raylet's own store stats).  Remote nodes report over their
+            # agent connection instead.
+            now = _time.monotonic()
+            if stats_period > 0 and now - last_stats >= stats_period:
+                last_stats = now
+                from ray_tpu._private.node_stats import (collect_node_stats,
+                                                         host_snapshot)
+                from ray_tpu._private.raylet import RemoteRaylet
+
+                base = host_snapshot()  # ONE cpu/mem read per tick —
+                # local raylets share this host (per-raylet cpu_percent
+                # calls would measure microsecond intervals)
+                with self._lock:
+                    for raylet in self.raylets.values():
+                        if isinstance(raylet, RemoteRaylet):
+                            continue
+                        self.gcs.update_node_stats(
+                            raylet.node_id,
+                            collect_node_stats(
+                                store=raylet.store,
+                                num_workers=len(raylet.workers),
+                                host_base=base))
             with self._lock:
                 self.memory_monitor.tick()
                 for raylet in list(self.raylets.values()):
@@ -236,6 +262,8 @@ class Head:
     def add_remote_node(self, msg: dict, conn) -> NodeID:
         """A node agent registered over TCP: attach its host to the cluster
         (reference: raylet self-registration with the GCS)."""
+        from ray_tpu._private.config import CONFIG
+
         node_id = NodeID.from_random()
         resources = dict(msg["resources"])
         labels = msg.get("labels") or {}
@@ -256,7 +284,12 @@ class Head:
             self._drain_pending()
             self._drive_pending_pgs()
         self._send_on(conn, {"type": "node_registered",
-                             "node_id": node_id.binary()})
+                             "node_id": node_id.binary(),
+                             # Head-resolved config the agent must honor
+                             # (its own CONFIG never sees the head's
+                             # _system_config overrides).
+                             "node_stats_period_s":
+                                 CONFIG.node_stats_period_s})
         return node_id
 
     def add_remote_driver(self, msg: dict, conn) -> NodeID:
@@ -370,6 +403,10 @@ class Head:
                 elif mtype == "worker_exit":
                     if agent_node is not None:
                         self.on_remote_worker_exit(agent_node, msg)
+                elif mtype == "node_stats":
+                    if agent_node is not None:
+                        self.gcs.update_node_stats(agent_node,
+                                                   msg.get("stats") or {})
                 elif mtype == "object_evicted":
                     nid = agent_node or (driver_wid and
                                          self._driver_nodes.get(driver_wid))

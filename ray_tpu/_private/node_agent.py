@@ -62,6 +62,7 @@ class NodeAgent:
         self._children_lock = threading.Lock()
         self._shutdown = threading.Event()
         self.node_id = None  # assigned by head in register reply
+        self._stats_period = None  # head-resolved, set in register reply
 
     def send(self, msg: dict):
         with self._send_lock:
@@ -80,6 +81,8 @@ class NodeAgent:
         })
         threading.Thread(target=self._reap_loop, name="rtpu-agent-reap",
                          daemon=True).start()
+        threading.Thread(target=self._stats_loop, name="rtpu-agent-stats",
+                         daemon=True).start()
         try:
             while not self._shutdown.is_set():
                 msg = self.conn.recv()
@@ -94,6 +97,8 @@ class NodeAgent:
         try:
             if t == "node_registered":
                 self.node_id = NodeID(msg["node_id"])
+                if "node_stats_period_s" in msg:
+                    self._stats_period = float(msg["node_stats_period_s"])
             elif t == "spawn_worker":
                 self._spawn_worker(msg)
             elif t == "kill_worker":
@@ -153,6 +158,31 @@ class NodeAgent:
                                    "code": code})
                     except Exception:
                         return
+
+    def _stats_loop(self):
+        """Per-node usage snapshots → head (reference: the dashboard
+        reporter agent per node).  The period is re-read each tick: the
+        head ships its resolved value in the registration reply (the
+        agent's own CONFIG never sees head-side _system_config
+        overrides), which may land after this thread starts."""
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu._private.node_stats import collect_node_stats
+
+        while not self._shutdown.is_set():
+            period = (self._stats_period if self._stats_period is not None
+                      else CONFIG.node_stats_period_s)
+            if period <= 0:
+                time.sleep(1.0)  # disabled (possibly until the handshake)
+                continue
+            time.sleep(period)
+            with self._children_lock:
+                n_workers = len(self._children)
+            try:
+                self.send({"type": "node_stats",
+                           "stats": collect_node_stats(
+                               store=self.store, num_workers=n_workers)})
+            except Exception:
+                return
 
     def shutdown(self):
         self._shutdown.set()

@@ -96,7 +96,9 @@ class Dashboard:
         if path == "/metrics":
             from ray_tpu.util.metrics import prometheus_text
 
-            return req._send(200, prometheus_text(), "text/plain")
+            return req._send(200,
+                             prometheus_text() + self._node_metrics_text(),
+                             "text/plain")
         if path == "/api/cluster":
             total, avail = [], []
             self.head.req_cluster_resources({}, total.append, None)
@@ -198,6 +200,27 @@ class Dashboard:
         return req._send(404, {"error": f"no route: {path}"})
 
     # ---------------- views ----------------
+    def _node_metrics_text(self) -> str:
+        """Per-node usage gauges for the Prometheus scrape (reference:
+        the reporter agent's node_cpu/node_mem series)."""
+        import io
+
+        buf = io.StringIO()
+        names = {"cpu_percent": "node_cpu_percent",
+                 "mem_used_bytes": "node_mem_used_bytes",
+                 "mem_total_bytes": "node_mem_total_bytes",
+                 "num_workers": "node_num_workers",
+                 "store_used_bytes": "node_store_used_bytes",
+                 "store_capacity_bytes": "node_store_capacity_bytes",
+                 "store_num_objects": "node_store_num_objects"}
+        for node in self._state("nodes"):
+            nid = node["node_id"][:16]
+            for key, metric in names.items():
+                val = node.get("stats", {}).get(key)
+                if val is not None:
+                    buf.write(f'{metric}{{node="{nid}"}} {float(val)}\n')
+        return buf.getvalue()
+
     def _log_index(self):
         logs_dir = os.path.join(self.head.session_dir, "logs")
         if not os.path.isdir(logs_dir):

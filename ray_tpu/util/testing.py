@@ -69,6 +69,9 @@ def start_node_agent(head, num_cpus: int = 2,
     a distinct host key, store, and worker pool (the multi-host test
     substrate; reference: ray.cluster_utils.Cluster.add_node)."""
     import json
+    import os
+
+    from ray_tpu._private import inject_pkg_pythonpath
 
     args = [sys.executable, "-m", "ray_tpu._private.node_agent",
             "--address", f"127.0.0.1:{head.tcp_port}",
@@ -79,7 +82,11 @@ def start_node_agent(head, num_cpus: int = 2,
         args += ["--resources", json.dumps(resources)]
     if tpu_chips:
         args += ["--num-tpus", str(tpu_chips)]
-    return subprocess.Popen(args)
+    env = dict(os.environ)
+    # The spawning process may have ray_tpu importable only via sys.path
+    # (e.g. a driver script outside the repo) — make it explicit.
+    inject_pkg_pythonpath(env)
+    return subprocess.Popen(args, env=env)
 
 
 @contextlib.contextmanager
