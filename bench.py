@@ -191,43 +191,30 @@ def bench_ppo_breakout() -> dict:
     # Learn phase: the throughput measurement is GATED on reaching the
     # reward floor (random policy scores ~0.14) — an un-learning pipeline's
     # steps/s would be meaningless, so it is never measured.
-    reward = float("nan")
-    best = float("-inf")
-    metrics = algo.train()  # compile + warmup
-    floor_met = False
-    for i in range(150):
-        metrics = algo.train()
-        reward = metrics.get("episode_reward_mean", float("nan"))
-        if reward == reward:
-            best = max(best, reward)
-        if i >= 10 and reward >= BREAKOUT_REWARD_FLOOR:
-            floor_met = True
-            break
+    floor_met, reward, best = _learn_to_floor(algo, BREAKOUT_REWARD_FLOOR,
+                                              max_iters=150)
     out = {
         "metric": "ppo_breakout_pixels_env_steps_per_sec",
         "unit": "env_steps/s",
-        "episode_reward_mean": round(float(reward), 2),
+        "episode_reward_mean": round(reward, 2),
         "reward_floor": BREAKOUT_REWARD_FLOOR,
         "reward_floor_met": floor_met,
         "num_devices": num_devices,
     }
     if not floor_met:
         out.update({"value": 0, "vs_baseline": 0.0,
-                    "best_reward": round(float(best), 2)})
+                    "best_reward": round(best, 2)})
         return out
     # Measure phase (only reached with the floor passed): steady-state
     # throughput of the exact config that just learned.
-    iters = 8
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        metrics = algo.train()
-    dt = time.perf_counter() - t0
-    steps_per_s = iters * num_envs * unroll / dt
-    reward = metrics.get("episode_reward_mean", reward)
+    steps_per_s, last_reward = _measure_steps_per_s(algo,
+                                                    num_envs * unroll)
+    if last_reward == last_reward:
+        reward = last_reward
     out.update({
         "value": round(steps_per_s),
         "vs_baseline": round(steps_per_s / num_devices / 62500.0, 2),
-        "episode_reward_mean": round(float(reward), 2),
+        "episode_reward_mean": round(reward, 2),
         # Honesty note carried in the artifact: the env is MinAtar-scale
         # (10x10x4 board), not 84x84x4 ALE frames, while the baseline
         # denominator is the reference's real-Atari per-chip share — the
@@ -239,8 +226,71 @@ def bench_ppo_breakout() -> dict:
     return out
 
 
+def _learn_to_floor(algo, floor: float, max_iters: int):
+    """Train until the reward floor passes (NaN-safe, with a 10-iter
+    stability guard).  Returns (floor_met, gate_reward, best) — the
+    shared gate half of every RL bench: throughput is never measured on
+    an un-learning pipeline."""
+    algo.train()  # compile + warmup
+    reward, best = float("nan"), float("-inf")
+    for i in range(max_iters):
+        metrics = algo.train()
+        reward = metrics.get("episode_reward_mean", float("nan"))
+        if reward == reward:
+            best = max(best, reward)
+        if i >= 10 and reward >= floor:
+            return True, float(reward), float(best)
+    return False, float(reward), float(best)
+
+
+def _measure_steps_per_s(algo, steps_per_iter: int, iters: int = 8):
+    """Steady-state env-steps/s of the exact config that just learned;
+    returns (steps_per_s, last_reward)."""
+    t0 = time.perf_counter()
+    metrics = {}
+    for _ in range(iters):
+        metrics = algo.train()
+    dt = time.perf_counter() - t0
+    return (iters * steps_per_iter / dt,
+            float(metrics.get("episode_reward_mean", float("nan"))))
+
+
+def bench_impala_breakout() -> dict:
+    """Secondary RL headline (BASELINE.md lists Atari IMPALA alongside
+    PPO): anakin IMPALA — V-trace, one update per rollout — on the same
+    pixel env.  Its single-update regime plateaus lower than PPO's
+    multi-epoch clipped surrogate, so the gate is an honest 1.5 floor
+    (~11x the random policy's 0.14) rather than PPO's 3.0; throughput is
+    still only measured once the floor passes."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    floor = 1.5
+    num_envs, unroll = 16384, 64
+    algo = (IMPALAConfig().environment("Breakout-MinAtar-v0")
+            .anakin(num_envs=num_envs, unroll_length=unroll)
+            .training(lr=1e-3, entropy_coeff=0.01)
+            .debugging(seed=0).build())
+    floor_met, reward, best = _learn_to_floor(algo, floor, max_iters=300)
+    out = {"impala_reward_floor": floor,
+           "impala_reward_floor_met": floor_met}
+    if not floor_met:
+        out["impala_best_reward"] = round(best, 2)
+        return out
+    # Reward at the moment the gate passed; the post-measure reading can
+    # dip a hair under the floor by episode noise.
+    out["impala_gate_reward"] = round(reward, 2)
+    steps_per_s, last_reward = _measure_steps_per_s(algo,
+                                                    num_envs * unroll)
+    out.update({
+        "impala_env_steps_per_s": round(steps_per_s),
+        "impala_episode_reward_mean": round(last_reward, 2),
+    })
+    return out
+
+
 def main():
     out = bench_gpt2()
+    out.update(bench_impala_breakout())
     out.update(bench_ppo_breakout())
     print(json.dumps(out))
 

@@ -36,7 +36,8 @@ def appo_loss(params, module, batch, *, gamma, clip_rho, clip_c,
     PPO's clipped surrogate with the importance ratio taken against the
     behaviour policy and the advantage from V-trace."""
     T, N = batch["actions"].shape
-    obs = batch["obs"].reshape(T * N, -1)
+    # Preserve trailing obs dims (pixel envs feed the CNN trunk).
+    obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
     actions = batch["actions"].reshape(T * N)
     logp, value, entropy = module.forward_train(params, obs, actions)
     logp = logp.reshape(T, N)

@@ -40,7 +40,9 @@ def impala_loss(params, module, batch, *, gamma, clip_rho, clip_c,
                 vf_loss_coeff, entropy_coeff):
     """batch tensors are time-major [T, N, ...] (V-trace needs time)."""
     T, N = batch["actions"].shape
-    obs = batch["obs"].reshape(T * N, -1)
+    # Preserve trailing obs dims: pixel envs feed [T, N, H, W, C] to a
+    # CNN trunk, flat envs [T, N, D] to the MLP.
+    obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
     actions = batch["actions"].reshape(T * N)
     logp, value, entropy = module.forward_train(params, obs, actions)
     logp = logp.reshape(T, N)
@@ -78,8 +80,7 @@ class IMPALA(Algorithm):
         config = self.config
         env = make_jax_env(config.env) if isinstance(config.env, str) \
             else config.env
-        spec = RLModuleSpec(obs_dim=env.obs_dim, num_actions=env.num_actions,
-                            hiddens=tuple(config.hiddens))
+        spec = RLModuleSpec.for_env(env, tuple(config.hiddens))
         module = self.module = spec.build()
         tx = optax.chain(
             optax.clip_by_global_norm(config.grad_clip or 1e9),

@@ -113,12 +113,7 @@ def make_anakin_ppo(config: AlgorithmConfig):
     env = make_jax_env(config.env) if isinstance(config.env, str) \
         else config.env
     obs_shape = getattr(env, "obs_shape", None)
-    if obs_shape is not None:  # pixel env → CNN trunk
-        spec = RLModuleSpec(obs_shape=tuple(obs_shape),
-                            num_actions=env.num_actions, conv=True)
-    else:
-        spec = RLModuleSpec(obs_dim=env.obs_dim, num_actions=env.num_actions,
-                            hiddens=tuple(config.hiddens))
+    spec = RLModuleSpec.for_env(env, tuple(config.hiddens))
     module = spec.build()
     tx_parts = []
     if config.grad_clip:

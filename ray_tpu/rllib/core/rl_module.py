@@ -26,6 +26,18 @@ class RLModuleSpec:
     def build(self) -> "DiscreteActorCritic":
         return DiscreteActorCritic(self)
 
+    @classmethod
+    def for_env(cls, env, hiddens: Tuple[int, ...]) -> "RLModuleSpec":
+        """The one place pixel-vs-flat trunk selection lives: envs with
+        an obs_shape get the CNN trunk, flat envs the MLP (shared by the
+        PPO and V-trace families' anakin setups)."""
+        obs_shape = getattr(env, "obs_shape", None)
+        if obs_shape is not None:
+            return cls(obs_shape=tuple(obs_shape),
+                       num_actions=env.num_actions, conv=True)
+        return cls(obs_dim=env.obs_dim, num_actions=env.num_actions,
+                   hiddens=tuple(hiddens))
+
 
 class DiscreteActorCritic(nn.Module):
     """Categorical policy + value baseline (separate heads, shared trunk for

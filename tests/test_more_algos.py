@@ -73,6 +73,19 @@ def test_appo_grad_matches_impala_on_policy():
                                    rtol=1e-4, atol=1e-6)
 
 
+def test_impala_and_appo_on_pixel_env():
+    """The V-trace family drives the CNN trunk on pixel envs (the loss
+    must preserve trailing obs dims instead of flattening them)."""
+    from ray_tpu.rllib import APPOConfig, IMPALAConfig
+
+    for cfg_cls in (IMPALAConfig, APPOConfig):
+        algo = (cfg_cls().environment("Breakout-MinAtar-v0")
+                .anakin(num_envs=32, unroll_length=16)
+                .debugging(seed=0).build())
+        m = algo.train()
+        assert math.isfinite(m["total_loss"]), cfg_cls.__name__
+
+
 @pytest.mark.slow
 def test_td3_learns_pendulum():
     from ray_tpu.rllib import TD3Config
