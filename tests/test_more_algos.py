@@ -176,7 +176,10 @@ def _scripted_cartpole_data(tmp_path, frac_random: float, seed: int = 0):
         cols["rewards"].append(np.asarray(rew))
         cols["dones"].append(np.asarray(done, np.float32))
         obs = obs2
-    # Interleave env-major so per-env episodes stay contiguous in time.
+    # Interleave env-major so per-env episodes stay contiguous in time;
+    # mark each env's final (truncated) step terminal so the backward
+    # return scan can't bleed across env boundaries.
+    cols["dones"][-1] = np.ones(32, np.float32)
     stacked = {k: np.stack(v, 1).reshape(-1, *np.asarray(v[0]).shape[1:])
                for k, v in ((k, vs) for k, vs in cols.items())}
     path = str(tmp_path / "mix")
