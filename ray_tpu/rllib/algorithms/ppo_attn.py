@@ -20,7 +20,7 @@ trainable with RL gradients.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -72,26 +72,44 @@ class GTrXLBlock(nn.Module):
 class AttentionActorCritic(nn.Module):
     """Window of K observations → separate GTrXL trunks → heads (separate
     pi/vf trunks for the same reason the LSTM module uses them: early
-    value-error gradients wreck a shared representation)."""
+    value-error gradients wreck a shared representation).  Pixel envs
+    (obs_shape set) run each window slot through a CNN encoder before
+    the attention stack — the CNN+attention combination the reference
+    builds with visionnet + GTrXL."""
 
     num_actions: int
     window: int
     d_model: int = 64
     heads: int = 4
     layers: int = 1
+    obs_shape: Optional[Tuple[int, ...]] = None  # set for pixel windows
 
     @nn.compact
     def __call__(self, obs_win, valid):
-        """obs_win [B, K, obs_dim]; valid [B, K] bool (False = empty slot
-        after an episode boundary).  Returns (logits [B, A], value [B])."""
+        """obs_win [B, K, obs_dim] (flat) or [B, K, H, W, C] (pixels);
+        valid [B, K] bool (False = empty slot after an episode boundary).
+        Returns (logits [B, A], value [B])."""
         K = self.window
         causal = jnp.tril(jnp.ones((K, K), bool))
         # Rows may only attend to valid columns (and themselves via the
         # diagonal, which is always valid: slot K-1 holds the current obs).
         mask = causal[None, None] & valid[:, None, None, :]
 
+        def embed(tag):
+            if self.obs_shape is None:
+                return nn.Dense(self.d_model, name=f"embed_{tag}")(obs_win)
+            from ray_tpu.models.nature_cnn import MinAtarCNN, NatureCNN
+
+            B = obs_win.shape[0]
+            frames = obs_win.reshape((B * K,) + tuple(self.obs_shape))
+            small = min(self.obs_shape[0], self.obs_shape[1]) < 32
+            cnn = (MinAtarCNN(out_dim=self.d_model, name=f"cnn_{tag}")
+                   if small else
+                   NatureCNN(out_dim=self.d_model, name=f"cnn_{tag}"))
+            return cnn(frames).reshape(B, K, self.d_model)
+
         def trunk(tag):
-            x = nn.Dense(self.d_model, name=f"embed_{tag}")(obs_win)
+            x = embed(tag)
             x = x + self.param(f"pos_{tag}",
                                nn.initializers.normal(0.02),
                                (K, self.d_model))
@@ -111,6 +129,9 @@ def make_attn_eval_rollout(env, module, window: int,
     attention-policy analogue of bc.make_greedy_eval_rollout (used by
     Algorithm.evaluate / the `rllib evaluate` CLI)."""
 
+    obs_shape = getattr(env, "obs_shape", None)
+    obs_dims = tuple(obs_shape) if obs_shape is not None else (env.obs_dim,)
+
     def eval_rollout(params, key, num_steps: int):
         k_env, k_run = jax.random.split(key)
         env_states, obs = vector_reset(env, k_env, num_eval_envs)
@@ -120,7 +141,8 @@ def make_attn_eval_rollout(env, module, window: int,
              dcnt) = carry
             rng, k_s = jax.random.split(rng)
             keep = ~prev_done
-            hist = hist * keep[:, None, None]
+            hist = hist * keep.reshape(
+                (num_eval_envs,) + (1,) * (hist.ndim - 1))
             valid = valid & keep[:, None]
             hist = jnp.concatenate([hist[:, 1:], obs[:, None]], axis=1)
             valid = jnp.concatenate(
@@ -137,7 +159,7 @@ def make_attn_eval_rollout(env, module, window: int,
                     dsum, dcnt), None
 
         carry = (env_states, obs,
-                 jnp.zeros((num_eval_envs, window, env.obs_dim)),
+                 jnp.zeros((num_eval_envs, window) + obs_dims),
                  jnp.zeros((num_eval_envs, window), bool),
                  jnp.zeros(num_eval_envs, bool), k_run,
                  jnp.zeros(num_eval_envs), jnp.zeros(()), jnp.zeros(()))
@@ -169,20 +191,18 @@ def make_anakin_ppo_attn(config):
 
     env = make_jax_env(config.env) if isinstance(config.env, str) \
         else config.env
-    if getattr(env, "obs_shape", None) is not None:
-        raise ValueError(
-            "use_attention supports flat-observation envs only (a "
-            "CNN+attention trunk is not wired); got pixel env "
-            f"{config.env!r} with obs_shape={env.obs_shape}")
     if env.num_actions is None:
         raise ValueError(
             "use_attention supports discrete action spaces only; "
             f"continuous env {config.env!r} belongs to the SAC family")
+    obs_shape = getattr(env, "obs_shape", None)
+    obs_dims = tuple(obs_shape) if obs_shape is not None else (env.obs_dim,)
     K = config.attention_window
     module = AttentionActorCritic(
         num_actions=env.num_actions, window=K,
         d_model=config.attention_dim, heads=config.attention_num_heads,
-        layers=config.attention_num_layers)
+        layers=config.attention_num_layers,
+        obs_shape=tuple(obs_shape) if obs_shape is not None else None)
     tx_parts = []
     if config.grad_clip:
         tx_parts.append(optax.clip_by_global_norm(config.grad_clip))
@@ -196,9 +216,9 @@ def make_anakin_ppo_attn(config):
 
     def push(hist, valid, obs, prev_done):
         """Clear windows of just-reset envs, then append the current obs
-        into slot K-1."""
+        into slot K-1 (obs may be flat [N, D] or pixels [N, H, W, C])."""
         keep = ~prev_done
-        hist = hist * keep[:, None, None]
+        hist = hist * keep.reshape((N,) + (1,) * (hist.ndim - 1))
         valid = valid & keep[:, None]
         hist = jnp.concatenate([hist[:, 1:], obs[:, None]], axis=1)
         valid = jnp.concatenate(
@@ -209,7 +229,7 @@ def make_anakin_ppo_attn(config):
         rng = jax.random.PRNGKey(seed)
         rng, k_init, k_env = jax.random.split(rng, 3)
         env_states, obs = vector_reset(env, k_env, N)
-        hist = jnp.zeros((N, K, env.obs_dim))
+        hist = jnp.zeros((N, K) + obs_dims)
         valid = jnp.zeros((N, K), bool)
         params = module.init(k_init, hist, valid)
         return AttnAnakinState(params, tx.init(params), env_states, obs,
@@ -266,7 +286,7 @@ def make_anakin_ppo_attn(config):
         # Feedforward training: every step's forward depends only on its
         # own window — flatten [T, N] and minibatch arbitrarily.
         flat = {
-            "hist": hist_t.reshape(batch_total, K, -1),
+            "hist": hist_t.reshape((batch_total, K) + obs_dims),
             "valid": valid_t.reshape(batch_total, K),
             "actions": act_t.reshape(batch_total),
             "action_logp": logp_t.reshape(batch_total),

@@ -34,22 +34,38 @@ class RecurrentActorCritic(nn.Module):
     (core/rl_module.py DiscreteActorCritic): a shared trunk lets the large
     early value-error gradients wreck the policy representation.  Exposed
     as a single per-step function; sequences scan it from outside so the
-    same params serve rollout and training."""
+    same params serve rollout and training.  Pixel envs (obs_shape set)
+    embed each frame through a CNN first (reference: visionnet + LSTM
+    wrapper, models/torch/recurrent_net.py)."""
 
     num_actions: int
     hiddens: Tuple[int, ...] = (64,)
     lstm_size: int = 128
+    obs_shape: Optional[Tuple[int, ...]] = None  # set for pixel obs
 
     @nn.compact
     def __call__(self, carry, obs, reset):
         """One step: zero both carries where `reset`, then advance.
-        carry: ((c,h) policy, (c,h) value), each [N, lstm]; reset [N]."""
+        carry: ((c,h) policy, (c,h) value), each [N, lstm]; reset [N];
+        obs [N, D] flat or [N, H, W, C] pixels."""
         mask = (1.0 - reset.astype(jnp.float32))[:, None]
+
+        def embed(name):
+            if self.obs_shape is None:
+                return MLP(self.hiddens, self.lstm_size,
+                           name=f"embed_{name}")(obs)
+            from ray_tpu.models.nature_cnn import MinAtarCNN, NatureCNN
+
+            small = min(self.obs_shape[0], self.obs_shape[1]) < 32
+            cnn = (MinAtarCNN(out_dim=self.lstm_size, name=f"cnn_{name}")
+                   if small else
+                   NatureCNN(out_dim=self.lstm_size, name=f"cnn_{name}"))
+            return cnn(obs)
 
         def trunk(sub_carry, name):
             c, h = sub_carry
             c, h = c * mask, h * mask
-            x = MLP(self.hiddens, self.lstm_size, name=f"embed_{name}")(obs)
+            x = embed(name)
             return nn.OptimizedLSTMCell(self.lstm_size,
                                         name=f"lstm_{name}")((c, h), x)
 

@@ -60,12 +60,19 @@ def test_gru_gate_starts_near_identity():
     assert drift < 0.5, f"gate not identity-biased at init: drift={drift}"
 
 
-def test_pixel_env_rejected():
-    cfg = (PPOConfig().environment("Breakout-MinAtar-v0")
-           .anakin(num_envs=8, unroll_length=8)
-           .training(model={"use_attention": True}))
-    with pytest.raises(ValueError, match="flat-observation"):
-        cfg.build()
+def test_pixel_env_attention_trains_and_evaluates():
+    """CNN+attention: each window slot runs through the MinAtar CNN
+    before the GTrXL stack (reference: visionnet + GTrXL)."""
+    import math
+
+    algo = (PPOConfig().environment("Breakout-MinAtar-v0")
+            .anakin(num_envs=8, unroll_length=8)
+            .training(model={"use_attention": True, "attention_window": 4})
+            .build())
+    m = algo.train()
+    assert math.isfinite(m["total_loss"])
+    out = algo.evaluate(num_steps=60)
+    assert math.isfinite(out["episode_reward_mean"])
 
 
 def test_lstm_and_attention_exclusive():
