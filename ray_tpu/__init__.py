@@ -89,6 +89,18 @@ def _connect_driver(job_config: Optional[dict] = None):
         node_id = next(iter(_head.raylets))
         transport = DirectTransport(_head, worker_id)
         worker = CoreWorker(worker_id, node_id, job_id, transport, mode="driver")
+        from ray_tpu._private.config import CONFIG
+
+        if CONFIG.direct_transport:
+            # The driver owns its tasks' results: start its direct listener
+            # (serving fetch/pin for borrowed refs) + lease-caching submitter.
+            from ray_tpu._private.direct import DirectServer
+
+            server = DirectServer(worker._owned, _head.authkey,
+                                  _head.host_key,
+                                  session_dir=_head.session_dir,
+                                  on_exec=None, tcp_bind=CONFIG.tcp_host)
+            worker.enable_direct(server, _head.host_key)
         _apply_job_config(worker, job_config)
         set_global_worker(worker)
         _head.gcs.add_job(job_id, job_config or {})
@@ -202,6 +214,11 @@ def shutdown():
         if global_worker is not None:
             if getattr(global_worker, "mode", None) == "local":
                 global_worker.shutdown()
+            else:
+                try:
+                    global_worker.shutdown()
+                except Exception:
+                    pass
             try:
                 global_worker._closed = True
             except Exception:
@@ -259,7 +276,11 @@ def kill(actor: ActorHandle, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, force: bool = False):
-    _worker().transport.request("cancel", {"task_id": ref.id.task_id()})
+    w = _worker()
+    if hasattr(w, "cancel_task"):
+        w.cancel_task(ref.id.task_id())
+    else:
+        w.transport.request("cancel", {"task_id": ref.id.task_id()})
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:

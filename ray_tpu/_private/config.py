@@ -158,4 +158,10 @@ CONFIG \
              "/proc (mirrors the reference's fake-memory test mode).") \
     .declare("node_stats_period_s", float, 2.0,
              "Per-node cpu/mem/store usage snapshot period "
-             "(0 disables; reference: the dashboard reporter agent).")
+             "(0 disables; reference: the dashboard reporter agent).") \
+    .declare("direct_transport", bool, True,
+             "Push tasks/actor calls directly to workers over cached "
+             "leases, bypassing the head on the hot path (reference: "
+             "direct_task_transport.h lease caching).") \
+    .declare("lease_idle_s", float, 0.5,
+             "Return an idle worker lease to the head after this long.")

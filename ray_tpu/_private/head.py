@@ -47,13 +47,12 @@ from ray_tpu._private.scheduler import (
     PlacementGroupInfo,
 )
 from ray_tpu._private.task_spec import (
+    ERROR_META,
     TaskResult,
     TaskSpec,
     TaskStatus,
     TaskType,
 )
-
-ERROR_META = b"__rtpu_error__"
 
 
 class Head:
@@ -393,7 +392,8 @@ class Head:
                 mtype = msg.get("type")
                 if mtype == "register":
                     worker_id = WorkerID(msg["worker_id"])
-                    self._on_register(worker_id, NodeID(msg["node_id"]), conn)
+                    self._on_register(worker_id, NodeID(msg["node_id"]), conn,
+                                      msg.get("direct_addr"))
                 elif mtype == "register_node":
                     agent_node = self.add_remote_node(msg, conn)
                 elif mtype == "register_driver":
@@ -426,6 +426,10 @@ class Head:
                                     msg["meta"], msg["size"])
                 elif mtype == "task_done":
                     self.on_task_done(msg)
+                elif mtype == "worker_blocked":
+                    self.on_worker_blocked(WorkerID(msg["worker_id"]))
+                elif mtype == "worker_unblocked":
+                    self.on_worker_unblocked(WorkerID(msg["worker_id"]))
                 elif mtype == "seal":
                     self.on_seal(msg)
                 elif mtype == "put_inline":
@@ -485,12 +489,13 @@ class Head:
             for oid in freed:
                 self._free_object(oid)
 
-    def _on_register(self, worker_id: WorkerID, node_id: NodeID, conn):
+    def _on_register(self, worker_id: WorkerID, node_id: NodeID, conn,
+                     direct_addr=None):
         with self._lock:
             self._conns[worker_id] = conn
             raylet = self.raylets.get(node_id)
             if raylet is not None:
-                raylet.on_worker_registered(worker_id, conn)
+                raylet.on_worker_registered(worker_id, conn, direct_addr)
                 raylet.try_dispatch()
 
     def on_conn_closed(self, worker_id: WorkerID):
@@ -503,10 +508,18 @@ class Head:
                     raylet.on_worker_lost(worker_id)
                     raylet.try_dispatch()
                     break
+            # Reclaim leases this process held on OTHER workers (reference:
+            # lease reclaim on lessee death, lease_policy / raylet).
+            lessee = worker_id.binary()
+            for raylet in self.raylets.values():
+                for h in list(raylet.workers.values()):
+                    if h.leased_to == lessee:
+                        self._release_lease_locked(raylet, h)
             self._drop_arena_leases_for(worker_id.binary())
             freed = self.gcs.remove_all_references(worker_id.binary())
             for oid in freed:
                 self._free_object(oid)
+            self._drain_pending()
 
     def send_to_worker(self, worker: WorkerHandle, msg: dict):
         if not self._send_on(worker.conn, msg):
@@ -810,6 +823,145 @@ class Head:
         self.cancel_task(payload["task_id"])
         reply(True)
 
+    # ----- direct transport: leases + actor addresses -----
+    def req_lease_worker(self, payload, reply, caller):
+        """Grant the caller a worker lease for a scheduling class: pick a
+        node + idle worker, hold the resources for the lease's lifetime, and
+        hand back the worker's direct address.  None = nothing available
+        right now (caller falls back to the classic path and retries).
+        Reference: raylet lease grant, node_manager.cc:1817 + lease caching
+        in direct_task_transport.h:57."""
+        from ray_tpu._private.ids import JobID as _JobID
+
+        spec = TaskSpec(task_id=TaskID.from_random(), job_id=_JobID.nil(),
+                        task_type=TaskType.NORMAL, name="__lease__",
+                        resources=dict(payload["resources"]))
+        with self._lock:
+            try:
+                node_id = self.scheduler.pick_node(spec)
+            except Infeasible as e:
+                reply(error=exc.RayTpuError(str(e)))
+                return
+            if node_id is None:
+                reply(None)
+                return
+            raylet = self.raylets[node_id]
+            h = raylet._pop_idle(spec)
+            if h is None or h.direct_addr is None:
+                if h is not None:  # claimed but not direct-capable
+                    raylet.idle.append(h.worker_id)
+                raylet.ensure_worker(spec)
+                self.scheduler.return_resources(node_id, spec)
+                reply(None)
+                return
+            h.busy = True
+            h.leased_to = caller.binary() if caller else b"driver"
+            h.lease_spec = spec
+            reply({"worker_id": h.worker_id.binary(),
+                   "addr": h.direct_addr})
+
+    def _release_lease_locked(self, raylet, h):
+        if h.leased_to is None:
+            return
+        if h.blocked:
+            h.blocked = False  # resources already released at block time
+        else:
+            self.scheduler.return_resources(h.node_id, h.lease_spec)
+        h.leased_to = None
+        h.lease_spec = None
+        raylet.release_worker(h)
+
+    # ----- blocked-worker resource release (reference: the raylet's
+    # NotifyDirectCallTaskBlocked/Unblocked handling — a worker blocked in
+    # get() yields its cpu so dependency producers can schedule; unblock
+    # re-acquires, possibly oversubscribing until something finishes;
+    # local_task_manager.cc ReleaseCpuResourcesFromBlockedWorker) -----
+    def on_worker_blocked(self, worker_id: WorkerID):
+        with self._lock:
+            raylet, h = self._find_worker(worker_id)
+            if h is None or h.blocked or h.actor_id is not None:
+                return
+            spec = h.current_task if h.current_task is not None \
+                else h.lease_spec
+            if spec is None:
+                return
+            h.blocked = True
+            self.scheduler.return_resources(h.node_id, spec)
+            self._drain_pending()
+            raylet.try_dispatch()
+
+    def on_worker_unblocked(self, worker_id: WorkerID):
+        with self._lock:
+            _, h = self._find_worker(worker_id)
+            if h is None or not h.blocked:
+                return
+            h.blocked = False
+            spec = h.current_task if h.current_task is not None \
+                else h.lease_spec
+            if spec is not None:
+                self.scheduler.reacquire(h.node_id, spec)
+
+    def req_return_lease(self, payload, reply, caller):
+        wid = WorkerID(payload["worker_id"])
+        with self._lock:
+            raylet, h = self._find_worker(wid)
+            if h is not None:
+                self._release_lease_locked(raylet, h)
+            self._drain_pending()
+            self._drive_pending_pgs()
+        reply(True)
+
+    def req_actor_direct_addr(self, payload, reply, caller):
+        """Resolve an actor to its worker's direct address, deferring while
+        the actor is pending/restarting (reference: the actor table
+        subscription that feeds direct_actor_task_submitter.h)."""
+        actor_id: ActorID = payload["actor_id"]
+
+        def send_addr(_val=None, error=None):
+            if error is not None:
+                reply(None, error=error)
+                return
+            with self._lock:
+                info = self.gcs.get_actor_info(actor_id)
+                if info is None or info.worker_id is None:
+                    reply(error=exc.ActorDiedError("actor is gone"))
+                    return
+                _, h = self._find_worker(info.worker_id)
+                if h is None or h.direct_addr is None:
+                    reply(None)  # not direct-capable: classic path
+                    return
+                reply({"worker_id": info.worker_id.binary(),
+                       "addr": h.direct_addr})
+
+        with self._lock:
+            info = self.gcs.get_actor_info(actor_id)
+            if info is None:
+                reply(error=ValueError(f"unknown actor {actor_id}"))
+                return
+            if info.state == ActorState.DEAD:
+                reply(error=exc.ActorDiedError(
+                    info.death_cause or "actor dead"))
+                return
+            if info.state == ActorState.ALIVE:
+                pass  # fall through to send_addr below
+            else:
+                self._actor_waiters[actor_id].append(send_addr)
+                return
+        send_addr()
+
+    def req_kill_worker(self, payload, reply, caller):
+        """Coarse cancel of a direct task: kill its leased worker (classic
+        cancel semantics — force=True kills the executing process)."""
+        wid = WorkerID(payload["worker_id"])
+        with self._lock:
+            _, h = self._find_worker(wid)
+            if h is not None:
+                try:
+                    h.proc.kill()
+                except Exception:
+                    pass
+        reply(True)
+
     # ================= task manager =================
     def submit_task(self, spec: TaskSpec):
         from ray_tpu._private.chaos import maybe_delay
@@ -895,7 +1047,10 @@ class Head:
                 spec_worker[0] if spec_worker else None)
             if handle is not None and spec is not None \
                     and spec.task_type == TaskType.NORMAL:
-                self.scheduler.return_resources(handle.node_id, spec)
+                if handle.blocked:
+                    handle.blocked = False  # released at block time
+                else:
+                    self.scheduler.return_resources(handle.node_id, spec)
             error = msg.get("error")  # (meta, data) serialized exception or None
             results: List[TaskResult] = msg.get("results") or []
             if spec is not None:
@@ -1064,6 +1219,17 @@ class Head:
         return None, None
 
     def _handle_worker_death(self, handle: WorkerHandle, cause: str):
+        if handle.leased_to is not None:
+            # Leased worker died: return the lease's held resources.  The
+            # lessee sees the channel break and handles its own in-flight
+            # retries (owner-side task manager, see direct.py).
+            if handle.blocked:
+                handle.blocked = False  # released at block time
+            else:
+                self.scheduler.return_resources(handle.node_id,
+                                                handle.lease_spec)
+            handle.leased_to = None
+            handle.lease_spec = None
         spec = handle.current_task
         if spec is not None and spec.task_type == TaskType.ACTOR_CREATION:
             # Died mid-creation: release and let the actor FSM below decide
@@ -1071,7 +1237,10 @@ class Head:
             self.scheduler.return_resources(handle.node_id, spec)
             self.running.pop(spec.task_id, None)
         elif spec is not None and spec.task_type == TaskType.NORMAL:
-            self.scheduler.return_resources(handle.node_id, spec)
+            if handle.blocked:
+                handle.blocked = False
+            else:
+                self.scheduler.return_resources(handle.node_id, spec)
             self.running.pop(spec.task_id, None)
             cancelled = spec.task_id in self._cancelled
             oom = spec.task_id in self._oom_killed

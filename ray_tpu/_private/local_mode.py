@@ -228,12 +228,12 @@ class LocalModeWorker:
         return v
 
     # ---- misc surface ----
-    def add_local_ref(self, oid: ObjectID):
+    def add_local_ref(self, oid: ObjectID, owner_addr=None):
         """ObjectRef lifetime hooks: local mode keeps values until
         shutdown (debugging runs are short; matches the reference's
         local-mode no-GC behavior)."""
 
-    def remove_local_ref(self, oid: ObjectID):
+    def remove_local_ref(self, oid: ObjectID, owner_addr=None):
         pass
 
     def shutdown(self):

@@ -28,7 +28,8 @@ IDLE_WORKER_TTL_S = 300.0
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "busy", "actor_id", "node_id",
                  "current_task", "idle_since", "tpu_visible", "tpu_chips",
-                 "task_started_at")
+                 "task_started_at", "direct_addr", "leased_to", "lease_spec",
+                 "blocked")
 
     def __init__(self, worker_id: WorkerID, proc, node_id: NodeID):
         self.worker_id = worker_id
@@ -42,6 +43,10 @@ class WorkerHandle:
         self.tpu_visible = False
         self.tpu_chips: tuple = ()  # chip indices this worker may touch
         self.task_started_at = 0.0  # dispatch time of current_task
+        self.direct_addr = None  # the worker's own direct listener address
+        self.leased_to = None    # caller worker id holding a lease on us
+        self.lease_spec = None   # synthetic spec whose resources the lease holds
+        self.blocked = False     # blocked in get(): resources released
 
 
 class Raylet:
@@ -159,6 +164,11 @@ class Raylet:
             "RAY_TPU_AUTHKEY": self.head.authkey.hex(),
             "RAY_TPU_NODE_ID": self.node_id.hex(),
             "RAY_TPU_WORKER_ID": worker_id.hex(),
+            # Host identity for the direct transport's endpoint selection
+            # (same host => unix socket; cross-host => TCP).  RemoteRaylet
+            # overrides with its agent's host key.
+            "RAY_TPU_HOST_KEY": getattr(self, "host_key", None)
+                                 or self.head.host_key,
         }
         if tpu_visible and tpu_chips and len(tpu_chips) < self.tpu_chips_total:
             # Strict-subset chip share: partition via TPU_VISIBLE_CHIPS so
@@ -213,11 +223,13 @@ class Raylet:
         self.num_starting += 1
         return worker_id
 
-    def on_worker_registered(self, worker_id: WorkerID, conn) -> Optional[WorkerHandle]:
+    def on_worker_registered(self, worker_id: WorkerID, conn,
+                             direct_addr=None) -> Optional[WorkerHandle]:
         h = self.workers.get(worker_id)
         if h is None:
             return None
         h.conn = conn
+        h.direct_addr = direct_addr
         self.num_starting = max(0, self.num_starting - 1)
         self.consecutive_start_failures = 0
         self.idle.append(worker_id)

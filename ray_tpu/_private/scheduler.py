@@ -202,6 +202,26 @@ class ClusterScheduler:
                 return pg.bundles[i].node_id
         return None
 
+    def reacquire(self, node_id: NodeID, spec: TaskSpec):
+        """Re-take a blocked worker's resources on unblock (reference:
+        TaskUnblocked re-acquisition — may oversubscribe; availability can
+        go negative until something completes)."""
+        with self._lock:
+            st = spec.scheduling_strategy
+            if st.kind == "PLACEMENT_GROUP":
+                pg = self.placement_groups.get(st.placement_group_id)
+                if pg is not None and pg.state == "CREATED":
+                    for b in pg.bundles:
+                        if b.node_id == node_id:
+                            avail = pg.bundle_available[b.index]
+                            for k, v in spec.resources.items():
+                                avail[k] = avail.get(k, 0.0) - v
+                            return
+                return
+            n = self.nodes.get(node_id)
+            if n is not None:
+                n.allocate(spec.resources)
+
     def return_resources(self, node_id: NodeID, spec: TaskSpec):
         with self._lock:
             st = spec.scheduling_strategy

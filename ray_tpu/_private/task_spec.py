@@ -22,6 +22,11 @@ from ray_tpu._private.ids import (
 )
 
 
+# Metadata prefix marking an inline object as a serialized error result
+# (reference: RAY_ERROR metadata in plasma objects).
+ERROR_META = b"__rtpu_error__"
+
+
 class TaskType(enum.Enum):
     NORMAL = 0
     ACTOR_CREATION = 1
@@ -43,6 +48,22 @@ class TaskArg:
     # the task's lifetime like direct ref args (borrow protocol,
     # reference: contained_ids in src/ray/core_worker/reference_count.h).
     contained: List[ObjectID] = field(default_factory=list)
+    # Owner address for REF args held in a caller's in-process store
+    # (reference: owner_address in TaskArg, common.proto) — the executing
+    # worker fetches the bytes from the owner, not the head.
+    owner: Optional[dict] = None
+    # oid-binary -> owner address for `contained` refs (same role).
+    contained_owners: Optional[Dict[bytes, dict]] = None
+
+    def __reduce__(self):
+        return (_rebuild_arg, (self.kind.value, self.value, self.ref,
+                               self.contained or None, self.owner,
+                               self.contained_owners))
+
+
+def _rebuild_arg(kind, value, ref, contained, owner, contained_owners):
+    return TaskArg(ArgKind(kind), value, ref, contained or [], owner,
+                   contained_owners)
 
 
 @dataclass
@@ -56,6 +77,21 @@ class SchedulingStrategy:
     placement_group_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
     capture_child_tasks: bool = False
+
+    def __reduce__(self):
+        # Compact wire form: specs cross a process boundary per task on the
+        # hot path; the default dataclass pickle (class + field dict) costs
+        # several x this tuple form.
+        if self.kind == "DEFAULT" and self.node_id is None:
+            return (_default_strategy, ())
+        return (SchedulingStrategy,
+                (self.kind, self.node_id, self.soft,
+                 self.placement_group_id, self.bundle_index,
+                 self.capture_child_tasks))
+
+
+def _default_strategy() -> SchedulingStrategy:
+    return SchedulingStrategy()
 
 
 @dataclass
@@ -96,7 +132,12 @@ class TaskSpec:
     attempt: int = 0
 
     def return_ids(self) -> List[ObjectID]:
-        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+        ids = getattr(self, "_return_ids", None)
+        if ids is None:
+            ids = [ObjectID.for_task_return(self.task_id, i)
+                   for i in range(self.num_returns)]
+            self._return_ids = ids
+        return ids
 
     def scheduling_class(self) -> Tuple:
         """Key for lease reuse: same-shaped tasks share leased workers
@@ -109,6 +150,33 @@ class TaskSpec:
             self._sched_class = key
         return key
 
+    def __reduce__(self):
+        return (_rebuild_spec, (
+            self.task_id, self.job_id, self.task_type.value, self.name,
+            self.func_blob, self.func_hash, self.method_name,
+            self.args or None, self.kwargs or None, self.num_returns,
+            self.resources or None, self.scheduling_strategy,
+            self.max_retries, self.retry_exceptions, self.actor_id,
+            self.max_restarts, self.max_task_retries, self.max_concurrency,
+            self.actor_name, self.actor_method_names or None,
+            self.namespace, self.lifetime, self.runtime_env,
+            self.owner_worker_id, self.parent_task_id, self.attempt))
+
+
+def _rebuild_spec(task_id, job_id, task_type, name, func_blob, func_hash,
+                  method_name, args, kwargs, num_returns, resources,
+                  scheduling_strategy, max_retries, retry_exceptions,
+                  actor_id, max_restarts, max_task_retries, max_concurrency,
+                  actor_name, actor_method_names, namespace, lifetime,
+                  runtime_env, owner_worker_id, parent_task_id, attempt):
+    return TaskSpec(task_id, job_id, TaskType(task_type), name, func_blob,
+                    func_hash, method_name, args or [], kwargs or {},
+                    num_returns, resources or {}, scheduling_strategy,
+                    max_retries, retry_exceptions, actor_id, max_restarts,
+                    max_task_retries, max_concurrency, actor_name,
+                    actor_method_names or [], namespace, lifetime,
+                    runtime_env, owner_worker_id, parent_task_id, attempt)
+
 
 @dataclass
 class TaskResult:
@@ -117,6 +185,10 @@ class TaskResult:
     in_store: bool = False
     size: int = 0
     meta: bytes = b""
+
+    def __reduce__(self):
+        return (TaskResult, (self.object_id, self.inline, self.in_store,
+                             self.size, self.meta))
 
 
 class TaskStatus(enum.Enum):

@@ -314,11 +314,24 @@ def _arena_lease_releaser(transport, oid_bin: bytes, holder_bin: bytes):
 # ---------------------------------------------------------------------------
 # CoreWorker
 # ---------------------------------------------------------------------------
+class _DepsUnready(BaseException):
+    """Raised during DIRECT-task arg resolution when a dependency is still
+    pending at its owner: the worker bounces the task back to the submitter
+    (who re-routes it through the head) rather than blocking the lease
+    queue — the pending producer may be queued right behind this task.
+    BaseException so user-level `except Exception` can't swallow it."""
+
+    def __init__(self, oid):
+        self.oid = oid
+
+
 class TaskContext(threading.local):
     def __init__(self):
         self.task_id: Optional[TaskID] = None
         self.put_counter = 0
         self.task_name = ""
+        self.direct_exec = False   # executing a direct-pushed task
+        self.arg_resolve = False   # inside execute_task arg resolution
 
 
 class CoreWorker:
@@ -329,6 +342,18 @@ class CoreWorker:
         self.job_id = job_id
         self.transport = transport
         self.mode = mode  # "driver" | "worker" | "local"
+        # Ownership plane (reference: in-process memory store +
+        # reference_count.h).  _owned always exists; the direct submitter +
+        # server are attached by enable_direct() when the process supports
+        # the direct transport (see _private/direct.py).
+        from ray_tpu._private.direct import OwnedStore
+
+        self._owned = OwnedStore()
+        self._direct = None
+        self._direct_server = None
+        self.direct_addr: Optional[dict] = None
+        self.host_key: str = ""
+        self._borrowed: Dict[ObjectID, list] = {}  # oid -> [owner_addr, count]
         # Job-level defaults (reference: JobConfig — ray_namespace +
         # runtime_env applied to every task/actor the driver submits
         # unless per-call options override them).  Drivers get these set
@@ -339,6 +364,12 @@ class CoreWorker:
         self._job_config_cache: Dict[JobID, dict] = {}
         self.ctx = TaskContext()
         self.driver_task_id = TaskID.for_driver(job_id)
+        # Blocked-in-get depth (process-wide): while a worker blocks
+        # waiting for an object it tells the head, which releases the
+        # worker's cpu so dependency producers can schedule (reference:
+        # NotifyDirectCallTaskBlocked, core_worker.cc).
+        self._block_depth = 0
+        self._block_lock = threading.Lock()
         self._local_refs: Dict[ObjectID, int] = {}
         self._refs_lock = threading.Lock()
         # In-process caches (memory store): resolved values + attached
@@ -350,12 +381,42 @@ class CoreWorker:
         self._value_cache_cap = 256
         self._shm_registry: Dict[ObjectID, Any] = {}
         self._func_cache: Dict[bytes, Callable] = {}
+        self._func_blobs: Dict[bytes, bytes] = {}
         self.actors: Dict[ActorID, Any] = {}
         self._closed = False
 
     # ---- reference counting ----
-    def add_local_ref(self, oid: ObjectID):
+    def enable_direct(self, server, host_key: str):
+        """Attach the direct transport: this process's listener (serving
+        fetch/pin + optionally exec) and the caller-side submitter."""
+        from ray_tpu._private.direct import DirectSubmitter
+
+        self._direct_server = server
+        self.direct_addr = server.address
+        self.host_key = host_key
+        self._direct = DirectSubmitter(self)
+
+    def add_local_ref(self, oid: ObjectID, owner_addr: Optional[dict] = None):
         if self._closed:
+            return
+        # Owner path: this process holds the entry — count locally, never
+        # talk to the head (EXTERN entries already mirror one holder there).
+        if self._owned.add_ref(oid) is not None:
+            return
+        # Borrower path: register the borrow with the owner (reference:
+        # borrow registration, reference_count.h:520) instead of the head.
+        if owner_addr is not None and self._direct is not None:
+            with self._refs_lock:
+                rec = self._borrowed.get(oid)
+                if rec is None:
+                    self._borrowed[oid] = [owner_addr, 1]
+                    first = True
+                else:
+                    rec[1] += 1
+                    first = False
+            if first:
+                self._direct.pin_at_owner(
+                    oid, owner_addr, b"bor:" + self.worker_id.binary())
             return
         with self._refs_lock:
             n = self._local_refs.get(oid, 0)
@@ -368,8 +429,42 @@ class CoreWorker:
             except Exception:
                 pass
 
-    def remove_local_ref(self, oid: ObjectID):
+    def remove_local_ref(self, oid: ObjectID, owner_addr: Optional[dict] = None):
         if self._closed:
+            return
+        from ray_tpu._private.direct import EXTERN
+
+        r = self._owned.remove_ref(oid)
+        if r is not None:
+            n, state = r
+            if n <= 0:
+                self._value_cache.pop(oid, None)
+                self._shm_registry.pop(oid, None)
+                if state == EXTERN:
+                    # Drop the mirrored holder in the head directory.
+                    try:
+                        self.transport.request_oneway(
+                            "remove_ref",
+                            {"oid": oid, "holder": self.worker_id.binary()})
+                    except Exception:
+                        pass
+            return
+        with self._refs_lock:
+            rec = self._borrowed.get(oid)
+            if rec is not None:
+                rec[1] -= 1
+                last_borrow = rec[1] <= 0
+                if last_borrow:
+                    self._borrowed.pop(oid, None)
+            else:
+                last_borrow = None
+        if rec is not None:
+            if last_borrow:
+                self._value_cache.pop(oid, None)
+                self._shm_registry.pop(oid, None)
+                if self._direct is not None:
+                    self._direct.unpin_at_owner(
+                        oid, rec[0], b"bor:" + self.worker_id.binary())
             return
         with self._refs_lock:
             n = self._local_refs.get(oid, 0) - 1
@@ -403,6 +498,13 @@ class CoreWorker:
         size = ser.packed_size(s)
         if size <= INLINE_OBJECT_THRESHOLD:
             meta, data = ser.pack(s)
+            if self._direct is not None:
+                # Owner-resident put: zero head traffic (reference: puts
+                # land in the owner's in-process store, memory_store.h:43;
+                # other processes fetch from the owner).
+                self._owned.put(oid, meta, data)
+                self._cache_value(oid, value)
+                return
             self.transport.notify({"type": "put_inline", "oid": oid.binary(),
                                    "meta": meta, "data": data,
                                    "lineage_task": lineage_task})
@@ -462,24 +564,50 @@ class CoreWorker:
             # the stragglers take the blocking per-object path.
             # Dedup: a repeated ref must not be granted two arena leases
             # when only one materialize (and lease release) will happen.
+            # Owner-resident (non-EXTERN) refs never go to the head.
+            from ray_tpu._private.direct import EXTERN
+
+            def _head_resident(oid: ObjectID) -> bool:
+                e = self._owned.lookup(oid)
+                return e is None or e.state == EXTERN
+
             missing = list(dict.fromkeys(
-                r.id for r in ref_list if r.id not in self._value_cache))
+                r.id for r in ref_list if r.id not in self._value_cache
+                and _head_resident(r.id)))
             if missing:
                 batch = self.transport.request("resolve_batch",
                                                {"oids": missing})
                 resolved = dict(batch or {})
         out = []
+        value_cache = self._value_cache
+        owned_lookup = self._owned.lookup
+        from ray_tpu._private.direct import READY
+
         try:
             for r in ref_list:
-                msg = resolved.pop(r.id.binary(), None)
-                if msg is not None and r.id not in self._value_cache:
-                    out.append(self._materialize(r.id, msg))
-                else:
-                    if msg is not None and msg.get("kind") == "arena":
-                        # Batch granted a lease but the cache won: give the
-                        # lease back instead of dropping it on the floor.
-                        self._release_arena_lease(r.id)
-                    out.append(self._get_one(r.id, timeout))
+                oid = r.id
+                msg = resolved.pop(oid.binary(), None)
+                if msg is not None and oid not in value_cache:
+                    out.append(self._materialize(oid, msg))
+                    continue
+                if msg is not None and msg.get("kind") == "arena":
+                    # Batch granted a lease but the cache won: give the
+                    # lease back instead of dropping it on the floor.
+                    self._release_arena_lease(oid)
+                # Fast path: cached value or owner-resident READY bytes
+                # (the common case for direct-task results).
+                v = value_cache.get(oid, value_cache)
+                if v is not value_cache:
+                    out.append(v)
+                    continue
+                e = owned_lookup(oid)
+                if e is not None and e.state == READY:
+                    value, _ = ser.unpack(e.meta, memoryview(e.data))
+                    self._cache_value(oid, value)
+                    out.append(value)
+                    continue
+                out.append(self._get_one(oid, timeout,
+                                         getattr(r, "owner_addr", None)))
         finally:
             # If an earlier ref's materialization raised, release the
             # leases of every unconsumed arena resolution — otherwise the
@@ -499,12 +627,102 @@ class CoreWorker:
             old, _ = self._value_cache.popitem(last=False)
             self._shm_registry.pop(old, None)
 
-    def _get_one(self, oid: ObjectID, timeout: Optional[float]):
+    @contextlib.contextmanager
+    def _blocked_in_get(self):
+        """Tell the head this worker is blocked waiting for an object so
+        its cpu can serve dependency producers meanwhile (reference:
+        NotifyDirectCallTaskBlocked/Unblocked; raylet releases and later
+        re-acquires the cpu, local_task_manager.cc).  No-op off-worker."""
+        if self.mode != "worker":
+            yield
+            return
+        with self._block_lock:
+            self._block_depth += 1
+            notify = self._block_depth == 1
+        if notify:
+            try:
+                self.transport.notify({"type": "worker_blocked",
+                                       "worker_id": self.worker_id.binary()})
+            except Exception:
+                pass
+        try:
+            yield
+        finally:
+            with self._block_lock:
+                self._block_depth -= 1
+                notify = self._block_depth == 0
+            if notify:
+                try:
+                    self.transport.notify({
+                        "type": "worker_unblocked",
+                        "worker_id": self.worker_id.binary()})
+                except Exception:
+                    pass
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float],
+                 owner_addr: Optional[dict] = None):
         if oid in self._value_cache:
             self._value_cache.move_to_end(oid)
             return self._value_cache[oid]
-        msg = self.transport.request("get_locations",
-                                     {"oid": oid, "timeout": timeout})
+        from ray_tpu._private.direct import ERROR, EXTERN, PENDING, READY
+
+        entry = self._owned.lookup(oid)
+        if entry is not None:
+            if entry.state == PENDING:
+                with self._blocked_in_get():
+                    if not self._owned.wait_fulfilled(entry, timeout):
+                        raise exc.GetTimeoutError(f"get({oid}) timed out")
+            state, meta, data = entry.state, entry.meta, entry.data
+            if state == READY:
+                value, _ = ser.unpack(meta, memoryview(data))
+                self._cache_value(oid, value)
+                return value
+            if state == ERROR:
+                err, _ = ser.unpack(meta, memoryview(data))
+                if isinstance(err, BaseException):
+                    raise err
+                raise exc.RayTpuError(str(err))
+            # EXTERN: bytes live in the shared store / head — fall through.
+        elif owner_addr is not None and self._direct is not None:
+            nowait = self.ctx.direct_exec and self.ctx.arg_resolve
+            if nowait:
+                msg = self._direct.fetch_from_owner(oid, owner_addr, timeout,
+                                                    nowait=True)
+            else:
+                with self._blocked_in_get():
+                    msg = self._direct.fetch_from_owner(oid, owner_addr,
+                                                        timeout)
+            if msg is not None:
+                k = msg["k"]
+                if k == "pending":
+                    raise _DepsUnready(oid)
+                if k == "bytes":
+                    value, _ = ser.unpack(msg["m"], memoryview(msg["d"]))
+                    self._cache_value(oid, value)
+                    return value
+                if k == "error":
+                    err, _ = ser.unpack(msg["m"], memoryview(msg["d"]))
+                    if isinstance(err, BaseException):
+                        raise err
+                    raise exc.RayTpuError(str(err))
+                if k == "missing":
+                    # The owner no longer holds it and never externalized
+                    # it: unless the head knows the object, it is gone.
+                    if not self.transport.request("object_info",
+                                                  {"oid": oid}):
+                        raise exc.ObjectLostError(
+                            f"object {oid} was freed by its owner")
+                # k == "extern" (or missing-but-head-knows): head path.
+            else:
+                # Owner unreachable (process died): the head may still hold
+                # an externalized copy; otherwise the object died with its
+                # owner (reference: owner failure => ObjectLostError).
+                if not self.transport.request("object_info", {"oid": oid}):
+                    raise exc.ObjectLostError(
+                        f"object {oid} lost: its owner is gone")
+        with self._blocked_in_get():
+            msg = self.transport.request("get_locations",
+                                         {"oid": oid, "timeout": timeout})
         return self._materialize(oid, msg)
 
     def _materialize(self, oid: ObjectID, msg: dict):
@@ -661,10 +879,11 @@ class CoreWorker:
 
     def get_async(self, ref: ObjectRef) -> Future:
         fut: Future = Future()
+        owner = getattr(ref, "owner_addr", None)
 
         def run():
             try:
-                fut.set_result(self._get_one(ref.id, None))
+                fut.set_result(self._get_one(ref.id, None, owner))
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
@@ -677,10 +896,53 @@ class CoreWorker:
              fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
-        ready_bins = self.transport.request(
-            "wait_ready",
-            {"oids": [r.id for r in refs], "num_returns": num_returns,
-             "timeout": timeout})
+        from ray_tpu._private.direct import ERROR, EXTERN, READY
+
+        def _is_owner_local(oid: ObjectID) -> bool:
+            e = self._owned.lookup(oid)
+            return e is not None and e.state != EXTERN
+
+        if any(_is_owner_local(r.id) for r in refs):
+            # Mixed owner-resident + head refs: short-poll both planes
+            # (owner-side readiness is a local check; the head side is one
+            # immediate-reply request per poll).
+            import time as _time
+
+            deadline = (None if timeout is None
+                        else _time.monotonic() + timeout)
+            with self._blocked_in_get():
+                while True:
+                    ready_bin = set()
+                    head_side = []
+                    for r in refs:
+                        e = self._owned.lookup(r.id)
+                        if e is not None and e.state in (READY, ERROR):
+                            ready_bin.add(r.id.binary())
+                        elif r.id in self._value_cache:
+                            ready_bin.add(r.id.binary())
+                        elif e is None or e.state == EXTERN:
+                            head_side.append(r)
+                    if head_side and len(ready_bin) < num_returns:
+                        got = self.transport.request(
+                            "wait_ready",
+                            {"oids": [r.id for r in head_side],
+                             "num_returns": len(head_side), "timeout": 0.0})
+                        ready_bin.update(got)
+                    if len(ready_bin) >= num_returns or (
+                            deadline is not None
+                            and _time.monotonic() >= deadline):
+                        break
+                    _time.sleep(0.003)
+            ready, not_ready = [], []
+            for r in refs:
+                (ready if r.id.binary() in ready_bin
+                 and len(ready) < num_returns else not_ready).append(r)
+            return ready, not_ready
+        with self._blocked_in_get():
+            ready_bins = self.transport.request(
+                "wait_ready",
+                {"oids": [r.id for r in refs], "num_returns": num_returns,
+                 "timeout": timeout})
         ready_set = set(ready_bins)
         ready, not_ready = [], []
         for r in refs:
@@ -693,20 +955,78 @@ class CoreWorker:
                   ) -> Tuple[List[TaskArg], Dict[str, TaskArg]]:
         def conv(v) -> TaskArg:
             if isinstance(v, ObjectRef):
-                return TaskArg(ArgKind.REF, ref=v.id)
+                return TaskArg(ArgKind.REF, ref=v.id,
+                               owner=v._effective_owner())
             s = ser.serialize(v)
             if ser.packed_size(s) > INLINE_OBJECT_THRESHOLD:
                 # Large literal arg: promote to a put object, pass by ref
                 # (reference inlines <100KB, else plasma: dependency_resolver).
                 ref = self.put(v)
-                return TaskArg(ArgKind.REF, ref=ref.id)
+                return TaskArg(ArgKind.REF, ref=ref.id,
+                               owner=ref._effective_owner())
             return TaskArg(ArgKind.VALUE, value=ser.pack(s),
-                           contained=list(s.contained_refs))
+                           contained=list(s.contained_refs),
+                           contained_owners=(s.contained_owners or None))
         return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}
+
+    def _promote_owned_args(self, spec: TaskSpec):
+        """Classic-path submit referencing owner-resident objects: push the
+        bytes to the head directory first (ordered ahead of the submit on
+        the same transport) so the head's arg pinning and the executing
+        worker's resolution see them.  PENDING entries promote when their
+        bytes arrive (the head's get_locations defers until then)."""
+        from ray_tpu._private.direct import ERROR, PENDING, READY
+
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            for oid in ([arg.ref] if arg.ref is not None else []) + arg.contained:
+                entry = self._owned.lookup(oid)
+                if entry is None:
+                    continue
+                if entry.state == PENDING:
+                    self._owned.set_promote_on_fulfill(oid)
+                elif entry.state in (READY, ERROR):
+                    self.promote_owned_to_head(oid)
+
+    def promote_owned_to_head(self, oid: ObjectID) -> None:
+        """Move an owner-resident inline object into the head directory and
+        flip the local entry EXTERN (with refcount mirroring)."""
+        from ray_tpu._private.direct import ERROR, EXTERN, READY
+        from ray_tpu._private.task_spec import ERROR_META
+
+        entry = self._owned.lookup(oid)
+        if entry is None or entry.state not in (READY, ERROR):
+            return
+        meta = entry.meta if entry.state == READY else ERROR_META + entry.meta
+        try:
+            self.transport.notify({"type": "put_inline", "oid": oid.binary(),
+                                   "meta": meta, "data": entry.data})
+        except Exception:
+            return
+        had, has_refs = self._owned.make_extern(oid)
+        if had and has_refs:
+            try:
+                self.transport.request_oneway(
+                    "add_ref",
+                    {"oid": oid, "holder": self.worker_id.binary()})
+            except Exception:
+                pass
+
+    def _adopt_return_refs(self, spec: TaskSpec) -> List[ObjectRef]:
+        """ObjectRefs for a direct submission: each adopts the submission
+        ref pre-held by the owned entry (see OwnedStore.create_pending)."""
+        refs = []
+        for oid in spec.return_ids():
+            r = ObjectRef(oid, skip_adding_local_ref=True)
+            r._owner_registered = True
+            refs.append(r)
+        return refs
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
+        if self._direct is not None and self._direct.submit_task(spec):
+            return self._adopt_return_refs(spec)
+        self._promote_owned_args(spec)
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         tr = _tracing()
         with (tr.span("task.submit", task_name=spec.name)
@@ -717,6 +1037,9 @@ class CoreWorker:
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
+        if self._direct is not None and self._direct.submit_actor_task(spec):
+            return self._adopt_return_refs(spec)
+        self._promote_owned_args(spec)
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         tr = _tracing()
         with (tr.span("actor_task.submit", task_name=spec.name)
@@ -725,10 +1048,22 @@ class CoreWorker:
         return refs
 
     # ---- function resolution ----
-    def load_function(self, blob: bytes, func_hash: Optional[bytes]) -> Callable:
+    def register_func_blob(self, func_hash: bytes, blob: bytes) -> None:
+        """Record a function blob at message-receive time so stripped
+        re-sends (see DirectChannel.exec) can always resolve, even when
+        concurrent actor threads execute out of order."""
+        self._func_blobs.setdefault(func_hash, blob)
+
+    def load_function(self, blob: Optional[bytes],
+                      func_hash: Optional[bytes]) -> Callable:
         key = func_hash or hashlib.sha256(blob).digest()
         fn = self._func_cache.get(key)
         if fn is None:
+            if blob is None:
+                blob = self._func_blobs.get(key)
+                if blob is None:
+                    raise exc.RayTpuError(
+                        "function blob missing for a stripped task spec")
             fn = cloudpickle.loads(blob)
             self._func_cache[key] = fn
         return fn
@@ -763,53 +1098,58 @@ class CoreWorker:
         saved_job_defaults = (self.namespace, self.default_runtime_env)
         job_cfg = self._job_config(spec.job_id) if self.mode == "worker" \
             else {}
-        if job_cfg.get("namespace"):
-            self.namespace = job_cfg["namespace"]
-        if job_cfg.get("runtime_env"):
-            self.default_runtime_env = job_cfg["runtime_env"]
+        if job_cfg:
+            if job_cfg.get("namespace"):
+                self.namespace = job_cfg["namespace"]
+            if job_cfg.get("runtime_env"):
+                self.default_runtime_env = job_cfg["runtime_env"]
         start_ts = _time.time()
         error = None
         error_str = None
         results: List[TaskResult] = []
         env_vars: Dict[str, Any] = {}
         workdir_applied = False
+        renv = spec.runtime_env
         try:
-            # Runtime env (lite): per-task/actor env vars (reference:
-            # python/ray/_private/runtime_env/ plugin architecture; the
-            # conda/pip/container plugins need per-node agents — round 2).
-            env_vars = (spec.runtime_env or {}).get("env_vars") or {}
-            if env_vars:
+            if renv:
+                # Runtime env (lite): per-task/actor env vars (reference:
+                # python/ray/_private/runtime_env/ plugin architecture).
                 # Pooled workers execute many tasks: overlay the keys and
                 # restore the pristine values afterwards so one task's env
                 # does not leak into the next (the reference instead
                 # dedicates workers to a runtime env).
-                _env_overlay.apply(env_vars)
-            working_dir = (spec.runtime_env or {}).get("working_dir")
-            if working_dir:
-                _workdir_overlay.apply(working_dir)
-                workdir_applied = True
-            unsupported = set(spec.runtime_env or {}) - {
-                "env_vars", "working_dir"}
-            if unsupported:
-                raise exc.RayTpuError(
-                    f"runtime_env fields {sorted(unsupported)} are not "
-                    "supported (pip/conda need package egress; this "
-                    "environment has none)")
-            args = [self._resolve_arg(a) for a in spec.args]
-            kwargs = {k: self._resolve_arg(a) for k, a in spec.kwargs.items()}
+                env_vars = renv.get("env_vars") or {}
+                if env_vars:
+                    _env_overlay.apply(env_vars)
+                working_dir = renv.get("working_dir")
+                if working_dir:
+                    _workdir_overlay.apply(working_dir)
+                    workdir_applied = True
+                unsupported = set(renv) - {"env_vars", "working_dir"}
+                if unsupported:
+                    raise exc.RayTpuError(
+                        f"runtime_env fields {sorted(unsupported)} are not "
+                        "supported (pip/conda need package egress; this "
+                        "environment has none)")
+            if spec.args or spec.kwargs:
+                self.ctx.arg_resolve = True
+                try:
+                    args = [self._resolve_arg(a) for a in spec.args]
+                    kwargs = {k: self._resolve_arg(a)
+                              for k, a in spec.kwargs.items()}
+                finally:
+                    self.ctx.arg_resolve = False
+            else:
+                args, kwargs = [], {}
             tr = _tracing()
-            with (tr.span("task.execute", task_name=spec.name,
-                          task_type=spec.task_type.name,
-                          task_id=spec.task_id.hex())
-                  if tr.tracing_enabled() else contextlib.nullcontext()):
-                if spec.task_type == TaskType.NORMAL:
-                    fn = self.load_function(spec.func_blob, spec.func_hash)
-                    out = fn(*args, **kwargs)
-                elif spec.task_type == TaskType.ACTOR_CREATION:
-                    cls = self.load_function(spec.func_blob, spec.func_hash)
-                    self.actors[spec.actor_id] = cls(*args, **kwargs)
-                    out = None
-                elif spec.task_type == TaskType.ACTOR_TASK:
+            span = (tr.span("task.execute", task_name=spec.name,
+                            task_type=spec.task_type.name,
+                            task_id=spec.task_id.hex())
+                    if tr.tracing_enabled() else None)
+            try:
+                if span is not None:
+                    span.__enter__()
+                if spec.task_type == TaskType.ACTOR_TASK:
                     instance = self.actors.get(spec.actor_id)
                     if instance is None:
                         raise exc.ActorDiedError(
@@ -818,9 +1158,21 @@ class CoreWorker:
                     out = method(*args, **kwargs)
                     if _is_coroutine(out):
                         out = _run_coroutine(out)
+                elif spec.task_type == TaskType.NORMAL:
+                    fn = self.load_function(spec.func_blob, spec.func_hash)
+                    out = fn(*args, **kwargs)
+                elif spec.task_type == TaskType.ACTOR_CREATION:
+                    cls = self.load_function(spec.func_blob, spec.func_hash)
+                    self.actors[spec.actor_id] = cls(*args, **kwargs)
+                    out = None
                 else:
                     raise exc.RayTpuError(f"bad task type {spec.task_type}")
+            finally:
+                if span is not None:
+                    span.__exit__(None, None, None)
             results = self._store_returns(spec, out)
+        except _DepsUnready:
+            raise  # bounced to the submitter by the worker loop
         except BaseException as e:  # noqa: BLE001 — errors are task results
             error_str = traceback.format_exc()
             terr = exc.TaskError(type(e).__name__, None, error_str, spec.name)
@@ -863,7 +1215,7 @@ class CoreWorker:
 
     def _resolve_arg(self, arg: TaskArg):
         if arg.kind == ArgKind.REF:
-            return self._get_one(arg.ref, None)
+            return self._get_one(arg.ref, None, getattr(arg, "owner", None))
         meta, data = arg.value
         value, _ = ser.unpack(meta, memoryview(data))
         return value
@@ -892,8 +1244,25 @@ class CoreWorker:
                 results.append(TaskResult(oid, in_store=True, size=size, meta=meta))
         return results
 
+    def cancel_task(self, task_id: TaskID):
+        """ray.cancel: direct in-flight tasks are cancelled by their owner
+        (this process); everything else goes through the head."""
+        if self._direct is not None and self._direct.cancel(task_id):
+            return
+        self.transport.request("cancel", {"task_id": task_id})
+
     def shutdown(self):
         self._closed = True
+        if self._direct is not None:
+            try:
+                self._direct.shutdown()
+            except Exception:
+                pass
+        if self._direct_server is not None:
+            try:
+                self._direct_server.shutdown()
+            except Exception:
+                pass
         self.transport.close()
 
 

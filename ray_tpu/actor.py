@@ -23,6 +23,7 @@ class ActorMethod:
         self._handle = handle
         self._name = name
         self._options = options or {}
+        self._qual_name = f"{handle._class_name}.{name}"
 
     def options(self, **kw) -> "ActorMethod":
         merged = dict(self._options)
@@ -30,7 +31,8 @@ class ActorMethod:
         return ActorMethod(self._handle, self._name, merged)
 
     def remote(self, *args, **kwargs):
-        return self._handle._invoke(self._name, args, kwargs, self._options)
+        return self._handle._invoke(self._name, args, kwargs, self._options,
+                                    self._qual_name)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -50,7 +52,9 @@ class ActorHandle:
     def actor_id(self) -> ActorID:
         return self._actor_id
 
-    def _invoke(self, method_name: str, args, kwargs, options: Dict[str, Any]):
+    def _invoke(self, method_name: str, args, kwargs, options: Dict[str, Any],
+                qual_name: Optional[str] = None):
+        from ray_tpu._private.ids import fast_task_id
         from ray_tpu._private.worker import global_worker
 
         if global_worker is None:
@@ -59,25 +63,29 @@ class ActorHandle:
             return global_worker.call_actor(
                 self._actor_id, method_name, args, kwargs,
                 options.get("num_returns", 1))
-        task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        if args or kwargs:
+            task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        else:
+            task_args, task_kwargs = [], {}
+        num_returns = options.get("num_returns", 1) if options else 1
         spec = TaskSpec(
-            task_id=TaskID.from_random(),
+            task_id=fast_task_id(),
             job_id=global_worker.job_id,
             task_type=TaskType.ACTOR_TASK,
-            name=f"{self._class_name}.{method_name}",
+            name=qual_name or f"{self._class_name}.{method_name}",
             method_name=method_name,
             args=task_args,
             kwargs=task_kwargs,
-            num_returns=options.get("num_returns", 1),
+            num_returns=num_returns,
             actor_id=self._actor_id,
             max_retries=options.get("max_task_retries",
                                     self._max_task_retries),
             retry_exceptions=bool(options.get("retry_exceptions", False)),
         )
         refs = global_worker.submit_actor_task(spec)
-        if spec.num_returns == 0:
+        if num_returns == 0:
             return None
-        return refs[0] if spec.num_returns == 1 else refs
+        return refs[0] if num_returns == 1 else refs
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -85,7 +93,12 @@ class ActorHandle:
         if name not in self._method_names:
             raise AttributeError(
                 f"actor {self._class_name} has no method {name!r}")
-        return ActorMethod(self, name)
+        m = ActorMethod(self, name)
+        # Cache on the instance: repeated handle.method lookups are on the
+        # submission hot path (not serialized — __reduce__ rebuilds from
+        # ctor args, so caches never travel).
+        self.__dict__[name] = m
+        return m
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"

@@ -1,9 +1,12 @@
 """ObjectRef: the user-facing future handle for an object in the cluster.
 
 Reference equivalent: ObjectRef in python/ray/includes/object_ref.pxi.
-Serialization registers borrows through the active worker so the
-owner-centralized refcounting in gcs.py sees every process holding the ref
-(reference protocol: src/ray/core_worker/reference_count.h:61).
+A ref carries its owner's address when the bytes live in a process's
+in-process store (ownership protocol, src/ray/core_worker/
+reference_count.h:61): serialization ships the address with the id, and
+deserialization registers the receiving process as a *borrower* with the
+owner (see _private/direct.py).  Refs without an owner address resolve
+through the head directory as before.
 """
 from __future__ import annotations
 
@@ -17,15 +20,17 @@ _get_global_worker = lambda: None  # noqa: E731
 
 
 class ObjectRef:
-    __slots__ = ("id", "_owner_registered", "__weakref__")
+    __slots__ = ("id", "owner_addr", "_owner_registered", "__weakref__")
 
-    def __init__(self, object_id: ObjectID, skip_adding_local_ref: bool = False):
+    def __init__(self, object_id: ObjectID, skip_adding_local_ref: bool = False,
+                 owner_addr: Optional[dict] = None):
         self.id = object_id
+        self.owner_addr = owner_addr
         self._owner_registered = False
         if not skip_adding_local_ref:
             w = _get_global_worker()
             if w is not None:
-                w.add_local_ref(object_id)
+                w.add_local_ref(object_id, owner_addr)
                 self._owner_registered = True
 
     def binary(self) -> bytes:
@@ -58,23 +63,39 @@ class ObjectRef:
     def __repr__(self):
         return f"ObjectRef({self.id.hex()})"
 
+    def _effective_owner(self) -> Optional[dict]:
+        """The address to ship with this ref: an explicit borrow source, or
+        this process's own direct address when it owns the bytes."""
+        if self.owner_addr is not None:
+            return self.owner_addr
+        w = _get_global_worker()
+        if w is not None and getattr(w, "_owned", None) is not None \
+                and w._owned.contains(self.id):
+            return getattr(w, "direct_addr", None)
+        return None
+
     def __reduce__(self):
+        owner = self._effective_owner()
         if ser.ref_context.active:
             ser.ref_context.refs.append(self.id)
-        return (_deserialize_ref, (self.id.binary(),))
+            if owner is not None:
+                ser.ref_context.owners[self.id.binary()] = owner
+        return (_deserialize_ref, (self.id.binary(), owner))
 
     def __del__(self):
         if self._owner_registered:
             w = _get_global_worker()
             if w is not None:
                 try:
-                    w.remove_local_ref(self.id)
+                    w.remove_local_ref(self.id, self.owner_addr)
                 except Exception:
                     pass
 
 
-def _deserialize_ref(binary: bytes) -> ObjectRef:
-    ref = ObjectRef(ObjectID(binary))
+def _deserialize_ref(binary: bytes, owner_addr: Optional[dict] = None) -> ObjectRef:
+    ref = ObjectRef(ObjectID(binary), owner_addr=owner_addr)
     if ser.ref_context.active:
         ser.ref_context.refs.append(ref.id)
+        if owner_addr is not None:
+            ser.ref_context.owners[ref.id.binary()] = owner_addr
     return ref

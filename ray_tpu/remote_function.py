@@ -60,6 +60,10 @@ class RemoteFunction:
         # incomplete module globals.
         self._blob_cache: Optional[bytes] = None
         self._hash_cache: Optional[bytes] = None
+        # (name, resources, strategy, ...) resolved once per instance; the
+        # resources/strategy objects are shared across submitted specs and
+        # treated as read-only downstream.
+        self._call_cache = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
 
     @property
@@ -82,10 +86,12 @@ class RemoteFunction:
         rf._options = merged
         rf._blob_cache = self._blob_cache
         rf._hash_cache = self._hash_cache
+        rf._call_cache = None
         rf.__name__ = self.__name__
         return rf
 
     def remote(self, *args, **kwargs):
+        from ray_tpu._private.ids import fast_task_id
         from ray_tpu._private.worker import global_worker
 
         if global_worker is None:
@@ -96,33 +102,50 @@ class RemoteFunction:
             # (reference: ray.init(local_mode=True)).
             return global_worker.run_function(
                 self._function, args, kwargs, opts.get("num_returns", 1))
-        task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        if args or kwargs:
+            task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        else:
+            task_args, task_kwargs = [], {}
+        # Options are immutable per RemoteFunction instance: resolve the
+        # resource vector / strategy / shared knobs once (submission path).
+        cached = self._call_cache
+        if cached is None:
+            cached = self._call_cache = (
+                opts.get("name") or self.__name__,
+                _resources_from_options(opts),
+                _strategy_from_options(opts),
+                opts.get("num_returns", 1),
+                opts.get("max_retries", 3),
+                bool(opts.get("retry_exceptions", False)),
+                opts.get("runtime_env"),
+            )
+        name, resources, strategy, num_returns, max_retries, retry_exc, \
+            renv = cached
         spec = TaskSpec(
-            task_id=TaskID.from_random(),
+            task_id=fast_task_id(),
             job_id=global_worker.job_id,
             task_type=TaskType.NORMAL,
-            name=opts.get("name") or self.__name__,
+            name=name,
             func_blob=self._blob,
             func_hash=self._hash,
             args=task_args,
             kwargs=task_kwargs,
-            num_returns=opts.get("num_returns", 1),
-            resources=_resources_from_options(opts),
-            scheduling_strategy=_strategy_from_options(opts),
-            max_retries=opts.get("max_retries", 3),
-            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            num_returns=num_returns,
+            resources=resources,
+            scheduling_strategy=strategy,
+            max_retries=max_retries,
+            retry_exceptions=retry_exc,
             # Explicit per-call values win even when falsy (runtime_env={}
             # deliberately clears the job default); only None/absent falls
             # back (reference: JobConfig default semantics).
-            runtime_env=(opts.get("runtime_env")
-                         if opts.get("runtime_env") is not None
+            runtime_env=(renv if renv is not None
                          else getattr(global_worker, "default_runtime_env",
                                       None)),
         )
         refs = global_worker.submit_task(spec)
-        if spec.num_returns == 0:
+        if num_returns == 0:
             return None
-        return refs[0] if spec.num_returns == 1 else refs
+        return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
