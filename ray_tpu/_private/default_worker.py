@@ -116,7 +116,8 @@ def main():
         # Bounce-on-pending applies only to leased NORMAL tasks; actor
         # calls must keep per-caller submission order, so they block
         # (their producers are never queued behind them on this channel).
-        worker.ctx.direct_exec = spec.task_type == TaskType.NORMAL
+        worker.ctx.direct_exec = True
+        worker.ctx.bounce_ok = spec.task_type == TaskType.NORMAL
         try:
             msg = worker.execute_task(spec)
         except _DepsUnready:
@@ -129,6 +130,7 @@ def main():
                     "error_str": None}
         finally:
             worker.ctx.direct_exec = False
+            worker.ctx.bounce_ok = False
         return {"t": "done", "task_id": msg["task_id"],
                 "results": msg["results"], "error": msg["error"],
                 "error_str": msg["error_str"]}
@@ -217,6 +219,8 @@ def main():
         if pool_started and spec.task_type == TaskType.ACTOR_TASK:
             actor_q.put((spec, reply_conn))
         elif reply_conn is None:
+            if done_buf:
+                flush_done_buf()  # classic task may block for a long time
             run_one(spec, None)
         else:
             dones = done_buf.setdefault(id(reply_conn), (reply_conn, []))[1]

@@ -61,7 +61,7 @@ FETCH_WAIT_S = 120.0  # safety valve on deferred fetch replies
 
 class _Entry:
     __slots__ = ("state", "meta", "data", "refs", "pins", "waiters",
-                 "promote")
+                 "promote", "linked")
 
     def __init__(self):
         self.state = PENDING
@@ -71,6 +71,9 @@ class _Entry:
         self.pins: Optional[Dict[bytes, int]] = None  # token -> count
         self.waiters: Optional[List[Callable]] = None  # deferred fetch replies
         self.promote = False        # promote to head on fulfill (classic arg)
+        # Contained-ref pins released when THIS entry is freed:
+        # (res-token, [(oid binary, owner addr), ...]).
+        self.linked = None
 
 
 class OwnedStore:
@@ -86,6 +89,10 @@ class OwnedStore:
         self._cond = threading.Condition(self._lock)
         self._nwaiters = 0
         self._entries: Dict[ObjectID, _Entry] = {}
+        # Linked-pin descriptors of freed entries, drained by the
+        # submitter's maintenance loop (released OUTSIDE the store lock —
+        # the release sends on channels whose locks order after ours).
+        self.released_links: deque = deque()
 
     # ---- lifecycle ----
     def create_pending(self, oid: ObjectID) -> None:
@@ -234,10 +241,23 @@ class OwnedStore:
                 e.pins[token] = n
             self._maybe_free(oid, e)
 
+    def set_linked(self, oid: ObjectID, linked) -> bool:
+        """Attach contained-ref pins to an entry; False if already freed
+        (caller releases the pins immediately)."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return False
+            e.linked = linked
+            return True
+
     def _maybe_free(self, oid: ObjectID, e: _Entry) -> None:
         if e.refs <= 0 and not e.pins and e.state != PENDING \
                 and not e.waiters and not e.promote:
             self._entries.pop(oid, None)
+            if e.linked is not None:
+                self.released_links.append(e.linked)
+                e.linked = None
 
     # ---- fetch serving (deferred replies: reference pubsub-on-ready) ----
     def fetch_or_wait(self, oid: ObjectID, respond: Callable,
@@ -524,23 +544,23 @@ class DirectChannel:
                 batch = []
                 while self._outq and len(batch) < 128:
                     batch.append(self._outq.popleft())
-            restore = []
+            import copy as _copy
+
+            wire = []
             for spec in batch:
                 h = spec.func_hash
                 if spec.func_blob is not None and h is not None:
                     if h in self.sent_funcs:
-                        # Strip for the wire only; restored below so a
-                        # retry on a fresh channel still carries the blob.
-                        restore.append((spec, spec.func_blob))
+                        # Strip on a shallow COPY: the original spec may be
+                        # re-pickled concurrently by a classic reroute.
+                        spec = _copy.copy(spec)
                         spec.func_blob = None
                     else:
                         self.sent_funcs.add(h)
-            msg = ({"t": "exec", "spec": batch[0]} if len(batch) == 1
-                   else {"t": "execb", "specs": batch})
-            ok = self.send(msg)
-            for spec, blob in restore:
-                spec.func_blob = blob
-            if not ok:
+                wire.append(spec)
+            msg = ({"t": "exec", "spec": wire[0]} if len(wire) == 1
+                   else {"t": "execb", "specs": wire})
+            if not self.send(msg):
                 self._fire_close()
                 return
 
@@ -842,19 +862,25 @@ class DirectSubmitter:
     def _actor_to_classic(self, ac: _ActorClient, _err):
         """Hand an actor's queued + future calls to the classic head path.
         Their owned entries flip EXTERN so results (including authoritative
-        death errors) resolve through the head."""
-        with self._lock:
-            ac.state = A_CLASSIC
-            specs = list(ac.queue) + list(ac.inflight.values())
-            ac.queue.clear()
-            ac.inflight.clear()
-        for spec in specs:
-            self._reroute_classic(spec, actor=True)
+        death errors) resolve through the head.  Drain-then-flip: new calls
+        keep queueing (state stays RESOLVING) until the backlog has been
+        rerouted, so the head sees them in submission order."""
+        while True:
+            with self._lock:
+                specs = list(ac.queue) + list(ac.inflight.values())
+                ac.queue.clear()
+                ac.inflight.clear()
+                if not specs:
+                    ac.state = A_CLASSIC
+                    return
+            for spec in specs:
+                self._reroute_classic(spec, actor=True)
 
-    def _reroute_classic(self, spec: TaskSpec, actor: bool = False):
-        inf = None
-        with self._lock:
-            inf = self._inflight.pop(spec.task_id.binary(), None)
+    def _reroute_classic(self, spec: TaskSpec, actor: bool = False,
+                         inf: Optional[_Inflight] = None):
+        if inf is None:
+            with self._lock:
+                inf = self._inflight.pop(spec.task_id.binary(), None)
         if inf is not None:
             self._release_pins(inf)
         for oid in spec.return_ids():
@@ -900,19 +926,7 @@ class DirectSubmitter:
         if msg.get("unready"):
             # Worker bounced the push: a dep was still pending at its owner.
             # Re-route through the head (no attempt charge — nothing ran).
-            self._release_pins(inf)
-            for oid in spec.return_ids():
-                self._make_extern_mirrored(oid)
-            try:
-                self.core._promote_owned_args(spec)
-                self.core.transport.request_oneway(
-                    "actor_call" if inf.actor is not None else "submit",
-                    {"spec": spec})
-            except Exception:
-                meta, data = _pack_error(exc.RayTpuError(
-                    "task lost: could not reach the head for fallback"))
-                for oid in spec.return_ids():
-                    self.owned.fulfill_error(oid, meta, data)
+            self._reroute_classic(spec, actor=inf.actor is not None, inf=inf)
             return
         error = msg.get("error")
         if (error is not None and spec.retry_exceptions
@@ -938,11 +952,15 @@ class DirectSubmitter:
             self._reroute_classic(spec, actor=inf.actor is not None)
             return
         self._release_pins(inf)
+        self._cancelled.discard(spec.task_id)
         results = msg.get("results") or []
         got = set()
         for res in results:
             got.add(res.object_id)
             if res.inline is not None:
+                contained = getattr(res, "contained", None)
+                if contained:
+                    self._take_contained_pins(spec, res, contained)
                 self.owned.fulfill_inline(res.object_id, res.inline[0],
                                           res.inline[1])
                 if self.owned.take_promote(res.object_id):
@@ -959,6 +977,55 @@ class DirectSubmitter:
                     self.owned.fulfill_error(oid, error[0], error[1])
                     if self.owned.take_promote(oid):
                         self.core.promote_owned_to_head(oid)
+
+    def _is_self(self, owner: Optional[dict]) -> bool:
+        mine = self.core.direct_addr
+        if owner is None or mine is None:
+            return False
+        if owner is mine:
+            return True
+        return owner.get("unix") is not None \
+            and owner.get("unix") == mine.get("unix")
+
+    def _take_contained_pins(self, spec: TaskSpec, res, contained):
+        """Contained-ref handover: register `res:` pins (tied to the
+        result entry's lifetime) at each nested ref's owner, then release
+        the returner's `ret:` pin — ordered on the same channel so the
+        object can never be unpinned-before-pinned."""
+        token = b"res:" + res.object_id.binary()
+        ret_tok = b"ret:" + spec.task_id.binary()
+        for oid_b, owner in contained:
+            oid = ObjectID(oid_b)
+            try:
+                if self._is_self(owner):
+                    self.owned.pin(oid, token)
+                    self.owned.unpin(oid, ret_tok)
+                else:
+                    ch = self._fetch_chan_for(owner)
+                    if ch is not None:
+                        ch.pin(oid, token)
+                        ch.unpin(oid, ret_tok)
+            except Exception:
+                pass
+        if not self.owned.set_linked(res.object_id, (token, contained)):
+            # Result entry already gone (nobody holds it): release now.
+            self.owned.released_links.append((token, contained))
+
+    def _drain_released_links(self):
+        while True:
+            try:
+                token, contained = self.owned.released_links.popleft()
+            except IndexError:
+                return
+            for oid_b, owner in contained:
+                oid = ObjectID(oid_b)
+                try:
+                    if self._is_self(owner):
+                        self.owned.unpin(oid, token)
+                    else:
+                        self.unpin_at_owner(oid, owner, token)
+                except Exception:
+                    pass
 
     def _on_chan_close(self, chan: DirectChannel):
         """A direct connection died.  Leased tasks retry (budget permitting)
@@ -1011,6 +1078,7 @@ class DirectSubmitter:
         for inf in to_fail:
             self._release_pins(inf)
             cancelled = inf.spec.task_id in self._cancelled
+            self._cancelled.discard(inf.spec.task_id)
             err = (exc.RayTpuError("task cancelled") if cancelled
                    else (exc.ActorDiedError("actor worker died")
                          if inf.actor is not None
@@ -1148,6 +1216,7 @@ class DirectSubmitter:
     def _maintenance(self):
         while not self._closed:
             time.sleep(0.2)
+            self._drain_released_links()
             drop: List[Tuple[tuple, _Lease]] = []
             now = time.monotonic()
             with self._lock:

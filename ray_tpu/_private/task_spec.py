@@ -185,10 +185,16 @@ class TaskResult:
     in_store: bool = False
     size: int = 0
     meta: bytes = b""
+    # Refs nested in an inline result: [(oid binary, owner addr)].  The
+    # returner holds a `ret:` pin on each at its owner; the caller takes
+    # over with a `res:` pin tied to the result entry's lifetime, then
+    # releases the returner's pin (reference: contained-ref handover in
+    # task replies, reference_count.h:543).
+    contained: Optional[List[Tuple[bytes, dict]]] = None
 
     def __reduce__(self):
         return (TaskResult, (self.object_id, self.inline, self.in_store,
-                             self.size, self.meta))
+                             self.size, self.meta, self.contained))
 
 
 class TaskStatus(enum.Enum):

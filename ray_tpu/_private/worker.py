@@ -331,6 +331,7 @@ class TaskContext(threading.local):
         self.put_counter = 0
         self.task_name = ""
         self.direct_exec = False   # executing a direct-pushed task
+        self.bounce_ok = False     # NORMAL direct task: may bounce deps
         self.arg_resolve = False   # inside execute_task arg resolution
 
 
@@ -410,13 +411,13 @@ class CoreWorker:
                 rec = self._borrowed.get(oid)
                 if rec is None:
                     self._borrowed[oid] = [owner_addr, 1]
-                    first = True
+                    # Register the borrow before any concurrent last-ref
+                    # drop can send the matching unpin (ordering on the
+                    # owner requires pin-before-unpin).
+                    self._direct.pin_at_owner(
+                        oid, owner_addr, b"bor:" + self.worker_id.binary())
                 else:
                     rec[1] += 1
-                    first = False
-            if first:
-                self._direct.pin_at_owner(
-                    oid, owner_addr, b"bor:" + self.worker_id.binary())
             return
         with self._refs_lock:
             n = self._local_refs.get(oid, 0)
@@ -456,15 +457,15 @@ class CoreWorker:
                 last_borrow = rec[1] <= 0
                 if last_borrow:
                     self._borrowed.pop(oid, None)
+                    if self._direct is not None:
+                        self._direct.unpin_at_owner(
+                            oid, rec[0], b"bor:" + self.worker_id.binary())
             else:
                 last_borrow = None
         if rec is not None:
             if last_borrow:
                 self._value_cache.pop(oid, None)
                 self._shm_registry.pop(oid, None)
-                if self._direct is not None:
-                    self._direct.unpin_at_owner(
-                        oid, rec[0], b"bor:" + self.worker_id.binary())
             return
         with self._refs_lock:
             n = self._local_refs.get(oid, 0) - 1
@@ -684,7 +685,7 @@ class CoreWorker:
                 raise exc.RayTpuError(str(err))
             # EXTERN: bytes live in the shared store / head — fall through.
         elif owner_addr is not None and self._direct is not None:
-            nowait = self.ctx.direct_exec and self.ctx.arg_resolve
+            nowait = self.ctx.bounce_ok and self.ctx.arg_resolve
             if nowait:
                 msg = self._direct.fetch_from_owner(oid, owner_addr, timeout,
                                                     nowait=True)
@@ -898,11 +899,15 @@ class CoreWorker:
             raise ValueError("num_returns > len(refs)")
         from ray_tpu._private.direct import ERROR, EXTERN, READY
 
-        def _is_owner_local(oid: ObjectID) -> bool:
-            e = self._owned.lookup(oid)
-            return e is not None and e.state != EXTERN
+        def _is_owner_local(r) -> bool:
+            e = self._owned.lookup(r.id)
+            if e is not None and e.state != EXTERN:
+                return True
+            # Borrowed refs resolve at their owner, which the head never
+            # hears about — they must poll the owner, not the head.
+            return e is None and getattr(r, "owner_addr", None) is not None
 
-        if any(_is_owner_local(r.id) for r in refs):
+        if any(_is_owner_local(r) for r in refs):
             # Mixed owner-resident + head refs: short-poll both planes
             # (owner-side readiness is a local check; the head side is one
             # immediate-reply request per poll).
@@ -916,10 +921,19 @@ class CoreWorker:
                     head_side = []
                     for r in refs:
                         e = self._owned.lookup(r.id)
+                        owner = getattr(r, "owner_addr", None)
                         if e is not None and e.state in (READY, ERROR):
                             ready_bin.add(r.id.binary())
                         elif r.id in self._value_cache:
                             ready_bin.add(r.id.binary())
+                        elif e is None and owner is not None \
+                                and self._direct is not None:
+                            got = self._direct.fetch_from_owner(
+                                r.id, owner, None, nowait=True)
+                            if got is None or got["k"] != "pending":
+                                # bytes/error/extern/missing: get() will
+                                # resolve (or raise) promptly => ready.
+                                ready_bin.add(r.id.binary())
                         elif e is None or e.state == EXTERN:
                             head_side.append(r)
                     if head_side and len(ready_bin) < num_returns:
@@ -1234,7 +1248,32 @@ class CoreWorker:
             s = ser.serialize(value)
             size = ser.packed_size(s)
             if size <= INLINE_OBJECT_THRESHOLD:
-                results.append(TaskResult(oid, inline=ser.pack(s)))
+                contained = None
+                if s.contained_refs and self.ctx.direct_exec:
+                    # Contained-ref handover (reference_count.h:543): hold
+                    # a `ret:` pin on each nested ref at its owner until
+                    # the caller registers its own `res:` pin (_on_done).
+                    token = b"ret:" + spec.task_id.binary()
+                    contained = []
+                    for coid in s.contained_refs:
+                        if self._owned.contains(coid):
+                            self._owned.pin(coid, token)
+                            contained.append((coid.binary(),
+                                              self.direct_addr))
+                        else:
+                            owner = s.contained_owners.get(coid.binary())
+                            if owner is not None and self._direct is not None:
+                                self._direct.pin_at_owner(coid, owner, token)
+                                contained.append((coid.binary(), owner))
+                elif s.contained_refs:
+                    # Classic-path result: no handover protocol runs, so
+                    # nested owner-resident refs must outlive this worker's
+                    # local refs — promote them into the head directory.
+                    for coid in s.contained_refs:
+                        if self._owned.contains(coid):
+                            self.promote_owned_to_head(coid)
+                results.append(TaskResult(oid, inline=ser.pack(s),
+                                          contained=contained))
             else:
                 meta = self._write_to_store(oid, s, size)
                 self.transport.notify({

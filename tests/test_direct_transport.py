@@ -1,0 +1,227 @@
+"""Direct transport + ownership protocol (reference:
+direct_task_transport.h lease caching, reference_count.h borrowing).
+
+Covers the round-4 redesign: owner-resident objects, borrow pins at the
+owner, cross-node borrowed nested refs under chaos, owner-death
+semantics, and the lease path's fallback behavior.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.testing import remote_node_agents, wait_for_condition
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024**2)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def routable_cluster(monkeypatch):
+    """Cluster whose control + direct listeners accept cross-host-key
+    connections (0.0.0.0 bind): the genuine owner-fetch path between
+    simulated hosts."""
+    monkeypatch.setenv("RAY_TPU_TCP_HOST", "0.0.0.0")
+    from ray_tpu._private.config import CONFIG
+
+    CONFIG.reset()
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024**2)
+    yield
+    ray_tpu.shutdown()
+    monkeypatch.delenv("RAY_TPU_TCP_HOST")
+    CONFIG.reset()
+
+
+def _owned_stats():
+    from ray_tpu._private.worker import global_worker
+
+    return global_worker._owned.stats()
+
+
+def test_owned_put_roundtrip_no_head(cluster):
+    """Small puts live in the owner's in-process store — the head
+    directory never hears about them."""
+    from ray_tpu import _head
+
+    ref = ray_tpu.put({"k": 123})
+    assert ray_tpu.get(ref) == {"k": 123}
+    assert _head.gcs.object_lookup(ref.id) is None
+    assert _owned_stats()["entries"] >= 1
+
+
+def test_owned_result_freed_on_ref_drop(cluster):
+    @ray_tpu.remote
+    def f():
+        return 7
+
+    refs = [f.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == [7] * 20
+    del refs
+    gc.collect()
+    wait_for_condition(lambda: _owned_stats()["entries"] == 0, timeout=10)
+
+
+def test_borrowed_arg_survives_driver_ref_drop(cluster):
+    """Task-pin protocol: the submitter pins owned args at the owner for
+    the task's lifetime, so dropping the driver ObjectRef right after
+    submit cannot free the bytes under the executing worker."""
+    ref = ray_tpu.put(np.arange(100))
+
+    @ray_tpu.remote
+    def consume(x):
+        time.sleep(0.5)
+        return int(x.sum())
+
+    out = consume.remote(ref)
+    del ref
+    gc.collect()
+    assert ray_tpu.get(out, timeout=60) == 4950
+
+
+def test_nested_borrow_reshare_through_value(cluster):
+    """A ref nested inside a value arg deserializes in the worker as a
+    borrow (pin registered at the owner) and resolves by owner fetch."""
+    inner = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    def unwrap(box):
+        return ray_tpu.get(box["r"]) + 1
+
+    assert ray_tpu.get(unwrap.remote({"r": inner}), timeout=60) == 42
+
+
+def test_worker_owned_nested_ref_and_owner_death(cluster):
+    """A worker's put travels to the driver as a borrowed ref (owner =
+    the worker); after the owner process dies the object is lost with a
+    clean error (reference: owner failure => ObjectLostError)."""
+    @ray_tpu.remote
+    class Owner:
+        def make(self):
+            return {"inner": ray_tpu.put(np.full(8, 9))}
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    o = Owner.remote()
+    box = ray_tpu.get(o.make.remote(), timeout=60)
+    inner = box["inner"]
+    assert ray_tpu.get(inner, timeout=60).sum() == 72
+    # Kill the owner: cached value still serves locally, but a fresh
+    # process-level resolution of an uncached owned object must fail.
+    box2 = ray_tpu.get(o.make.remote(), timeout=60)
+    pid = ray_tpu.get(o.pid.remote(), timeout=60)
+    import os
+    import signal
+
+    os.kill(pid, signal.SIGKILL)
+    time.sleep(1.5)
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(box2["inner"], timeout=30)
+
+
+def test_borrowed_nested_refs_across_agent_nodes(routable_cluster):
+    """VERDICT r3 #2 'done' gate: borrowed nested refs flow across two
+    real node-agent processes (distinct host keys) and drain without
+    leaks."""
+    from ray_tpu import _head
+
+    with remote_node_agents(_head, n=2, num_cpus=2):
+        inner_refs = [ray_tpu.put(np.full(64, i)) for i in range(8)]
+
+        @ray_tpu.remote
+        def reshare(box):
+            # Borrower re-shares the borrowed refs to a nested task —
+            # possibly on the other agent node.
+            @ray_tpu.remote
+            def total(b):
+                return int(sum(ray_tpu.get(r).sum() for r in b["refs"]))
+
+            return ray_tpu.get(total.remote(b=box), timeout=120)
+
+        out = ray_tpu.get(
+            [reshare.remote({"refs": inner_refs}) for _ in range(4)],
+            timeout=180)
+        want = sum(64 * i for i in range(8))
+        assert out == [want] * 4
+        del inner_refs
+        gc.collect()
+        wait_for_condition(lambda: _owned_stats()["entries"] == 0,
+                           timeout=15)
+
+
+def test_no_borrow_leak_under_chaos_wave(cluster, monkeypatch):
+    """Chaos extension of the r3 leak gate: schedule-fuzzed borrowed
+    nested refs; after refs drop both the owner store and the head
+    directory drain."""
+    monkeypatch.setenv("RAY_TPU_TESTING_DELAY_MS", "submit:0:5")
+    from ray_tpu import state
+
+    inner = [ray_tpu.put(np.full(32, i)) for i in range(6)]
+
+    @ray_tpu.remote
+    def agg(box):
+        return int(sum(ray_tpu.get(r).sum() for r in box))
+
+    outs = [agg.remote(inner) for _ in range(24)]
+    want = sum(32 * i for i in range(6))
+    assert ray_tpu.get(outs, timeout=120) == [want] * 24
+    del inner, outs
+    gc.collect()
+    wait_for_condition(lambda: _owned_stats()["entries"] == 0, timeout=15)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if state.summarize_objects()["total_bytes"] == 0:
+            break
+        time.sleep(0.25)
+    assert state.summarize_objects()["total_bytes"] == 0
+
+
+def test_lease_returned_after_idle(cluster):
+    """Idle leases go back to the head (resources released)."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(50)], timeout=60)
+
+    def all_free():
+        avail = ray_tpu.available_resources()
+        total = ray_tpu.cluster_resources()
+        return avail.get("CPU") == total.get("CPU")
+
+    wait_for_condition(all_free, timeout=10)
+
+
+def test_direct_disabled_still_works(monkeypatch):
+    """The classic path remains a complete transport when the direct
+    plane is switched off."""
+    monkeypatch.setenv("RAY_TPU_DIRECT_TRANSPORT", "0")
+    from ray_tpu._private.config import CONFIG
+
+    CONFIG.reset()
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024**2)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        @ray_tpu.remote
+        class A:
+            def m(self):
+                return "ok"
+
+        assert ray_tpu.get(f.remote(1), timeout=60) == 2
+        a = A.remote()
+        assert ray_tpu.get(a.m.remote(), timeout=60) == "ok"
+    finally:
+        ray_tpu.shutdown()
+        monkeypatch.delenv("RAY_TPU_DIRECT_TRANSPORT")
+        CONFIG.reset()
