@@ -949,7 +949,10 @@ class DirectSubmitter:
                 return
             with self._lock:
                 self._inflight.pop(tid, None)
-            self._reroute_classic(spec, actor=inf.actor is not None)
+                if inf.lease is not None and resub:
+                    inf.lease.inflight -= 1  # the push we just failed
+            self._reroute_classic(spec, actor=inf.actor is not None,
+                                  inf=inf)
             return
         self._release_pins(inf)
         self._cancelled.discard(spec.task_id)
@@ -994,17 +997,19 @@ class DirectSubmitter:
         object can never be unpinned-before-pinned."""
         token = b"res:" + res.object_id.binary()
         ret_tok = b"ret:" + spec.task_id.binary()
-        for oid_b, owner in contained:
+        for oid_b, owner, prepinned in contained:
             oid = ObjectID(oid_b)
             try:
                 if self._is_self(owner):
                     self.owned.pin(oid, token)
-                    self.owned.unpin(oid, ret_tok)
+                    if prepinned:
+                        self.owned.unpin(oid, ret_tok)
                 else:
                     ch = self._fetch_chan_for(owner)
                     if ch is not None:
                         ch.pin(oid, token)
-                        ch.unpin(oid, ret_tok)
+                        if prepinned:
+                            ch.unpin(oid, ret_tok)
             except Exception:
                 pass
         if not self.owned.set_linked(res.object_id, (token, contained)):
@@ -1017,7 +1022,7 @@ class DirectSubmitter:
                 token, contained = self.owned.released_links.popleft()
             except IndexError:
                 return
-            for oid_b, owner in contained:
+            for oid_b, owner, _prepinned in contained:
                 oid = ObjectID(oid_b)
                 try:
                     if self._is_self(owner):

@@ -173,6 +173,13 @@ class MemoryMonitor:
             return
         victim = self.policy(self._candidates())
         if victim is None:
+            # Last resort: actor workers.  The reference's policies rank
+            # actors/non-retriable last rather than exempting them — a host
+            # whose pressure comes from actors must still get relief (the
+            # actor FSM's restart path rebuilds state afterwards).  Newest
+            # actor first: it has accumulated the least state.
+            victim = self._actor_last_resort()
+        if victim is None:
             return
         self.kill_count += 1
         spec = victim.current_task
@@ -213,3 +220,21 @@ class MemoryMonitor:
                         and h.proc is not None and h.proc.poll() is None):
                     out.append((h, h.current_task, h.task_started_at))
         return out
+
+    def _actor_last_resort(self):
+        from ray_tpu._private.raylet import RemoteRaylet
+
+        best, best_t = None, -1.0
+        for raylet in self.head.raylets.values():
+            if isinstance(raylet, RemoteRaylet):
+                continue
+            for h in raylet.workers.values():
+                if (h.actor_id is not None and h.proc is not None
+                        and h.proc.poll() is None):
+                    # idle_since ~= registration time for actor workers
+                    # (they never rejoin the idle pool): newest actor has
+                    # accumulated the least state.
+                    t = h.idle_since
+                    if t > best_t:
+                        best, best_t = h, t
+        return best

@@ -81,6 +81,8 @@ class NodeAgent:
         })
         threading.Thread(target=self._reap_loop, name="rtpu-agent-reap",
                          daemon=True).start()
+        threading.Thread(target=self._memory_loop, name="rtpu-agent-mem",
+                         daemon=True).start()
         threading.Thread(target=self._stats_loop, name="rtpu-agent-stats",
                          daemon=True).start()
         try:
@@ -129,6 +131,7 @@ class NodeAgent:
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.default_worker"],
             env=env)
+        proc._rtpu_spawned = time.monotonic()
         with self._children_lock:
             self._children[msg["worker_id"]] = proc
 
@@ -158,6 +161,54 @@ class NodeAgent:
                                    "code": code})
                     except Exception:
                         return
+
+    def _memory_loop(self):
+        """Host memory-pressure relief for THIS node (the head's monitor
+        only reads the head host's memory; remote workers would otherwise
+        be at the mercy of the kernel OOM-killer, which can take the
+        agent/store down with them).  Kills the newest child under
+        pressure — one per period, like the head-side pacing; the head's
+        death handling retries/fails the victim's work.  Policy-blind by
+        design: the agent has no task/actor visibility (that state lives
+        in the head), so it cannot apply the ranked head-side policies —
+        newest-child is the LIFO approximation."""
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu._private.memory_monitor import host_memory_usage_fraction
+
+        period = CONFIG.memory_monitor_refresh_ms / 1000.0
+        threshold = CONFIG.memory_usage_threshold
+        test_file = CONFIG.memory_monitor_test_file
+        if period <= 0:
+            return
+        while not self._shutdown.is_set():
+            time.sleep(period)
+            usage = 0.0
+            if test_file:
+                try:
+                    with open(test_file) as f:
+                        usage = float(f.read().strip() or 0.0)
+                except (OSError, ValueError):
+                    usage = 0.0
+            else:
+                usage = host_memory_usage_fraction()
+            if usage < threshold:
+                continue
+            with self._children_lock:
+                items = list(self._children.items())
+            now = time.monotonic()
+            victim = None
+            for wid, proc in items:
+                # Spawn grace: a worker needs ~2s to boot; killing it
+                # before it can run anything just spawn-loops the retry.
+                if proc.poll() is None and \
+                        now - getattr(proc, "_rtpu_spawned", 0.0) > 3.0:
+                    victim = (wid, proc)  # dict order: newest spawn last
+            if victim is None:
+                continue
+            try:
+                victim[1].kill()
+            except Exception:
+                pass
 
     def _stats_loop(self):
         """Per-node usage snapshots → head (reference: the dashboard

@@ -407,17 +407,30 @@ class CoreWorker:
         # Borrower path: register the borrow with the owner (reference:
         # borrow registration, reference_count.h:520) instead of the head.
         if owner_addr is not None and self._direct is not None:
+            # rec = [owner_addr, count, pinned?]; the pin itself happens
+            # OUTSIDE the refs lock (it can open a connection).  Ordering
+            # (pin-before-unpin at the owner) comes from the handshake:
+            # the unpin is deferred to whichever thread holds/reaches the
+            # pinned state last (see remove_local_ref).
             with self._refs_lock:
                 rec = self._borrowed.get(oid)
                 if rec is None:
-                    self._borrowed[oid] = [owner_addr, 1]
-                    # Register the borrow before any concurrent last-ref
-                    # drop can send the matching unpin (ordering on the
-                    # owner requires pin-before-unpin).
-                    self._direct.pin_at_owner(
-                        oid, owner_addr, b"bor:" + self.worker_id.binary())
+                    rec = self._borrowed[oid] = [owner_addr, 1, False]
+                    register = True
                 else:
                     rec[1] += 1
+                    register = False
+            if register:
+                self._direct.pin_at_owner(
+                    oid, owner_addr, b"bor:" + self.worker_id.binary())
+                with self._refs_lock:
+                    rec[2] = True
+                    dead = rec[1] <= 0
+                    if dead:
+                        self._borrowed.pop(oid, None)
+                if dead:  # every ref dropped while we were registering
+                    self._direct.unpin_at_owner(
+                        oid, owner_addr, b"bor:" + self.worker_id.binary())
             return
         with self._refs_lock:
             n = self._local_refs.get(oid, 0)
@@ -454,18 +467,21 @@ class CoreWorker:
             rec = self._borrowed.get(oid)
             if rec is not None:
                 rec[1] -= 1
-                last_borrow = rec[1] <= 0
+                last_borrow = rec[1] <= 0 and rec[2]
                 if last_borrow:
+                    # Pin already registered: this thread sends the unpin.
+                    # If the registering thread is still mid-pin (rec[2]
+                    # False), IT will observe count<=0 and unpin.
                     self._borrowed.pop(oid, None)
-                    if self._direct is not None:
-                        self._direct.unpin_at_owner(
-                            oid, rec[0], b"bor:" + self.worker_id.binary())
             else:
                 last_borrow = None
         if rec is not None:
             if last_borrow:
                 self._value_cache.pop(oid, None)
                 self._shm_registry.pop(oid, None)
+                if self._direct is not None:
+                    self._direct.unpin_at_owner(
+                        oid, rec[0], b"bor:" + self.worker_id.binary())
             return
         with self._refs_lock:
             n = self._local_refs.get(oid, 0) - 1
@@ -930,8 +946,16 @@ class CoreWorker:
                                 and self._direct is not None:
                             got = self._direct.fetch_from_owner(
                                 r.id, owner, None, nowait=True)
-                            if got is None or got["k"] != "pending":
-                                # bytes/error/extern/missing: get() will
+                            if got is not None and got["k"] == "bytes":
+                                # Keep the fetched value: later poll
+                                # rounds hit the cache, and the get() is
+                                # free (no refetch of big payloads).
+                                value, _ = ser.unpack(
+                                    got["m"], memoryview(got["d"]))
+                                self._cache_value(r.id, value)
+                                ready_bin.add(r.id.binary())
+                            elif got is None or got["k"] != "pending":
+                                # error/extern/missing: get() will
                                 # resolve (or raise) promptly => ready.
                                 ready_bin.add(r.id.binary())
                         elif e is None or e.state == EXTERN:
@@ -1250,21 +1274,28 @@ class CoreWorker:
             if size <= INLINE_OBJECT_THRESHOLD:
                 contained = None
                 if s.contained_refs and self.ctx.direct_exec:
-                    # Contained-ref handover (reference_count.h:543): hold
-                    # a `ret:` pin on each nested ref at its owner until
-                    # the caller registers its own `res:` pin (_on_done).
+                    # Contained-ref handover (reference_count.h:543): for
+                    # SELF-owned refs, hold a `ret:` pin locally until the
+                    # caller registers its `res:` pin (_on_done) — the pin
+                    # is set before the done ships, so it cannot race.
+                    # Refs this worker merely BORROWS are listed without a
+                    # pre-pin: a remote `ret:` pin rides a different
+                    # channel than the done and could arrive after the
+                    # caller's unpin (leaking), so the caller just
+                    # registers its `res:` pin promptly and the borrow
+                    # chain's own pins cover the (small) window.
                     token = b"ret:" + spec.task_id.binary()
                     contained = []
                     for coid in s.contained_refs:
                         if self._owned.contains(coid):
                             self._owned.pin(coid, token)
                             contained.append((coid.binary(),
-                                              self.direct_addr))
+                                              self.direct_addr, True))
                         else:
                             owner = s.contained_owners.get(coid.binary())
                             if owner is not None and self._direct is not None:
-                                self._direct.pin_at_owner(coid, owner, token)
-                                contained.append((coid.binary(), owner))
+                                contained.append((coid.binary(), owner,
+                                                  False))
                 elif s.contained_refs:
                     # Classic-path result: no handover protocol runs, so
                     # nested owner-resident refs must outlive this worker's

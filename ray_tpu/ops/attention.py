@@ -31,11 +31,12 @@ def mha_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     XLA path wins because attention is a small FLOP fraction there and
     the d<128 lane padding around the custom call costs more than the
     [L, L] materialization it avoids."""
+    b, lq, h, _ = q.shape
+    lk = k.shape[1]
+    # [B, H, Lq, Lk] score-matrix footprint the XLA path materializes
+    # (also used by the fallback warning below for explicit use_flash).
+    score_bytes = b * h * lq * lk * q.dtype.itemsize
     if use_flash is None:
-        b, lq, h, _ = q.shape
-        lk = k.shape[1]
-        # [B, H, Lq, Lk] score-matrix footprint the XLA path materializes.
-        score_bytes = b * h * lq * lk * q.dtype.itemsize
         use_flash = (jax.default_backend() not in ("cpu",)
                      and lq % 128 == 0 and lk % 128 == 0
                      # Speed crossover is ~2k ctx (below it the XLA path
@@ -51,8 +52,20 @@ def mha_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if use_flash:
         try:
             return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
-        except Exception:
-            pass  # fall back to the XLA path (e.g. interpreter platforms)
+        except Exception as e:
+            if score_bytes > 512 * 1024 * 1024:
+                # Dispatch chose flash BECAUSE the XLA score matrix would
+                # likely OOM: falling back silently would surface as an
+                # opaque HBM OOM (or a silent 10x slowdown) instead of the
+                # real kernel failure — make the cause visible first.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "flash attention kernel failed (%s: %s); falling back "
+                    "to the XLA path, which needs a ~%dMB score matrix and "
+                    "may OOM", type(e).__name__, e,
+                    score_bytes // (1024 * 1024))
+            # Fall back to the XLA path (e.g. interpreter platforms).
     return _xla_attention(q, k, v, causal, sm_scale)
 
 

@@ -251,20 +251,33 @@ class TD3(Algorithm):
             "on-device; the actor-path sampling stack serves PPO/IMPALA)")
 
     def save_checkpoint(self):
+        """Full training state: params + BOTH optimizer moment trees +
+        update_count (the policy-delay phase).  Replay contents stay
+        excluded by design — a resumed run restarts collection, which is
+        documented resume behavior (fresh transitions under the restored
+        policy), not silent state loss."""
         from ray_tpu.air.checkpoint import Checkpoint
 
         s = self._anakin_state
         return Checkpoint.from_pytree(
             {"pi": s.pi_params, "pi_target": s.pi_target,
-             "q": s.q_params, "q_target": s.q_target},
+             "q": s.q_params, "q_target": s.q_target,
+             "pi_opt": s.pi_opt, "q_opt": s.q_opt,
+             "update_count": s.update_count},
             extra={"iteration": self.iteration})
 
     def load_checkpoint(self, checkpoint):
         tree = checkpoint.to_pytree()
         self.iteration = checkpoint.extra().get("iteration", 0)
-        self._anakin_state = self._anakin_state._replace(
+        s = self._anakin_state
+        self._anakin_state = s._replace(
             pi_params=tree["pi"], pi_target=tree["pi_target"],
-            q_params=tree["q"], q_target=tree["q_target"])
+            q_params=tree["q"], q_target=tree["q_target"],
+            # Older checkpoints (pre r4) lack optimizer state: keep the
+            # freshly-initialized moments rather than failing the restore.
+            pi_opt=tree.get("pi_opt", s.pi_opt),
+            q_opt=tree.get("q_opt", s.q_opt),
+            update_count=tree.get("update_count", s.update_count))
 
 
 class DDPG(TD3):

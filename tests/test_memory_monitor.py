@@ -142,3 +142,72 @@ def test_host_memory_reader_sane():
 
     frac = host_memory_usage_fraction()
     assert 0.0 <= frac <= 1.0
+
+
+def test_actor_killed_as_last_resort(pressure_cluster):
+    """A host whose pressure comes entirely from actors still gets relief:
+    actors become kill candidates once no task workers exist (advisor r3;
+    the FSM restart path rebuilds the actor afterwards)."""
+    gauge = pressure_cluster
+
+    @ray_tpu.remote(max_restarts=1)
+    class Hog:
+        def ping(self):
+            return "up"
+
+    h = Hog.remote()
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == "up"
+    gauge.write_text("0.99")
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if ray_tpu._head.memory_monitor.kill_count >= 1:
+            break
+        time.sleep(0.2)
+    assert ray_tpu._head.memory_monitor.kill_count >= 1
+    gauge.write_text("0.1")
+    # The actor restarts and serves again.
+    assert ray_tpu.get(h.ping.remote(), timeout=60) == "up"
+
+
+def test_remote_agent_relieves_own_pressure(tmp_path, monkeypatch):
+    """Remote nodes run their own memory monitor in the node agent
+    (advisor r3): under injected pressure the agent kills a child worker
+    instead of leaving the host to the kernel OOM-killer."""
+    gauge = tmp_path / "agent_mem"
+    gauge.write_text("0.1")
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_TEST_FILE", str(gauge))
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_REFRESH_MS", "100")
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.9")
+    monkeypatch.setenv("RAY_TPU_TCP_HOST", "127.0.0.1")
+    from ray_tpu._private.config import CONFIG
+
+    CONFIG.reset()
+    ray_tpu.init(num_cpus=0, object_store_memory=64 * 1024**2)
+    try:
+        from ray_tpu.util.testing import remote_node_agents
+
+        with remote_node_agents(ray_tpu._head, n=1, num_cpus=2):
+            # Head host has 0 CPUs: the task must land on the agent node.
+            @ray_tpu.remote(max_retries=2)
+            def slow(marker_path, gauge_path):
+                import os
+                import time as _t
+
+                if not os.path.exists(marker_path):
+                    open(marker_path, "w").write("1")
+                    _t.sleep(120)  # first attempt hangs under pressure
+                open(gauge_path, "w").write("0.1")
+                return "survived"
+
+            marker = tmp_path / "attempt"
+            ref = slow.remote(str(marker), str(gauge))
+            deadline = time.time() + 60
+            while time.time() < deadline and not marker.exists():
+                time.sleep(0.2)
+            assert marker.exists(), "task never started on the agent"
+            time.sleep(0.3)
+            gauge.write_text("0.99")  # agent's monitor kills the worker
+            assert ray_tpu.get(ref, timeout=90) == "survived"
+    finally:
+        ray_tpu.shutdown()
+        CONFIG.reset()
