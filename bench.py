@@ -1,10 +1,12 @@
 """Headline benchmarks, one JSON line on stdout.
 
-1. **Atari-class PPO** (headline metric): Anakin PPO on the pixel Breakout
-   env (10x10x4 board -> CNN trunk) — env dynamics, rollout, GAE and the
-   SGD epochs all inside one jitted step on the local accelerator.  The
-   bench first *trains to a reward floor* (learning is gated, not
-   asserted), then measures steady-state env-steps/s.
+1. **Atari-resolution PPO** (headline metric): Anakin PPO on Breakout at
+   TRUE Atari input size (84x84x4 uint8 frames -> Nature CNN) — env
+   dynamics, rendering, rollout, GAE and the SGD epochs all inside one
+   jitted step on the local accelerator.  The bench first *trains to a
+   reward floor* (learning is gated, not asserted), then measures
+   steady-state env-steps/s.  The MinAtar-scale Breakout from r2/r3 is
+   kept as a secondary key (ppo_minatar_*).
    Baseline (BASELINE.md north star): PPO Atari >= 1,000,000 env-steps/s on
    a TPU v4-32 pod (16 chips) => 62,500 env-steps/s/chip; vs_baseline is
    per-chip throughput over that per-chip share.
@@ -20,6 +22,9 @@ import os
 import time
 
 BREAKOUT_REWARD_FLOOR = 3.0
+# 84x84 Breakout floor: random ~0.13/episode; training crosses 15 by
+# ~iter 30 at 2048 envs and plateaus 30-55 (measured on v5e).
+ATARI84_REWARD_FLOOR = 15.0
 
 # Per-chip peak bf16 FLOP/s by device kind substring (public spec sheets).
 PEAK_FLOPS = {
@@ -169,7 +174,62 @@ def bench_gpt2() -> dict:
         rt.shutdown()
 
 
+def bench_ppo_atari84() -> dict:
+    """PRIMARY RL headline (VERDICT r3 #3): PPO on Breakout at TRUE Atari
+    resolution — 84x84x4 frames through the Nature CNN, the same per-frame
+    network work as the reference's atari-ppo.yaml (84x84 wrap + 4-stack).
+    vs_baseline divides by the north star's per-chip share (1M env-steps/s
+    on a v4-32 pod => 62.5k/chip) and is now apples-to-apples on input
+    pixels."""
+    import jax
+
+    from ray_tpu.rllib import PPOConfig
+
+    num_devices = max(1, len(jax.devices()))
+    # 2048 envs: the uint8 rollout buffer (2048x64 frames) + Nature-CNN
+    # activations fit a 16G v5e; 4096 exceeds HBM by ~2G (measured).
+    num_envs, unroll = 2048, 64
+    algo = (
+        PPOConfig()
+        .environment("Breakout-Atari84-v0")
+        .anakin(num_envs=num_envs, unroll_length=unroll)
+        .training(num_sgd_iter=2, sgd_minibatch_size=8192, lr=5e-4,
+                  entropy_coeff=0.01)
+        .debugging(seed=0)
+        .build()
+    )
+    floor = ATARI84_REWARD_FLOOR
+    floor_met, reward, best = _learn_to_floor(algo, floor, max_iters=150)
+    out = {
+        "metric": "ppo_atari84_env_steps_per_sec",
+        "unit": "env_steps/s",
+        "episode_reward_mean": round(reward, 2),
+        "reward_floor": floor,
+        "reward_floor_met": floor_met,
+        "num_devices": num_devices,
+        "env_note": "Breakout-Atari84 84x84x4 uint8 frames + NatureCNN "
+                    "(same input pixels/net as ALE Breakout); random "
+                    "policy scores ~0.13/episode",
+    }
+    if not floor_met:
+        out.update({"value": 0, "vs_baseline": 0.0,
+                    "best_reward": round(best, 2)})
+        return out
+    steps_per_s, last_reward = _measure_steps_per_s(algo,
+                                                    num_envs * unroll)
+    if last_reward == last_reward:
+        reward = last_reward
+    out.update({
+        "value": round(steps_per_s),
+        "vs_baseline": round(steps_per_s / num_devices / 62500.0, 2),
+        "episode_reward_mean": round(reward, 2),
+    })
+    return out
+
+
 def bench_ppo_breakout() -> dict:
+    """Secondary RL key: the MinAtar-scale pixel env (kept from r2/r3 for
+    continuity; the 84x84 bench above is the headline)."""
     import jax
 
     from ray_tpu.rllib import PPOConfig
@@ -194,35 +254,18 @@ def bench_ppo_breakout() -> dict:
     floor_met, reward, best = _learn_to_floor(algo, BREAKOUT_REWARD_FLOOR,
                                               max_iters=150)
     out = {
-        "metric": "ppo_breakout_pixels_env_steps_per_sec",
-        "unit": "env_steps/s",
-        "episode_reward_mean": round(reward, 2),
-        "reward_floor": BREAKOUT_REWARD_FLOOR,
-        "reward_floor_met": floor_met,
-        "num_devices": num_devices,
+        "ppo_minatar_reward": round(reward, 2),
+        "ppo_minatar_reward_floor": BREAKOUT_REWARD_FLOOR,
+        "ppo_minatar_reward_floor_met": floor_met,
     }
     if not floor_met:
-        out.update({"value": 0, "vs_baseline": 0.0,
-                    "best_reward": round(best, 2)})
+        out["ppo_minatar_best_reward"] = round(best, 2)
         return out
-    # Measure phase (only reached with the floor passed): steady-state
-    # throughput of the exact config that just learned.
     steps_per_s, last_reward = _measure_steps_per_s(algo,
                                                     num_envs * unroll)
     if last_reward == last_reward:
-        reward = last_reward
-    out.update({
-        "value": round(steps_per_s),
-        "vs_baseline": round(steps_per_s / num_devices / 62500.0, 2),
-        "episode_reward_mean": round(reward, 2),
-        # Honesty note carried in the artifact: the env is MinAtar-scale
-        # (10x10x4 board), not 84x84x4 ALE frames, while the baseline
-        # denominator is the reference's real-Atari per-chip share — the
-        # ratio overstates headroom by the pixel-count gap.
-        "env_note": "Breakout-MinAtar 10x10x4 (≈78x fewer input pixels "
-                    "than ALE 84x84x4); vs_baseline divides by the "
-                    "real-Atari per-chip target",
-    })
+        out["ppo_minatar_reward"] = round(last_reward, 2)
+    out["ppo_minatar_env_steps_per_s"] = round(steps_per_s)
     return out
 
 
@@ -292,6 +335,7 @@ def main():
     out = bench_gpt2()
     out.update(bench_impala_breakout())
     out.update(bench_ppo_breakout())
+    out.update(bench_ppo_atari84())  # last: the headline metric keys
     print(json.dumps(out))
 
 

@@ -951,6 +951,8 @@ class DirectSubmitter:
                 self._inflight.pop(tid, None)
                 if inf.lease is not None and resub:
                     inf.lease.inflight -= 1  # the push we just failed
+                if inf.actor is not None:
+                    inf.actor.inflight.pop(tid, None)
             self._reroute_classic(spec, actor=inf.actor is not None,
                                   inf=inf)
             return

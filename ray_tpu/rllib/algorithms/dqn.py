@@ -99,6 +99,79 @@ def make_replay_state(buffer_size: int, n_insert: int, obs_dim: int,
     )
 
 
+class HostReplay:
+    """Learner-owned host (numpy) replay ring for the actor topology —
+    the rollout actors stream raw transitions in, the learner samples
+    stacked minibatches out (reference: the learner-side replay in ApexDQN,
+    rllib/execution/multi_gpu_learner_thread.py:187)."""
+
+    def __init__(self, capacity: int, obs_dim: int,
+                 action_shape: Tuple[int, ...] = (), action_dtype=None):
+        import numpy as np
+
+        self.cols = {
+            "obs": np.zeros((capacity, obs_dim), np.float32),
+            "actions": np.zeros((capacity,) + tuple(action_shape),
+                                action_dtype or np.int64),
+            "rewards": np.zeros((capacity,), np.float32),
+            "next_obs": np.zeros((capacity, obs_dim), np.float32),
+            "dones": np.zeros((capacity,), np.float32),
+        }
+        self.capacity = capacity
+        self.pos = 0
+        self.size = 0
+
+    def insert(self, batch):
+        import numpy as np
+
+        n = len(batch["rewards"])
+        idx = (self.pos + np.arange(n)) % self.capacity
+        for k, col in self.cols.items():
+            self.cols[k][idx] = np.asarray(batch[k]).reshape(
+                (n,) + col.shape[1:])
+        self.pos = int((self.pos + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample_stacked(self, rng, num_batches: int, batch_size: int):
+        """[U, B, ...] stacked minibatches as device arrays — one device
+        round trip feeds a whole lax.scan of updates."""
+        idx = rng.integers(0, self.size, size=(num_batches, batch_size))
+        return {k: jnp.asarray(col[idx]) for k, col in self.cols.items()}
+
+
+def run_actor_replay_iter(algo, explore_arg, batch_size, do_updates):
+    """ONE shared actor-topology iteration for the replay family
+    (DQN/SAC/TD3): harvest transitions from the rollout actors, feed the
+    learner-owned host replay, run the algorithm's updates once warm, and
+    assemble the common metrics (reward EMA, worker health)."""
+    import numpy as np
+
+    cfg = algo.config
+    batches, returns = algo.workers.sample_sync(explore_arg)
+    for b in batches:
+        algo._rb.insert(b)
+        algo._env_steps += len(b["rewards"])
+    metrics = {"replay_size": algo._rb.size}
+    if returns:
+        mean_r = float(np.mean(returns))
+        prev = getattr(algo, "_ep_reward_ema", None)
+        algo._ep_reward_ema = (mean_r if prev is None
+                               else 0.7 * prev + 0.3 * mean_r)
+        metrics["episodes_this_iter"] = len(returns)
+    if getattr(algo, "_ep_reward_ema", None) is not None:
+        metrics["episode_reward_mean"] = algo._ep_reward_ema
+    if algo._rb.size >= cfg.learning_starts:
+        U = cfg.num_updates_per_iter
+        stacked = algo._rb.sample_stacked(algo._host_rng, U, batch_size)
+        keys = jax.random.split(jax.random.PRNGKey(algo._env_steps), U)
+        metrics.update(do_updates(stacked, keys))
+        algo.workers.sync_weights(jax.device_get(algo._sync_params()))
+    metrics["num_env_steps_sampled_this_iter"] = sum(
+        len(b["rewards"]) for b in batches)
+    metrics["num_healthy_workers"] = algo.workers.num_healthy_workers
+    return metrics
+
+
 def make_offpolicy_rollout(env, act_fn):
     """Shared env-interaction scan body for the replay-family algorithms
     (SAC, TD3/DDPG): `act_fn(params, obs, key) -> action` is the only
@@ -292,8 +365,130 @@ class DQN(Algorithm):
         metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
         return metrics
 
+    # ---------------- actor mode (Ape-X shape) ----------------
+    # CPU rollout actors collect raw transitions from non-jittable (gym)
+    # envs into a learner-owned host replay buffer; the learner samples
+    # minibatches and runs the SAME jitted TD update as the anakin path.
+    # Reference: ApexDQN's replay-actor architecture + the learner-thread
+    # consumer (rllib/execution/multi_gpu_learner_thread.py:20,187).
     def _setup_actor_mode(self):
-        raise NotImplementedError(
-            "DQN ships anakin-mode only; use mode='anakin' (the actor-path "
-            "replay pipeline is PPO/IMPALA's sampling stack and does not "
-            "apply to off-policy replay)")
+        import cloudpickle
+
+        from ray_tpu.rllib.env.py_envs import make_py_env
+        from ray_tpu.rllib.evaluation.worker_set import (
+            OffPolicyRolloutWorker,
+            WorkerSet,
+        )
+
+        cfg = self.config
+        probe = make_py_env(cfg.env)
+        obs_dim, num_actions = probe.obs_dim, probe.num_actions
+        net = QNetwork(obs_dim, num_actions, tuple(cfg.hiddens))
+        self.module = net
+        rng = jax.random.PRNGKey(cfg.seed)
+        self._params = net.init(rng, jnp.zeros((1, obs_dim)))
+        self._target_params = self._params
+        tx_parts = []
+        if cfg.grad_clip:
+            tx_parts.append(optax.clip_by_global_norm(cfg.grad_clip))
+        tx_parts.append(optax.adam(cfg.lr))
+        self._tx = tx = optax.chain(*tx_parts)
+        self._opt_state = tx.init(self._params)
+        self._rng = rng
+        self._env_steps = 0
+        self._rb = HostReplay(cfg.buffer_size, obs_dim)
+        self._host_rng = __import__("numpy").random.default_rng(cfg.seed)
+
+        hiddens = tuple(cfg.hiddens)
+
+        def act_factory():
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            from ray_tpu.rllib.algorithms.dqn import QNetwork as _QNet
+
+            anet = _QNet(obs_dim, num_actions, hiddens)
+
+            def act(params, obs, key, epsilon):
+                q = anet.apply(params, obs)
+                greedy = _jnp.argmax(q, axis=-1)
+                k1, k2 = _jax.random.split(key)
+                rand_a = _jax.random.randint(k1, greedy.shape, 0,
+                                             num_actions)
+                explore = _jax.random.uniform(k2, greedy.shape) < epsilon
+                return _jnp.where(explore, rand_a, greedy)
+
+            return act
+
+        blob = cloudpickle.dumps(act_factory)
+
+        def factory(i):
+            return OffPolicyRolloutWorker.options(max_restarts=1).remote(
+                cfg.env, blob, i, cfg.num_envs_per_worker,
+                cfg.rollout_fragment_length, cfg.seed)
+
+        self.workers = WorkerSet(cfg, None, worker_factory=factory)
+        self.workers.sync_weights(jax.device_get(self._params))
+
+        def td_loss(params, target_params, batch):
+            q = net.apply(params, batch["obs"])
+            q_sa = jnp.take_along_axis(q, batch["actions"][:, None], 1)[:, 0]
+            q_next_target = net.apply(target_params, batch["next_obs"])
+            if cfg.double_q:
+                q_next_online = net.apply(params, batch["next_obs"])
+                next_a = jnp.argmax(q_next_online, axis=-1)
+                q_next = jnp.take_along_axis(q_next_target, next_a[:, None],
+                                             1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_target, axis=-1)
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) \
+                * jax.lax.stop_gradient(q_next)
+            td = q_sa - jax.lax.stop_gradient(target)
+            return jnp.mean(optax.huber_loss(td)), jnp.mean(jnp.abs(td))
+
+        def update_many(params, target_params, opt_state, batches):
+            """lax.scan over [U, B, ...] stacked minibatches — one device
+            round trip per training iteration."""
+            def one(carry, batch):
+                params, target_params, opt_state = carry
+                (loss, td_abs), grads = jax.value_and_grad(
+                    td_loss, has_aux=True)(params, target_params, batch)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                tau = cfg.target_network_tau
+                target_params = jax.tree_util.tree_map(
+                    lambda t, p: (1 - tau) * t + tau * p, target_params,
+                    params)
+                return (params, target_params, opt_state), (loss, td_abs)
+
+            (params, target_params, opt_state), (losses, tds) = \
+                jax.lax.scan(one, (params, target_params, opt_state),
+                             batches)
+            return params, target_params, opt_state, losses, tds
+
+        self._update_many = jax.jit(update_many)
+
+    def _epsilon_now(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def _sync_params(self):
+        return self._params
+
+    def _training_step_actor(self):
+        eps = self._epsilon_now()
+
+        def do_updates(stacked, _keys):
+            (self._params, self._target_params, self._opt_state, losses,
+             tds) = self._update_many(self._params, self._target_params,
+                                      self._opt_state, stacked)
+            return {"total_loss": float(losses.mean()),
+                    "td_error_abs": float(tds.mean())}
+
+        metrics = run_actor_replay_iter(self, eps,
+                                        self.config.dqn_batch_size,
+                                        do_updates)
+        metrics["epsilon"] = eps
+        return metrics

@@ -119,6 +119,33 @@ class SACState(NamedTuple):
     done_count: jax.Array
 
 
+def make_sac_losses(pi, q, config, target_entropy):
+    """The three SAC losses over an explicit minibatch — shared by the
+    anakin path (replay-state batches) and the actor path (host-sampled
+    batches), so the math exists once."""
+    def q_loss(q_params, q_target, pi_params, log_alpha, batch, key):
+        next_a, next_logp = pi.sample(pi_params, batch["next_obs"], key)
+        tq1, tq2 = q.apply(q_target, batch["next_obs"], next_a)
+        alpha = jnp.exp(log_alpha)
+        target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+        target = batch["rewards"] + config.gamma * (1 - batch["dones"]) \
+            * jax.lax.stop_gradient(target_v)
+        q1, q2 = q.apply(q_params, batch["obs"], batch["actions"])
+        return jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
+
+    def pi_loss(pi_params, q_params, log_alpha, batch, key):
+        a, logp = pi.sample(pi_params, batch["obs"], key)
+        q1, q2 = q.apply(q_params, batch["obs"], a)
+        alpha = jnp.exp(log_alpha)
+        return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+    def alpha_loss(log_alpha, logp):
+        return -jnp.mean(log_alpha
+                         * jax.lax.stop_gradient(logp + target_entropy))
+
+    return q_loss, pi_loss, alpha_loss
+
+
 def make_anakin_sac(config: SACConfig):
     env = make_jax_env(config.env) if isinstance(config.env, str) \
         else config.env
@@ -164,25 +191,8 @@ def make_anakin_sac(config: SACConfig):
     rollout_step = make_offpolicy_rollout(
         env, lambda p, obs, key: pi.sample(p, obs, key)[0])
 
-    def q_loss(q_params, q_target, pi_params, log_alpha, batch, key):
-        next_a, next_logp = pi.sample(pi_params, batch["next_obs"], key)
-        tq1, tq2 = q.apply(q_target, batch["next_obs"], next_a)
-        alpha = jnp.exp(log_alpha)
-        target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
-        target = batch["rewards"] + config.gamma * (1 - batch["dones"]) \
-            * jax.lax.stop_gradient(target_v)
-        q1, q2 = q.apply(q_params, batch["obs"], batch["actions"])
-        return jnp.mean((q1 - target) ** 2) + jnp.mean((q2 - target) ** 2)
-
-    def pi_loss(pi_params, q_params, log_alpha, batch, key):
-        a, logp = pi.sample(pi_params, batch["obs"], key)
-        q1, q2 = q.apply(q_params, batch["obs"], a)
-        alpha = jnp.exp(log_alpha)
-        return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
-
-    def alpha_loss(log_alpha, logp):
-        return -jnp.mean(log_alpha
-                         * jax.lax.stop_gradient(logp + target_entropy))
+    q_loss, pi_loss, alpha_loss = make_sac_losses(pi, q, config,
+                                                  target_entropy)
 
     def train_step(state: SACState) -> Tuple[SACState, Dict[str, jax.Array]]:
         carry = (state.pi_params, state.env_states, state.obs, state.rng,
@@ -262,10 +272,147 @@ class SAC(Algorithm):
         metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
         return metrics
 
+    # -------- actor mode: CPU rollout actors -> host replay -> learner
+    # (the Ape-X topology; reference: multi_gpu_learner_thread.py:20) ----
     def _setup_actor_mode(self):
-        raise NotImplementedError(
-            "SAC ships anakin-mode only (off-policy replay is on-device; "
-            "the actor-path sampling stack serves PPO/IMPALA)")
+        import cloudpickle
+        import numpy as np
+
+        from ray_tpu.rllib.algorithms.dqn import HostReplay
+        from ray_tpu.rllib.env.py_envs import make_py_env
+        from ray_tpu.rllib.evaluation.worker_set import (
+            OffPolicyRolloutWorker,
+            WorkerSet,
+        )
+
+        cfg = self.config
+        probe = make_py_env(cfg.env)
+        adim = getattr(probe, "action_dim", None)
+        if adim is None:
+            raise ValueError(
+                f"SAC needs a continuous (Box) action env; {cfg.env!r} "
+                "is discrete")
+        obs_dim = probe.obs_dim
+        low = jnp.asarray(probe.action_low, jnp.float32)
+        high = jnp.asarray(probe.action_high, jnp.float32)
+        pi = SquashedGaussianPolicy(obs_dim, adim, cfg.hiddens, low, high)
+        q = TwinQ(cfg.hiddens)
+        self.module = pi
+        target_entropy = (-float(adim) if cfg.target_entropy == "auto"
+                          else float(cfg.target_entropy))
+        rng = jax.random.PRNGKey(cfg.seed)
+        k_pi, k_q = jax.random.split(rng)
+        z = jnp.zeros((1, obs_dim))
+        self._pi_params = pi.init(k_pi, z)
+        self._q_params = q.init(k_q, z, jnp.zeros((1, adim)))
+        self._q_target = self._q_params
+        self._log_alpha = jnp.log(jnp.asarray(cfg.initial_alpha,
+                                              jnp.float32))
+
+        def make_tx():
+            parts = []
+            if cfg.grad_clip:
+                parts.append(optax.clip_by_global_norm(cfg.grad_clip))
+            parts.append(optax.adam(cfg.lr))
+            return optax.chain(*parts)
+
+        pi_tx, q_tx, a_tx = make_tx(), make_tx(), make_tx()
+        self._pi_opt = pi_tx.init(self._pi_params)
+        self._q_opt = q_tx.init(self._q_params)
+        self._a_opt = a_tx.init(self._log_alpha)
+        self._env_steps = 0
+        self._rb = HostReplay(cfg.buffer_size, obs_dim,
+                              action_shape=(adim,),
+                              action_dtype=np.float32)
+
+        hiddens = tuple(cfg.hiddens)
+        low_l = np.asarray(probe.action_low).tolist()
+        high_l = np.asarray(probe.action_high).tolist()
+
+        def act_factory():
+            import jax.numpy as _jnp
+
+            from ray_tpu.rllib.algorithms.sac import (
+                SquashedGaussianPolicy as _Pi,
+            )
+
+            apol = _Pi(obs_dim, adim, hiddens,
+                       _jnp.asarray(low_l, _jnp.float32),
+                       _jnp.asarray(high_l, _jnp.float32))
+
+            def act(params, obs, key, _unused):
+                return apol.sample(params, obs, key)[0]
+
+            return act
+
+        blob = cloudpickle.dumps(act_factory)
+
+        def factory(i):
+            return OffPolicyRolloutWorker.options(max_restarts=1).remote(
+                cfg.env, blob, i, cfg.num_envs_per_worker,
+                cfg.rollout_fragment_length, cfg.seed)
+
+        self.workers = WorkerSet(cfg, None, worker_factory=factory)
+        self.workers.sync_weights(jax.device_get(self._pi_params))
+
+        q_loss, pi_loss, alpha_loss = make_sac_losses(pi, q, cfg,
+                                                      target_entropy)
+
+        def update_many(pi_params, q_params, q_target, log_alpha, pi_opt,
+                        q_opt, a_opt, batches, keys):
+            def one(carry, xs):
+                (pi_params, q_params, q_target, log_alpha, pi_opt, q_opt,
+                 a_opt) = carry
+                batch, key = xs
+                k_q, k_pi = jax.random.split(key)
+                ql, q_grads = jax.value_and_grad(q_loss)(
+                    q_params, q_target, pi_params, log_alpha, batch, k_q)
+                qu, q_opt = q_tx.update(q_grads, q_opt)
+                q_params = optax.apply_updates(q_params, qu)
+                (pl, logp), pi_grads = jax.value_and_grad(
+                    pi_loss, has_aux=True)(pi_params, q_params, log_alpha,
+                                           batch, k_pi)
+                pu, pi_opt = pi_tx.update(pi_grads, pi_opt)
+                pi_params = optax.apply_updates(pi_params, pu)
+                al, a_grad = jax.value_and_grad(alpha_loss)(log_alpha, logp)
+                au, a_opt = a_tx.update(a_grad, a_opt)
+                log_alpha = optax.apply_updates(log_alpha, au)
+                tau = cfg.tau
+                q_target = jax.tree_util.tree_map(
+                    lambda t, p_: (1 - tau) * t + tau * p_, q_target,
+                    q_params)
+                return (pi_params, q_params, q_target, log_alpha, pi_opt,
+                        q_opt, a_opt), (ql, pl, al)
+
+            carry = (pi_params, q_params, q_target, log_alpha, pi_opt,
+                     q_opt, a_opt)
+            carry, (qls, pls, als) = jax.lax.scan(one, carry,
+                                                  (batches, keys))
+            return carry + (qls, pls, als)
+
+        self._update_many = jax.jit(update_many)
+        self._host_rng = np.random.default_rng(cfg.seed)
+
+    def _sync_params(self):
+        return self._pi_params
+
+    def _training_step_actor(self):
+        from ray_tpu.rllib.algorithms.dqn import run_actor_replay_iter
+
+        def do_updates(stacked, keys):
+            (self._pi_params, self._q_params, self._q_target,
+             self._log_alpha, self._pi_opt, self._q_opt, self._a_opt,
+             qls, pls, als) = self._update_many(
+                self._pi_params, self._q_params, self._q_target,
+                self._log_alpha, self._pi_opt, self._q_opt, self._a_opt,
+                stacked, keys)
+            return {"critic_loss": float(qls.mean()),
+                    "actor_loss": float(pls.mean()),
+                    "alpha": float(jnp.exp(self._log_alpha))}
+
+        return run_actor_replay_iter(self, 0.0,
+                                     self.config.sac_batch_size,
+                                     do_updates)
 
 
     # SACState has multiple param trees — override the Trainable protocol's

@@ -96,6 +96,38 @@ class TD3State(NamedTuple):
     done_count: jax.Array
 
 
+def make_td3_losses(pi, q, config, scale, low, high):
+    """TD3's two losses over an explicit minibatch — shared by the anakin
+    and actor paths so the Bellman-target math exists once."""
+    def q_loss(q_params, q_target, pi_target, batch, key):
+        next_a = pi.apply(pi_target, batch["next_obs"])
+        if config.smooth_target_policy:
+            # Target policy smoothing (TD3 trick #3): clipped noise on the
+            # target action regularizes the critic against sharp Q peaks.
+            eps = jnp.clip(
+                config.target_noise * scale
+                * jax.random.normal(key, next_a.shape),
+                -config.target_noise_clip * scale,
+                config.target_noise_clip * scale)
+            next_a = jnp.clip(next_a + eps, low, high)
+        tq1, tq2 = q.apply(q_target, batch["next_obs"], next_a)
+        target_v = jnp.minimum(tq1, tq2) if config.twin_q else tq1
+        target = batch["rewards"] + config.gamma * (1 - batch["dones"]) \
+            * jax.lax.stop_gradient(target_v)
+        q1, q2 = q.apply(q_params, batch["obs"], batch["actions"])
+        loss = jnp.mean((q1 - target) ** 2)
+        if config.twin_q:
+            loss = loss + jnp.mean((q2 - target) ** 2)
+        return loss
+
+    def pi_loss(pi_params, q_params, batch):
+        a = pi.apply(pi_params, batch["obs"])
+        q1, _ = q.apply(q_params, batch["obs"], a)
+        return -jnp.mean(q1)
+
+    return q_loss, pi_loss
+
+
 def make_anakin_td3(config: TD3Config):
     env = make_jax_env(config.env) if isinstance(config.env, str) \
         else config.env
@@ -139,31 +171,7 @@ def make_anakin_td3(config: TD3Config):
 
     rollout_step = make_offpolicy_rollout(env, explore)
 
-    def q_loss(q_params, q_target, pi_target, batch, key):
-        next_a = pi.apply(pi_target, batch["next_obs"])
-        if config.smooth_target_policy:
-            # Target policy smoothing (TD3 trick #3): clipped noise on the
-            # target action regularizes the critic against sharp Q peaks.
-            eps = jnp.clip(
-                config.target_noise * scale
-                * jax.random.normal(key, next_a.shape),
-                -config.target_noise_clip * scale,
-                config.target_noise_clip * scale)
-            next_a = jnp.clip(next_a + eps, low, high)
-        tq1, tq2 = q.apply(q_target, batch["next_obs"], next_a)
-        target_v = jnp.minimum(tq1, tq2) if config.twin_q else tq1
-        target = batch["rewards"] + config.gamma * (1 - batch["dones"]) \
-            * jax.lax.stop_gradient(target_v)
-        q1, q2 = q.apply(q_params, batch["obs"], batch["actions"])
-        loss = jnp.mean((q1 - target) ** 2)
-        if config.twin_q:
-            loss = loss + jnp.mean((q2 - target) ** 2)
-        return loss
-
-    def pi_loss(pi_params, q_params, batch):
-        a = pi.apply(pi_params, batch["obs"])
-        q1, _ = q.apply(q_params, batch["obs"], a)
-        return -jnp.mean(q1)
+    q_loss, pi_loss = make_td3_losses(pi, q, config, scale, low, high)
 
     def train_step(state: TD3State) -> Tuple[TD3State, Dict[str, jax.Array]]:
         carry = (state.pi_params, state.env_states, state.obs, state.rng,
@@ -245,10 +253,151 @@ class TD3(Algorithm):
         metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
         return metrics
 
+    # -------- actor mode (Ape-X topology; see dqn.py/sac.py) --------
     def _setup_actor_mode(self):
-        raise NotImplementedError(
-            "TD3/DDPG ship anakin-mode only (off-policy replay is "
-            "on-device; the actor-path sampling stack serves PPO/IMPALA)")
+        import cloudpickle
+        import numpy as np
+
+        from ray_tpu.rllib.algorithms.dqn import HostReplay
+        from ray_tpu.rllib.env.py_envs import make_py_env
+        from ray_tpu.rllib.evaluation.worker_set import (
+            OffPolicyRolloutWorker,
+            WorkerSet,
+        )
+
+        cfg = self.config
+        probe = make_py_env(cfg.env)
+        adim = getattr(probe, "action_dim", None)
+        if adim is None:
+            raise ValueError(
+                f"TD3 needs a continuous (Box) action env; {cfg.env!r} "
+                "is discrete")
+        obs_dim = probe.obs_dim
+        low = jnp.asarray(probe.action_low, jnp.float32)
+        high = jnp.asarray(probe.action_high, jnp.float32)
+        scale = (high - low) / 2.0
+        pi = DeterministicPolicy(adim, cfg.hiddens, low, high)
+        q = TwinQ(cfg.hiddens)
+        self.module = pi
+        rng = jax.random.PRNGKey(cfg.seed)
+        k_pi, k_q = jax.random.split(rng)
+        z = jnp.zeros((1, obs_dim))
+        self._pi_params = pi.init(k_pi, z)
+        self._pi_target = self._pi_params
+        self._q_params = q.init(k_q, z, jnp.zeros((1, adim)))
+        self._q_target = self._q_params
+
+        def make_tx():
+            parts = []
+            if cfg.grad_clip:
+                parts.append(optax.clip_by_global_norm(cfg.grad_clip))
+            parts.append(optax.adam(cfg.lr))
+            return optax.chain(*parts)
+
+        pi_tx, q_tx = make_tx(), make_tx()
+        self._pi_opt = pi_tx.init(self._pi_params)
+        self._q_opt = q_tx.init(self._q_params)
+        self._count = jnp.zeros((), jnp.int32)
+        self._env_steps = 0
+        self._rb = HostReplay(cfg.buffer_size, obs_dim,
+                              action_shape=(adim,),
+                              action_dtype=np.float32)
+        self._host_rng = np.random.default_rng(cfg.seed)
+
+        hiddens = tuple(cfg.hiddens)
+        low_l = np.asarray(probe.action_low).tolist()
+        high_l = np.asarray(probe.action_high).tolist()
+
+        def act_factory():
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            from ray_tpu.rllib.algorithms.td3 import (
+                DeterministicPolicy as _Pi,
+            )
+
+            lo = _jnp.asarray(low_l, _jnp.float32)
+            hi = _jnp.asarray(high_l, _jnp.float32)
+            sc = (hi - lo) / 2.0
+            apol = _Pi(adim, hiddens, lo, hi)
+
+            def act(params, obs, key, noise_scale):
+                a = apol.apply(params, obs)
+                noise = noise_scale * sc * _jax.random.normal(key, a.shape)
+                return _jnp.clip(a + noise, lo, hi)
+
+            return act
+
+        blob = cloudpickle.dumps(act_factory)
+
+        def factory(i):
+            return OffPolicyRolloutWorker.options(max_restarts=1).remote(
+                cfg.env, blob, i, cfg.num_envs_per_worker,
+                cfg.rollout_fragment_length, cfg.seed)
+
+        self.workers = WorkerSet(cfg, None, worker_factory=factory)
+        self.workers.sync_weights(jax.device_get(self._pi_params))
+
+        q_loss, pi_loss = make_td3_losses(pi, q, cfg, scale, low, high)
+
+        def update_many(pi_params, pi_target, q_params, q_target, pi_opt,
+                        q_opt, count, batches, keys):
+            def one(carry, xs):
+                (pi_params, pi_target, q_params, q_target, pi_opt, q_opt,
+                 count) = carry
+                batch, key = xs
+                ql, q_grads = jax.value_and_grad(q_loss)(
+                    q_params, q_target, pi_target, batch, key)
+                qu, q_opt = q_tx.update(q_grads, q_opt)
+                q_params = optax.apply_updates(q_params, qu)
+                pl, pi_grads = jax.value_and_grad(pi_loss)(
+                    pi_params, q_params, batch)
+                pu, new_pi_opt = pi_tx.update(pi_grads, pi_opt)
+                new_pi = optax.apply_updates(pi_params, pu)
+                apply_pi = (count % cfg.policy_delay) == 0
+                pi_params = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(apply_pi, n, o), new_pi,
+                    pi_params)
+                pi_opt = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(apply_pi, n, o), new_pi_opt,
+                    pi_opt)
+                tau = cfg.tau
+                polyak = lambda t, p_: (1 - tau) * t + tau * p_  # noqa: E731
+                q_target = jax.tree_util.tree_map(polyak, q_target,
+                                                  q_params)
+                pi_target = jax.tree_util.tree_map(
+                    lambda t, p_: jnp.where(apply_pi, polyak(t, p_), t),
+                    pi_target, pi_params)
+                return (pi_params, pi_target, q_params, q_target, pi_opt,
+                        q_opt, count + 1), (ql, pl)
+
+            carry = (pi_params, pi_target, q_params, q_target, pi_opt,
+                     q_opt, count)
+            carry, (qls, pls) = jax.lax.scan(one, carry, (batches, keys))
+            return carry + (qls, pls)
+
+        self._update_many = jax.jit(update_many)
+
+    def _sync_params(self):
+        return self._pi_params
+
+    def _training_step_actor(self):
+        from ray_tpu.rllib.algorithms.dqn import run_actor_replay_iter
+
+        def do_updates(stacked, keys):
+            (self._pi_params, self._pi_target, self._q_params,
+             self._q_target, self._pi_opt, self._q_opt, self._count,
+             qls, pls) = self._update_many(
+                self._pi_params, self._pi_target, self._q_params,
+                self._q_target, self._pi_opt, self._q_opt, self._count,
+                stacked, keys)
+            return {"critic_loss": float(qls.mean()),
+                    "actor_loss": float(pls.mean())}
+
+        return run_actor_replay_iter(self, self.config.exploration_noise,
+                                     self.config.td3_batch_size,
+                                     do_updates)
+
 
     def save_checkpoint(self):
         """Full training state: params + BOTH optimizer moment trees +

@@ -337,6 +337,122 @@ class SpaceInvaders:
         return out_state, obs, reward, done, {}
 
 
+class Breakout84:
+    """Pixel Breakout at TRUE Atari resolution: [84, 84, 4] uint8 frames —
+    the input size of the reference's Atari PPO north star
+    (rllib/tuned_examples/ppo/atari-ppo.yaml:20, 84x84 wrap + 4-stack,
+    rllib/env/wrappers/atari_wrappers.py:221).  The MinAtar-scale Breakout
+    above keeps game logic on a 10x10 board; this env plays on the native
+    84x84 pixel grid with multi-pixel sprites, so the policy network (the
+    Nature CNN trunk) does the same per-frame work as on real Atari — the
+    honest apples-to-apples benchmark input.
+
+    Geometry: an 8x2-px paddle on the bottom rows moving +-3 px/action; a
+    2x2-px ball with velocity (dx in {-2,-1,1,2}, dy in {-2,2}); a brick
+    wall of 6 rows x 12 bricks (each 3x7 px) spanning rows 12..29.
+    Channels {paddle, ball, trail, bricks} play the role of the 4-frame
+    stack (trail gives motion, like frame differencing).  Reward +1 per
+    brick; a missed ball ends the episode; a cleared wall respawns.
+    Observations are uint8 {0, 255}: a 16k-env rollout buffer must not
+    cost 4 bytes/pixel (the CNN trunk normalizes uint8 on entry).
+    Fully jittable: dynamic_update_slice sprites, jnp.where branching.
+    """
+
+    num_actions = 3
+    obs_shape = (84, 84, 4)
+    H = W = 84
+    PW = 8          # paddle width (px)
+    PADDLE_ROW = 82  # paddle occupies rows 82..83
+    BRICK_TOP = 12   # brick band rows 12..29 (6 brick-rows x 3 px)
+    BRICK_H = 3
+    BRICK_W = 7
+    max_steps = 2500
+
+    def reset(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        bx = jax.random.randint(k1, (), 8, self.W - 10).astype(jnp.int32)
+        dx = jnp.take(jnp.array([-2, -1, 1, 2], jnp.int32),
+                      jax.random.randint(k2, (), 0, 4))
+        px = jax.random.randint(k3, (), 0, self.W - self.PW).astype(jnp.int32)
+        state = {
+            "px": px,
+            "bx": bx, "by": jnp.array(40, jnp.int32),
+            "dx": dx, "dy": jnp.array(2, jnp.int32),
+            "lx": bx, "ly": jnp.array(38, jnp.int32),
+            "bricks": jnp.ones((6, 12), jnp.bool_),
+            "t": jnp.zeros((), jnp.int32),
+        }
+        return state, self._obs(state)
+
+    def _obs(self, s):
+        # Mask-based rendering (no scatter): sprites are outer products of
+        # boolean row/col bands — vectorizes onto the VPU and fuses,
+        # where per-env dynamic_update_slice scatters serialize (measured
+        # the difference at ~3x whole-pipeline throughput at 2k envs).
+        rows = jnp.arange(self.H, dtype=jnp.int32)
+        cols = jnp.arange(self.W, dtype=jnp.int32)
+        r = rows[:, None]
+        c = cols[None, :]
+
+        def sprite(top, left, h, w):
+            return ((r >= top) & (r < top + h)
+                    & (c >= left) & (c < left + w))
+
+        paddle = sprite(self.PADDLE_ROW, s["px"], 2, self.PW)
+        ball = sprite(s["by"], s["bx"], 2, 2)
+        trail = sprite(s["ly"], s["lx"], 2, 2)
+        # Brick channel: map each pixel to its brick cell and gather.
+        brow = jnp.clip((rows - self.BRICK_TOP) // self.BRICK_H, 0, 5)
+        bcol = jnp.clip(cols // self.BRICK_W, 0, 11)
+        in_band = (rows >= self.BRICK_TOP) \
+            & (rows < self.BRICK_TOP + 6 * self.BRICK_H)
+        wall = s["bricks"][brow[:, None], bcol[None, :]] & in_band[:, None]
+        stacked = jnp.stack([paddle, ball, trail, wall], axis=-1)
+        return (stacked * jnp.uint8(255)).astype(jnp.uint8)
+
+    def step(self, s, action, rng):
+        px = jnp.clip(s["px"] - 3 * (action == 1) + 3 * (action == 2),
+                      0, self.W - self.PW).astype(jnp.int32)
+        # Side walls bounce (ball is 2px wide).
+        dx = jnp.where((s["bx"] + s["dx"] < 0)
+                       | (s["bx"] + s["dx"] > self.W - 2),
+                       -s["dx"], s["dx"])
+        new_x = jnp.clip(s["bx"] + dx, 0, self.W - 2)
+        # Ceiling bounce.
+        dy = jnp.where(s["by"] + s["dy"] < 0, -s["dy"], s["dy"])
+        new_y = jnp.clip(s["by"] + dy, 0, self.H - 2)
+        # Brick collision on the landing cell.
+        in_band = (new_y >= self.BRICK_TOP) \
+            & (new_y < self.BRICK_TOP + 6 * self.BRICK_H)
+        row = jnp.clip((new_y - self.BRICK_TOP) // self.BRICK_H, 0, 5)
+        col = jnp.clip((new_x + 1) // self.BRICK_W, 0, 11)
+        hit = in_band & s["bricks"][row, col]
+        bricks = jnp.where(hit, s["bricks"].at[row, col].set(False),
+                           s["bricks"])
+        reward = jnp.where(hit, 1.0, 0.0)
+        dy = jnp.where(hit, -dy, dy)
+        new_y = jnp.where(hit, s["by"], new_y)
+        # Paddle band: catch bounces up, a miss ends the episode.
+        at_bottom = new_y >= self.PADDLE_ROW - 1
+        caught = at_bottom & (new_x + 1 >= px) & (new_x <= px + self.PW - 1)
+        dy = jnp.where(caught, -jnp.abs(dy), dy)
+        new_y = jnp.where(caught,
+                          jnp.array(self.PADDLE_ROW - 3, jnp.int32), new_y)
+        dead = at_bottom & ~caught
+        bricks = jnp.where(bricks.any(), bricks, jnp.ones_like(bricks))
+        t = s["t"] + 1
+        done = dead | (t >= self.max_steps)
+        new_state = {
+            "px": px, "bx": new_x, "by": new_y, "dx": dx, "dy": dy,
+            "lx": s["bx"], "ly": s["by"], "bricks": bricks, "t": t,
+        }
+        reset_state, reset_obs = self.reset(rng)
+        out_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, a, b), reset_state, new_state)
+        obs = jnp.where(done, reset_obs, self._obs(new_state))
+        return out_state, obs, reward, done, {}
+
+
 class StatelessCartPole(CartPole):
     """CartPole with the velocity components hidden (obs = [x, theta]) —
     the classic recurrent-policy testbed: a memoryless policy cannot infer
@@ -372,6 +488,7 @@ REGISTRY = {
     "Pendulum-v1": Pendulum,
     "PendulumContinuous-v1": PendulumContinuous,
     "Breakout-MinAtar-v0": Breakout,
+    "Breakout-Atari84-v0": Breakout84,
     "SpaceInvaders-MinAtar-v0": SpaceInvaders,
 }
 

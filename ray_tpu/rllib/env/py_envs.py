@@ -74,12 +74,21 @@ class GymEnvAdapter:
                 f"first), got {space}")
         self.obs_dim = int(np.prod(space.shape))
         act = self.env.action_space
-        if not hasattr(act, "n"):
+        if hasattr(act, "n"):
+            self.num_actions = int(act.n)
+            self.action_dim = None
+        elif isinstance(act, spaces.Box):
+            # Continuous control: the SAC/TD3-family actor path drives
+            # gym Box actions (reference: the torch algos on MuJoCo/
+            # classic-control continuous envs).
+            self.num_actions = None
+            self.action_dim = int(np.prod(act.shape))
+            self.action_low = np.asarray(act.low, np.float32).reshape(-1)
+            self.action_high = np.asarray(act.high, np.float32).reshape(-1)
+        else:
             raise ValueError(
-                f"gym env {name!r}: only Discrete action spaces are "
-                f"bridgeable here (continuous control runs anakin-side "
-                f"via the SAC/TD3 family), got {act}")
-        self.num_actions = int(act.n)
+                f"gym env {name!r}: only Discrete or Box action spaces "
+                f"are bridgeable, got {act}")
         self._next_seed = seed
 
     def _flat(self, obs) -> np.ndarray:
@@ -92,8 +101,13 @@ class GymEnvAdapter:
         obs, _info = self.env.reset(seed=seed)
         return self._flat(obs)
 
-    def step(self, action: int):
-        obs, reward, terminated, truncated, info = self.env.step(int(action))
+    def step(self, action):
+        if self.num_actions is not None:
+            action = int(action)
+        else:
+            action = np.asarray(action, np.float32).reshape(
+                self.env.action_space.shape)
+        obs, reward, terminated, truncated, info = self.env.step(action)
         return (self._flat(obs), float(reward), bool(terminated),
                 bool(truncated), info)
 
@@ -133,7 +147,8 @@ class VectorEnv:
     def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[dict]]:
         obs, rews, dones, infos = [], [], [], []
         for e, a in zip(self.envs, actions):
-            o, r, term, trunc, info = e.step(int(a))
+            o, r, term, trunc, info = e.step(
+                int(a) if np.ndim(a) == 0 else a)
             done = term or trunc
             if done:
                 o = e.reset()

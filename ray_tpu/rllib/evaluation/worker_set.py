@@ -136,6 +136,81 @@ class RolloutWorker:
         return batch, completed
 
 
+@ray_tpu.remote
+class OffPolicyRolloutWorker:
+    """CPU actor collecting RAW TRANSITIONS for the replay-family
+    algorithms (DQN/SAC/TD3) — the Ape-X shape: rollout actors feed a
+    learner-owned replay buffer (reference: ApexDQN's distributed replay
+    actors + the learner-thread consumer,
+    rllib/execution/multi_gpu_learner_thread.py:20).
+
+    The per-algorithm piece is an `act_factory` (cloudpickled closure)
+    returning ``act(params, obs, key, explore_arg) -> action`` — epsilon
+    for DQN, noise scale for TD3, unused for SAC's stochastic policy."""
+
+    def __init__(self, env_name, act_factory_blob, worker_index: int,
+                 num_envs: int, fragment_length: int, seed: int):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import cloudpickle
+        import jax
+
+        from ray_tpu.rllib.env.py_envs import VectorEnv, make_py_env
+
+        self.env = VectorEnv(lambda: make_py_env(env_name),
+                             num_envs, seed + worker_index * 1000)
+        self.params = None
+        self.fragment_length = fragment_length
+        self.rng = jax.random.PRNGKey(seed + worker_index)
+        self.obs = self.env.reset_all().astype(np.float32)
+        self.ep_returns = np.zeros(num_envs)
+        self.completed: List[float] = []
+        self._act = jax.jit(cloudpickle.loads(act_factory_blob)())
+
+    def set_weights(self, params):
+        self.params = params
+        return True
+
+    def ping(self):
+        return "ok"
+
+    def sample(self, explore_arg: float = 0.0):
+        """T steps of raw transitions: column dict + completed returns."""
+        import jax
+
+        T = self.fragment_length
+        obs_l, act_l, rew_l, nxt_l, done_l = [], [], [], [], []
+        for _ in range(T):
+            self.rng, k = jax.random.split(self.rng)
+            action = np.asarray(self._act(self.params, self.obs, k,
+                                          explore_arg))
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_l.append(self.obs)
+            act_l.append(action)
+            rew_l.append(reward)
+            nxt_l.append(next_obs.astype(np.float32))
+            done_l.append(done)
+            self.ep_returns += reward
+            for i, d in enumerate(done):
+                if d:
+                    self.completed.append(float(self.ep_returns[i]))
+                    self.ep_returns[i] = 0.0
+            self.obs = next_obs.astype(np.float32)
+        n = np.stack(rew_l).size
+        batch = {
+            "obs": np.stack(obs_l).reshape(n, -1),
+            "actions": np.concatenate(act_l, axis=0)
+            if np.asarray(act_l[0]).ndim > 1
+            else np.stack(act_l).reshape(n),
+            "rewards": np.stack(rew_l).reshape(n).astype(np.float32),
+            "next_obs": np.stack(nxt_l).reshape(n, -1),
+            "dones": np.stack(done_l).reshape(n).astype(np.float32),
+        }
+        completed, self.completed = self.completed, []
+        return batch, completed
+
+
 class WorkerSet:
     """Rollout workers behind a fault-tolerant actor manager (reference:
     FaultTolerantActorManager, rllib/utils/actor_manager.py:157 — health
@@ -144,15 +219,18 @@ class WorkerSet:
 
     MAX_FAILURES_BEFORE_RECREATE = 2
 
-    def __init__(self, config, module_spec):
+    def __init__(self, config, module_spec, worker_factory=None):
         self._config = config
         self._module_spec = module_spec
+        self._worker_factory = worker_factory
         n = max(1, config.num_rollout_workers)
         self.workers = [self._make_worker(i) for i in range(n)]
         self._failures = [0] * n
         self._weights_ref = None
 
     def _make_worker(self, i: int):
+        if self._worker_factory is not None:
+            return self._worker_factory(i)
         c = self._config
         return RolloutWorker.options(max_restarts=1).remote(
             c.env, self._module_spec, i, c.num_envs_per_worker,
@@ -231,11 +309,14 @@ class WorkerSet:
     def num_healthy_workers(self) -> int:
         return sum(1 for n in self._failures if n == 0)
 
-    def sample_sync(self) -> Tuple[List[Any], List[float]]:
+    def sample_sync(self, *args) -> Tuple[List[Any], List[float]]:
         """synchronous_parallel_sample (reference:
-        rllib/execution/rollout_ops.py:21) with dead-worker tolerance."""
+        rllib/execution/rollout_ops.py:21) with dead-worker tolerance.
+        Extra args forward to the workers' sample() (the off-policy
+        workers take the exploration argument per call)."""
         batches, returns = [], []
-        for _i, (b, eps) in self._foreach(lambda w: w.sample.remote()):
+        for _i, (b, eps) in self._foreach(
+                lambda w: w.sample.remote(*args)):
             batches.append(b)
             returns.extend(eps)
         return batches, returns

@@ -1,0 +1,91 @@
+"""Breakout-Atari84: the true-resolution (84x84x4) jittable pixel env
+behind the headline PPO bench (VERDICT r3 #3)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.env.jax_envs import (
+    Breakout84,
+    make_jax_env,
+    vector_reset,
+    vector_step,
+)
+
+
+def test_registry_and_shapes():
+    env = make_jax_env("Breakout-Atari84-v0")
+    assert isinstance(env, Breakout84)
+    states, obs = vector_reset(env, jax.random.PRNGKey(0), 3)
+    assert obs.shape == (3, 84, 84, 4)
+    assert obs.dtype == jnp.uint8
+
+
+def test_render_sprites():
+    env = Breakout84()
+    _, obs = env.reset(jax.random.PRNGKey(1))
+    o = np.asarray(obs)
+    assert (o[:, :, 0] > 0).sum() == 2 * env.PW      # paddle 2x8
+    assert (o[:, :, 1] > 0).sum() == 4               # ball 2x2
+    assert (o[:, :, 3] > 0).sum() == 72 * env.BRICK_H * env.BRICK_W
+    # Paddle is on the paddle rows; bricks in the brick band.
+    assert o[env.PADDLE_ROW:env.PADDLE_ROW + 2, :, 0].sum() == o[:, :, 0].sum()
+    band = o[env.BRICK_TOP:env.BRICK_TOP + 18, :, 3]
+    assert band.sum() == o[:, :, 3].sum()
+
+
+def test_random_rollout_scores_and_resets():
+    env = make_jax_env("Breakout-Atari84-v0")
+    states, _ = vector_reset(env, jax.random.PRNGKey(0), 8)
+
+    @jax.jit
+    def roll(states, rng):
+        def f(c, _):
+            st, r = c
+            r, k1, k2 = jax.random.split(r, 3)
+            a = jax.random.randint(k1, (8,), 0, 3)
+            st, o, rew, dn, _ = vector_step(env, st, a, k2)
+            return (st, r), (rew, dn)
+        (st, _), (rews, dones) = jax.lax.scan(f, (states, rng), None,
+                                              length=2000)
+        return rews.sum(), dones.sum()
+
+    r, d = roll(states, jax.random.PRNGKey(2))
+    assert int(d) > 50          # episodes end and reset
+    assert 0 < float(r) < 500   # random hits some bricks, not hundreds/ep
+
+
+def test_brick_hit_gives_reward_and_bounce():
+    env = Breakout84()
+    s, _ = env.reset(jax.random.PRNGKey(0))
+    # Place the ball just below the brick band moving up, aligned with a
+    # live brick column.
+    s = dict(s)
+    s["bx"] = jnp.array(10, jnp.int32)
+    s["by"] = jnp.array(env.BRICK_TOP + 6 * env.BRICK_H + 1, jnp.int32)
+    s["dx"] = jnp.array(0, jnp.int32)
+    s["dy"] = jnp.array(-2, jnp.int32)
+    s2, _obs, reward, done, _ = env.step(s, jnp.array(0), jax.random.PRNGKey(1))
+    assert float(reward) == 1.0
+    assert int(s2["dy"]) == 2  # bounced back down
+    assert int(s2["bricks"].sum()) == 71
+
+
+@pytest.mark.slow
+def test_ppo_learns_atari84():
+    """Learning gate at small scale (the bench runs the full config on the
+    chip): reward must clearly exceed the random policy's ~0.13."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig().environment("Breakout-Atari84-v0")
+            .anakin(num_envs=256, unroll_length=64)
+            .training(num_sgd_iter=2, sgd_minibatch_size=4096, lr=5e-4,
+                      entropy_coeff=0.01)
+            .debugging(seed=0).build())
+    best = 0.0
+    for _ in range(40):
+        m = algo.train()
+        r = m.get("episode_reward_mean", 0.0)
+        if r == r:
+            best = max(best, r)
+    assert best >= 1.0, f"no learning signal on Atari84: best={best}"

@@ -72,3 +72,77 @@ def test_impala_actor_mode_runs(ray_cluster):
     assert np.isfinite(result["total_loss"])
     assert result["num_env_steps_sampled"] > 0
     algo.stop()
+
+
+def test_dqn_actor_mode_learns_cartpole(ray_cluster):
+    """VERDICT r3 #6 'done' gate: DQN on gym CartPole-v1 via CPU rollout
+    actors feeding the learner-owned replay buffer reaches reward >= 100
+    (the Ape-X topology, reference: multi_gpu_learner_thread.py:20)."""
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_fragment_length=64)
+            .training(lr=5e-4)
+            .build())
+    best = 0.0
+    for _ in range(80):
+        m = algo.train()
+        r = m.get("episode_reward_mean", 0.0)
+        if r == r:
+            best = max(best, r)
+        if best >= 100.0:
+            break
+    algo.stop()
+    assert best >= 100.0, f"actor-path DQN failed to learn: best={best}"
+
+
+def test_sac_actor_mode_learns_pendulum(ray_cluster):
+    """SAC actor path drives a CONTINUOUS gym env through the Box-action
+    bridge; random policy scores ~-1400, learning must lift it."""
+    from ray_tpu.rllib import SACConfig
+
+    cfg = (SACConfig()
+           .environment("Pendulum-v1")
+           .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                     rollout_fragment_length=64)
+           .training(lr=3e-4))
+    # ~1 gradient update per 4 env steps (the standard SAC regime; the
+    # default 8/iter is tuned for the anakin path's huge batches).
+    cfg.num_updates_per_iter = 64
+    cfg.learning_starts = 512
+    algo = cfg.build()
+    best = -1e9
+    for _ in range(120):
+        m = algo.train()
+        r = m.get("episode_reward_mean", float("nan"))
+        if r == r:
+            best = max(best, r)
+        if best >= -400.0:
+            break
+    algo.stop()
+    assert best >= -800.0, f"actor-path SAC failed to learn: best={best}"
+
+
+def test_td3_actor_mode_runs_continuous(ray_cluster):
+    """TD3 actor path: continuous bridge + delayed-policy updates run and
+    produce finite losses (learning gate lives with SAC above — same
+    machinery, one slow gate is enough)."""
+    import numpy as np
+
+    from ray_tpu.rllib.algorithms.td3 import TD3Config
+
+    algo = (TD3Config()
+            .environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                      rollout_fragment_length=64)
+            .training(lr=3e-4)
+            .build())
+    algo.config.learning_starts = 256
+    last = {}
+    for _ in range(6):
+        last = algo.train()
+    algo.stop()
+    assert last["replay_size"] >= 1500
+    assert np.isfinite(last.get("critic_loss", np.nan))
