@@ -164,4 +164,8 @@ CONFIG \
              "leases, bypassing the head on the hot path (reference: "
              "direct_task_transport.h lease caching).") \
     .declare("lease_idle_s", float, 0.5,
-             "Return an idle worker lease to the head after this long.")
+             "Return an idle worker lease to the head after this long.") \
+    .declare("reconnect_window_s", float, 30.0,
+             "How long agents/workers/drivers retry reconnecting to a "
+             "restarted head before giving up (reference: the GCS "
+             "reconnect window, ray_config_def.h:58-62).")

@@ -79,27 +79,61 @@ def main():
             tcp_bind=tcp_bind)
         worker.enable_direct(server, host_key)
 
+    def register():
+        transport.send({"type": "register", "worker_id": worker_id.binary(),
+                        "node_id": node_id.binary(), "pid": os.getpid(),
+                        "direct_addr": server.address if server else None})
+
+    def reconnect() -> bool:
+        """Remote workers outlive a restarting head: retry the control
+        connection within the reconnect window and re-register (the
+        worker's actor/task state lives HERE, so surviving the outage is
+        what preserves actors across head failover)."""
+        if not head_addr:
+            return False  # local workers die with the head process
+        import time as _time
+
+        from ray_tpu._private.config import CONFIG
+
+        host, port = head_addr.rsplit(":", 1)
+        deadline = _time.monotonic() + CONFIG.reconnect_window_s
+        while _time.monotonic() < deadline:
+            _time.sleep(1.0)
+            try:
+                newconn = Client((host, int(port)), family="AF_INET",
+                                 authkey=authkey)
+            except Exception:
+                continue
+            transport.replace_conn(newconn)
+            try:
+                register()
+            except Exception:
+                continue  # head died again mid-handshake: keep retrying
+            return True
+        return False
+
     def reader():
-        try:
-            while True:
-                msg = conn.recv()
-                t = msg.get("type")
-                if t == "reply":
-                    transport.on_reply(msg)
-                elif t == "execute":
-                    task_queue.put((msg["spec"], None))
-                elif t == "shutdown":
+        while True:
+            try:
+                msg = transport.conn.recv()
+            except (EOFError, OSError):
+                if not reconnect():
                     stop.set()
                     task_queue.put(None)
                     return
-        except (EOFError, OSError):
-            stop.set()
-            task_queue.put(None)
+                continue
+            t = msg.get("type")
+            if t == "reply":
+                transport.on_reply(msg)
+            elif t == "execute":
+                task_queue.put((msg["spec"], None))
+            elif t == "shutdown":
+                stop.set()
+                task_queue.put(None)
+                return
 
     threading.Thread(target=reader, name="rtpu-reader", daemon=True).start()
-    transport.send({"type": "register", "worker_id": worker_id.binary(),
-                    "node_id": node_id.binary(), "pid": os.getpid(),
-                    "direct_addr": server.address if server else None})
+    register()
 
     def make_done(spec: TaskSpec):
         if server is not None and spec.task_id in server.cancelled:

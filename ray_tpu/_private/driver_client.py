@@ -31,6 +31,8 @@ class RemoteDriverRuntime:
                  job_config: Optional[dict] = None,
                  timeout: float = 30.0):
         host, port = address.rsplit(":", 1)
+        self._head_host, self._head_port = host, int(port)
+        self._job_config = job_config
         self.authkey = authkey
         self.worker_id = WorkerID.from_random()
         self.job_id = JobID.from_random()
@@ -49,19 +51,12 @@ class RemoteDriverRuntime:
             self.transport = ConnTransport(self.conn, authkey)
             self.node_id: Optional[NodeID] = None
             self._registered = threading.Event()
+            self._closing = False
             self._reader = threading.Thread(
                 target=self._read_loop, name="rtpu-driver-reader",
                 daemon=True)
             self._reader.start()
-            self.transport.send({
-                "type": "register_driver",
-                "worker_id": self.worker_id.binary(),
-                "job_id": self.job_id,
-                "job_config": job_config or {},
-                "host_key": self.host_key,
-                "transfer_addr": list(self.xfer.address),
-                "pid": os.getpid(),
-            })
+            self._send_register()
             if not self._registered.wait(timeout):
                 raise TimeoutError(
                     f"driver registration with {address} timed out")
@@ -69,29 +64,68 @@ class RemoteDriverRuntime:
             self.shutdown()
             raise
 
+    def _send_register(self):
+        self.transport.send({
+            "type": "register_driver",
+            "worker_id": self.worker_id.binary(),
+            "job_id": self.job_id,
+            "job_config": self._job_config or {},
+            "host_key": self.host_key,
+            "transfer_addr": list(self.xfer.address),
+            "pid": os.getpid(),
+        })
+
+    def _reconnect(self) -> bool:
+        """Head restarted: retry within the reconnect window and
+        re-register this driver (same identity/store) — reference: the
+        GCS client reconnect window, ray_config_def.h:58-62."""
+        import time
+
+        from ray_tpu._private.config import CONFIG
+
+        deadline = time.monotonic() + CONFIG.reconnect_window_s
+        while time.monotonic() < deadline:
+            time.sleep(1.0)
+            try:
+                conn = Client((self._head_host, self._head_port),
+                              family="AF_INET", authkey=self.authkey)
+            except Exception:
+                continue
+            self.conn = conn
+            self.transport.replace_conn(conn)
+            try:
+                self._send_register()
+            except Exception:
+                continue  # head died again mid-handshake: keep retrying
+            return True
+        return False
+
     def _read_loop(self):
-        try:
-            while True:
+        while True:
+            try:
                 msg = self.conn.recv()
-                t = msg.get("type")
-                if t == "reply":
-                    self.transport.on_reply(msg)
-                elif t == "driver_registered":
-                    self.node_id = NodeID(msg["node_id"])
-                    self._registered.set()
-                elif t == "store_adopt":
-                    self.store.adopt(ObjectID(msg["oid"]), msg["size"],
-                                     msg["meta"])
-                elif t == "store_delete":
-                    self.store.delete(ObjectID(msg["oid"]))
-                elif t == "shutdown":
+            except (EOFError, OSError, BrokenPipeError):
+                if self._closing or not self._reconnect():
+                    self.transport.close()
                     return
-        except (EOFError, OSError, BrokenPipeError):
-            pass
-        finally:
-            self.transport.close()
+                continue
+            t = msg.get("type")
+            if t == "reply":
+                self.transport.on_reply(msg)
+            elif t == "driver_registered":
+                self.node_id = NodeID(msg["node_id"])
+                self._registered.set()
+            elif t == "store_adopt":
+                self.store.adopt(ObjectID(msg["oid"]), msg["size"],
+                                 msg["meta"])
+            elif t == "store_delete":
+                self.store.delete(ObjectID(msg["oid"]))
+            elif t == "shutdown":
+                self.transport.close()
+                return
 
     def shutdown(self):
+        self._closing = True
         try:
             if self.conn is not None:
                 self.conn.close()

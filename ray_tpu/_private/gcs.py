@@ -54,6 +54,7 @@ class ActorInfo:
     __slots__ = (
         "actor_id", "name", "namespace", "state", "creation_spec", "node_id",
         "worker_id", "num_restarts", "max_restarts", "death_cause", "lifetime",
+        "reconnect_worker_id",
         "class_name", "pending_calls", "resources_held",
     )
 
@@ -70,6 +71,10 @@ class ActorInfo:
         self.death_cause: Optional[str] = None
         self.lifetime = creation_spec.lifetime
         self.class_name = creation_spec.name.replace(".__init__", "")
+        # Set on snapshot-restore: the worker id this actor ran on before
+        # the head died; a re-registering worker with this id re-adopts
+        # the actor (head failover, see head._on_register).
+        self.reconnect_worker_id = None
         self.pending_calls: List[TaskSpec] = []
         # True while the creation-task resources are allocated on a node;
         # guards against double-release on kill + worker-death paths.
@@ -159,21 +164,46 @@ class GCS:
         detached-actor name registrations; live sockets/workers/objects are
         process state and rebuild on restart."""
         with self._lock:
+            actors = {}
+            for aid, info in self.actors.items():
+                if info.state == ActorState.DEAD:
+                    continue
+                actors[aid] = {
+                    "creation_spec": info.creation_spec,
+                    "worker_id": (info.worker_id.binary()
+                                  if info.worker_id else None),
+                    "num_restarts": info.num_restarts,
+                }
             return {
                 "kv": {ns: dict(t) for ns, t in self.kv.items()},
                 "jobs": dict(self.jobs),
                 "named_actors": dict(self.named_actors),
+                "actors": actors,
             }
 
     def restore(self, snap: dict):
+        from ray_tpu._private.ids import WorkerID as _WorkerID
+
         with self._lock:
             for ns, t in snap.get("kv", {}).items():
                 self.kv[ns].update(t)
             self.jobs.update(snap.get("jobs", {}))
-            # Only re-register names whose actor record is live in THIS
-            # process — the actors table is process state and is not
-            # snapshotted, so a restored dangling name would poison lookups
-            # (get_actor would crash) and block re-creation forever.
+            # Actors: restore live records as RESTARTING and remember the
+            # worker each ran on — its (still-running) worker process
+            # re-registers after a head restart and re-adopts the actor
+            # with its state intact (head failover; reference: GCS FT over
+            # redis_store_client.h + worker reconnect,
+            # ray_config_def.h:58-62).  Workers that never come back are
+            # reaped by the head's reconnect-window timer.
+            for aid, rec in snap.get("actors", {}).items():
+                if aid in self.actors:
+                    continue
+                info = ActorInfo(aid, rec["creation_spec"])
+                info.state = ActorState.RESTARTING
+                info.num_restarts = rec.get("num_restarts", 0)
+                if rec.get("worker_id"):
+                    info.reconnect_worker_id = _WorkerID(rec["worker_id"])
+                self.actors[aid] = info
             for key, actor_id in snap.get("named_actors", {}).items():
                 if actor_id in self.actors:
                     self.named_actors.setdefault(key, actor_id)

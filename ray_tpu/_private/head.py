@@ -56,11 +56,27 @@ from ray_tpu._private.task_spec import (
 
 
 class Head:
-    def __init__(self, session_dir: Optional[str] = None):
+    def __init__(self, session_dir: Optional[str] = None, tcp_port: int = 0):
         self.session_dir = session_dir or tempfile.mkdtemp(prefix="ray_tpu_")
         os.makedirs(self.session_dir, exist_ok=True)
         self.socket_path = os.path.join(self.session_dir, "head.sock")
-        self.authkey = os.urandom(16)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)  # stale socket from a dead head
+        # Persistent cluster identity: a restarted head must present the
+        # SAME authkey or reconnecting agents/workers/drivers fail their
+        # HMAC handshake (reference: the GCS's stable redis-backed
+        # identity).  tcp_port=0 keeps the ephemeral-port behavior for
+        # in-process test clusters; a standalone head passes a fixed port.
+        keyfile = os.path.join(self.session_dir, "authkey.bin")
+        if os.path.exists(keyfile):
+            with open(keyfile, "rb") as f:
+                self.authkey = f.read()
+        else:
+            self.authkey = os.urandom(16)
+            fd = os.open(keyfile, os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                         0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(self.authkey)
         self.gcs = GCS()
         self.scheduler = ClusterScheduler()
         self.raylets: Dict[NodeID, Raylet] = {}
@@ -74,6 +90,9 @@ class Head:
         self._pg_waiters: Dict[PlacementGroupID, List[Callable[[dict], None]]] = defaultdict(list)
         self._conns: Dict[WorkerID, Any] = {}
         self._conn_worker: Dict[int, WorkerID] = {}
+        # Worker registrations that raced ahead of their node's (re-)
+        # registration during head failover: replayed in add_remote_node.
+        self._pending_worker_regs: Dict[NodeID, list] = defaultdict(list)
         self._pending_pgs: List[PlacementGroupInfo] = []
         # Arena reader leases: oid -> {holder worker id: count}.  Granted when
         # an arena resolution is handed to a reader, released when the reader
@@ -96,6 +115,7 @@ class Head:
         self._local_xfer: Dict[NodeID, Any] = {}      # local transfer servers
         self._driver_hosts: Dict[bytes, str] = {}     # remote driver host keys
         self._driver_nodes: Dict[bytes, NodeID] = {}  # driver wid -> pseudo node
+        self._driver_conns: Dict[bytes, Any] = {}     # driver wid -> live conn
         self._has_remote = False
         self._listener = Listener(self.socket_path, family="AF_UNIX",
                                   authkey=self.authkey)
@@ -111,7 +131,7 @@ class Head:
         from ray_tpu._private.config import CONFIG
 
         self.tcp_bind_host = CONFIG.tcp_host
-        self._tcp_listener = Listener((self.tcp_bind_host, 0),
+        self._tcp_listener = Listener((self.tcp_bind_host, tcp_port),
                                       family="AF_INET", authkey=self.authkey)
         self.tcp_port = self._tcp_listener.address[1]
         self._tcp_accept_thread = threading.Thread(
@@ -145,6 +165,19 @@ class Head:
         self.gcs_snapshot_path = os.path.join(self.session_dir,
                                               "gcs_snapshot.pkl")
         self.gcs.load_snapshot(self.gcs_snapshot_path)
+        # Restored actors that had NO worker at snapshot time (creation
+        # still queued) have nothing to re-adopt: reschedule their
+        # creation now — it waits in the pending queue until capacity
+        # (re-)registers.
+        with self._lock:
+            from ray_tpu._private.gcs import ActorState as _AS
+
+            for info in self.gcs.actors.values():
+                if info.state == _AS.RESTARTING \
+                        and info.reconnect_worker_id is None:
+                    self._schedule(info.creation_spec)
+        self._boot_time = __import__("time").monotonic()
+        self._reconnect_reaped = False
         period = CONFIG.gcs_snapshot_period_s
         if period > 0:
             def snapshot_loop():
@@ -201,6 +234,7 @@ class Head:
                                 num_workers=len(raylet.workers),
                                 host_base=base))
             with self._lock:
+                self._reap_unreconnected_actors()
                 self.memory_monitor.tick()
                 for raylet in list(self.raylets.values()):
                     for h in list(raylet.workers.values()):
@@ -260,10 +294,14 @@ class Head:
 
     def add_remote_node(self, msg: dict, conn) -> NodeID:
         """A node agent registered over TCP: attach its host to the cluster
-        (reference: raylet self-registration with the GCS)."""
+        (reference: raylet self-registration with the GCS).  A
+        RE-registration after head failover carries the agent's previous
+        node_id and its surviving worker processes, which are adopted
+        rather than respawned."""
         from ray_tpu._private.config import CONFIG
 
-        node_id = NodeID.from_random()
+        node_id = (NodeID(msg["node_id"]) if msg.get("node_id")
+                   else NodeID.from_random())
         resources = dict(msg["resources"])
         labels = msg.get("labels") or {}
         with self._lock:
@@ -280,6 +318,31 @@ class Head:
                 self._ensure_local_transfer(nid)
             self.scheduler.add_node(node_id, resources, labels)
             self.gcs.register_node(NodeInfo(node_id, resources, labels))
+            # Adopt the agent's surviving worker processes (failover):
+            # handles exist immediately; each worker's own reconnect then
+            # attaches its control conn (possibly already parked below).
+            from ray_tpu._private.raylet import _RemoteProc, WorkerHandle
+
+            for w in msg.get("workers") or []:
+                if isinstance(w, dict):
+                    wid = WorkerID(w["worker_id"])
+                    chips = tuple(w.get("tpu_chips") or ())
+                else:  # bare worker-id (older agents)
+                    wid, chips = WorkerID(w), ()
+                h = WorkerHandle(wid, _RemoteProc(raylet, wid), node_id)
+                if chips:
+                    # The surviving worker still owns these chips: keep
+                    # them out of the fresh raylet's free pool.
+                    h.tpu_visible = True
+                    h.tpu_chips = chips
+                    raylet._free_chips = [c for c in raylet._free_chips
+                                          if c not in chips]
+                raylet.workers[wid] = h
+            for worker_id, wconn, daddr in self._pending_worker_regs.pop(
+                    node_id, []):
+                self._conns[worker_id] = wconn
+                h = raylet.on_worker_registered(worker_id, wconn, daddr)
+                self._try_readopt_actor(raylet, node_id, worker_id, h)
             self._drain_pending()
             self._drive_pending_pgs()
         self._send_on(conn, {"type": "node_registered",
@@ -308,6 +371,7 @@ class Head:
                 self._ensure_local_transfer(nid)
             self._driver_hosts[worker_id] = msg["host_key"]
             self._driver_nodes[worker_id] = node_id
+            self._driver_conns[worker_id] = conn
             self.gcs.add_job(msg["job_id"], msg.get("job_config") or {})
         self._send_on(conn, {"type": "driver_registered",
                              "node_id": node_id.binary()})
@@ -451,12 +515,21 @@ class Head:
         except Exception:
             traceback.print_exc()
         finally:
+            # Teardown is identity-checked: a peer that already
+            # RE-registered over a fresh connection (head failover /
+            # transient drop) must not be torn down by its old socket's
+            # delayed EOF.
             if agent_node is not None:
-                self.remove_node(agent_node)
+                raylet = self.raylets.get(agent_node)
+                if raylet is not None \
+                        and getattr(raylet, "agent_conn", None) is conn:
+                    self.remove_node(agent_node)
             elif driver_wid is not None:
-                self.on_driver_disconnected(driver_wid)
+                if self._driver_conns.get(driver_wid) is conn:
+                    self.on_driver_disconnected(driver_wid)
             elif worker_id is not None:
-                self.on_conn_closed(worker_id)
+                if self._conns.get(worker_id) is conn:
+                    self.on_conn_closed(worker_id)
 
     def on_remote_worker_exit(self, node_id: NodeID, msg: dict):
         """Agent reported one of its worker subprocesses exited — mirrors
@@ -481,6 +554,7 @@ class Head:
     def on_driver_disconnected(self, driver_wid: bytes):
         with self._lock:
             self._driver_hosts.pop(driver_wid, None)
+            self._driver_conns.pop(driver_wid, None)
             node_id = self._driver_nodes.pop(driver_wid, None)
         if node_id is not None:
             self.remove_node(node_id)
@@ -494,9 +568,68 @@ class Head:
         with self._lock:
             self._conns[worker_id] = conn
             raylet = self.raylets.get(node_id)
-            if raylet is not None:
-                raylet.on_worker_registered(worker_id, conn, direct_addr)
-                raylet.try_dispatch()
+            if raylet is None:
+                # Failover race: this worker's node agent has not
+                # re-registered yet — park the registration.
+                self._pending_worker_regs[node_id].append(
+                    (worker_id, conn, direct_addr))
+                return
+            h = raylet.on_worker_registered(worker_id, conn, direct_addr)
+            self._try_readopt_actor(raylet, node_id, worker_id, h)
+            raylet.try_dispatch()
+
+    def _try_readopt_actor(self, raylet, node_id, worker_id, h):
+        """Head-failover re-adoption: a surviving actor worker came back —
+        re-bind its restored actor record (state intact in the worker
+        process) instead of pooling the worker.  Under the head lock."""
+        for info in self.gcs.actors.values():
+            if info.reconnect_worker_id == worker_id:
+                info.reconnect_worker_id = None
+                if h is not None:
+                    h.actor_id = info.actor_id
+                    h.busy = True
+                    try:
+                        raylet.idle.remove(worker_id)
+                    except ValueError:
+                        pass
+                info.resources_held = True
+                self.scheduler.reacquire(node_id, info.creation_spec)
+                self.gcs.actor_started(info.actor_id, node_id, worker_id)
+                self._notify_actor_waiters(info.actor_id)
+                calls, info.pending_calls = info.pending_calls, []
+                for call in calls:
+                    self._push_actor_task(info, call)
+                return
+
+    def _reap_unreconnected_actors(self):
+        """After the reconnect window, restored actors whose worker never
+        came back go through the normal death path (restart budget or
+        DEAD) — called under the head lock from the monitor loop."""
+        if self._reconnect_reaped:
+            return
+        import time as _time
+
+        from ray_tpu._private.config import CONFIG
+
+        if _time.monotonic() - self._boot_time < CONFIG.reconnect_window_s:
+            return
+        self._reconnect_reaped = True
+        # Parked worker registrations whose node never re-registered:
+        # close them out (the workers give up their own reconnect loops).
+        for regs in self._pending_worker_regs.values():
+            for _wid, wconn, _d in regs:
+                try:
+                    wconn.close()
+                except Exception:
+                    pass
+        self._pending_worker_regs.clear()
+        for info in list(self.gcs.actors.values()):
+            if info.reconnect_worker_id is None:
+                continue
+            info.reconnect_worker_id = None
+            self._on_actor_worker_death(
+                info.actor_id,
+                "actor worker did not reconnect after head restart")
 
     def on_conn_closed(self, worker_id: WorkerID):
         with self._lock:

@@ -1,0 +1,55 @@
+"""Standalone head process: ``python -m ray_tpu._private.head_server``.
+
+The failover topology (reference: a GCS process separate from drivers,
+src/ray/gcs/gcs_server/gcs_server_main.cc): the head runs alone with a
+FIXED tcp port and a session dir holding its durable identity (authkey)
+and GCS snapshot; agents, workers and drivers connect over TCP and
+survive a head restart by reconnecting (see node_agent/default_worker/
+driver_client reconnect loops).
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--session-dir", required=True)
+    p.add_argument("--num-cpus", type=float, default=0.0,
+                   help="resources for an optional head-local node "
+                        "(0 = head is control-plane only)")
+    p.add_argument("--snapshot-period", type=float, default=1.0)
+    args = p.parse_args()
+
+    from ray_tpu._private.config import CONFIG
+
+    # A standalone head snapshots continuously by default — failover
+    # restores from the last snapshot (overridable via env/_system_config).
+    import os
+
+    if "RAY_TPU_GCS_SNAPSHOT_PERIOD_S" not in os.environ:
+        CONFIG.apply_system_config(
+            {"gcs_snapshot_period_s": args.snapshot_period})
+
+    from ray_tpu._private.head import Head
+
+    head = Head(session_dir=args.session_dir, tcp_port=args.port)
+    if args.num_cpus > 0:
+        head.add_node({"CPU": args.num_cpus})
+    print(f"head up: {head.tcp_address} session={head.session_dir}",
+          flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    head.gcs.save_snapshot(head.gcs_snapshot_path)
+    head.shutdown()
+
+
+if __name__ == "__main__":
+    main()

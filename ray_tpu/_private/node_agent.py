@@ -68,8 +68,8 @@ class NodeAgent:
         with self._send_lock:
             self.conn.send(msg)
 
-    def run(self):
-        self.send({
+    def _register_msg(self) -> dict:
+        msg = {
             "type": "register_node",
             "resources": self.resources,
             "labels": self.labels,
@@ -78,7 +78,50 @@ class NodeAgent:
             "store_capacity": self.store.capacity,
             "max_workers": self.max_workers,
             "pid": os.getpid(),
-        })
+        }
+        if self.node_id is not None:
+            # Re-registration after a head restart: keep our identity and
+            # hand over the surviving worker processes for adoption.
+            msg["node_id"] = self.node_id.binary()
+            with self._children_lock:
+                msg["workers"] = [
+                    {"worker_id": wid,
+                     "tpu_chips": getattr(p, "_rtpu_chips", [])}
+                    for wid, p in self._children.items()]
+        return msg
+
+    def _reconnect(self) -> bool:
+        """Head connection died: retry within the reconnect window (the
+        head may be restarting from its snapshot — reference: the GCS
+        reconnect window, ray_config_def.h:58-62)."""
+        from ray_tpu._private.config import CONFIG
+
+        deadline = time.monotonic() + CONFIG.reconnect_window_s
+        while not self._shutdown.is_set() and time.monotonic() < deadline:
+            time.sleep(1.0)
+            try:
+                conn = Client(tuple(self.head_addr), family="AF_INET",
+                              authkey=self.authkey)
+            except Exception:
+                continue
+            with self._send_lock:
+                try:
+                    conn_old, self.conn = self.conn, conn
+                except Exception:
+                    continue
+            try:
+                conn_old.close()
+            except Exception:
+                pass
+            try:
+                self.send(self._register_msg())
+            except Exception:
+                continue  # head died again mid-handshake: keep retrying
+            return True
+        return False
+
+    def run(self):
+        self.send(self._register_msg())
         threading.Thread(target=self._reap_loop, name="rtpu-agent-reap",
                          daemon=True).start()
         threading.Thread(target=self._memory_loop, name="rtpu-agent-mem",
@@ -87,10 +130,13 @@ class NodeAgent:
                          daemon=True).start()
         try:
             while not self._shutdown.is_set():
-                msg = self.conn.recv()
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    if self._shutdown.is_set() or not self._reconnect():
+                        break
+                    continue
                 self._handle(msg)
-        except (EOFError, OSError):
-            pass  # head gone: shut down the node
         finally:
             self.shutdown()
 
@@ -132,6 +178,9 @@ class NodeAgent:
             [sys.executable, "-m", "ray_tpu._private.default_worker"],
             env=env)
         proc._rtpu_spawned = time.monotonic()
+        chips = (msg.get("env") or {}).get("TPU_VISIBLE_CHIPS")
+        proc._rtpu_chips = ([int(c) for c in chips.split(",")]
+                            if chips else [])
         with self._children_lock:
             self._children[msg["worker_id"]] = proc
 
@@ -160,7 +209,7 @@ class NodeAgent:
                         self.send({"type": "worker_exit", "worker_id": wid,
                                    "code": code})
                     except Exception:
-                        return
+                        pass  # head restarting: reconnect loop handles it
 
     def _memory_loop(self):
         """Host memory-pressure relief for THIS node (the head's monitor
@@ -233,7 +282,7 @@ class NodeAgent:
                            "stats": collect_node_stats(
                                store=self.store, num_workers=n_workers)})
             except Exception:
-                return
+                pass  # head restarting: reconnect loop handles it
 
     def shutdown(self):
         self._shutdown.set()

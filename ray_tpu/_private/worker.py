@@ -154,6 +154,25 @@ class ConnTransport:
         with self._send_lock:
             self.conn.send(msg)
 
+    def replace_conn(self, conn):
+        """Head failover: swap in a fresh control connection.  Requests
+        in flight on the dead conn fail (their callers retry or surface
+        the error); new traffic rides the new conn.  Swap and sweep are
+        atomic under both locks so a request can't send on the new conn
+        yet have its future swept (request() never nests these locks)."""
+        with self._send_lock:
+            with self._futures_lock:
+                futs, self._futures = list(self._futures.values()), {}
+                old, self.conn = self.conn, conn
+        try:
+            old.close()
+        except Exception:
+            pass
+        for fut in futs:
+            if not fut.done():
+                fut.set_exception(
+                    exc.RayTpuError("head connection lost (reconnected)"))
+
     def close(self):
         try:
             self.conn.close()
