@@ -839,6 +839,14 @@ class DirectSubmitter:
                 return
             dead = False
             with self._lock:
+                # Publish ac.chan FIRST (state stays A_RESOLVING so
+                # concurrent submits still queue): if the channel dies at
+                # any point — exec returning False mid-drain, or the
+                # reader's close callback racing this block —
+                # _on_chan_close(chan) must match this actor and replay
+                # the specs already moved into ac.inflight; with ac.chan
+                # unset they would strand in A_RESOLVING forever.
+                ac.chan = chan
                 # Enqueue the backlog onto the channel BEFORE exposing
                 # A_UP: chan.exec only appends to the sender queue, so a
                 # concurrent submit observing A_UP cannot overtake queued
@@ -850,7 +858,6 @@ class DirectSubmitter:
                         dead = True
                         break
                 if not dead:
-                    ac.chan = chan
                     ac.state = A_UP
             if dead:
                 self._on_chan_close(chan)

@@ -560,8 +560,22 @@ class Head:
             self.remove_node(node_id)
         freed = self.gcs.remove_all_references(driver_wid)
         with self._lock:
+            self._reclaim_lessee_locked(driver_wid)
             for oid in freed:
                 self._free_object(oid)
+            self._drain_pending()
+            self._drive_pending_pgs()
+
+    def _reclaim_lessee_locked(self, lessee: bytes):
+        """Lessee (worker or remote driver) died: release every worker
+        lease it held plus its arena leases — leaked leases are permanent
+        capacity loss (reference: lease reclaim on lessee death,
+        lease_policy / raylet).  Under the head lock."""
+        for raylet in self.raylets.values():
+            for h in list(raylet.workers.values()):
+                if h.leased_to == lessee:
+                    self._release_lease_locked(raylet, h)
+        self._drop_arena_leases_for(lessee)
 
     def _on_register(self, worker_id: WorkerID, node_id: NodeID, conn,
                      direct_addr=None):
@@ -641,18 +655,12 @@ class Head:
                     raylet.on_worker_lost(worker_id)
                     raylet.try_dispatch()
                     break
-            # Reclaim leases this process held on OTHER workers (reference:
-            # lease reclaim on lessee death, lease_policy / raylet).
-            lessee = worker_id.binary()
-            for raylet in self.raylets.values():
-                for h in list(raylet.workers.values()):
-                    if h.leased_to == lessee:
-                        self._release_lease_locked(raylet, h)
-            self._drop_arena_leases_for(worker_id.binary())
+            self._reclaim_lessee_locked(worker_id.binary())
             freed = self.gcs.remove_all_references(worker_id.binary())
             for oid in freed:
                 self._free_object(oid)
             self._drain_pending()
+            self._drive_pending_pgs()
 
     def send_to_worker(self, worker: WorkerHandle, msg: dict):
         if not self._send_on(worker.conn, msg):

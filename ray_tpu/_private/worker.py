@@ -950,6 +950,10 @@ class CoreWorker:
 
             deadline = (None if timeout is None
                         else _time.monotonic() + timeout)
+            # Poll interval backs off exponentially: a long wait on slow
+            # tasks must not spin the head (one wait_ready RPC per round)
+            # or the owner connections at ~300 rounds/s forever.
+            interval = 0.002
             with self._blocked_in_get():
                 while True:
                     ready_bin = set()
@@ -989,7 +993,12 @@ class CoreWorker:
                             deadline is not None
                             and _time.monotonic() >= deadline):
                         break
-                    _time.sleep(0.003)
+                    sleep_for = interval
+                    if deadline is not None:
+                        sleep_for = min(sleep_for,
+                                        max(0.0, deadline - _time.monotonic()))
+                    _time.sleep(sleep_for)
+                    interval = min(interval * 1.5, 0.1)
             ready, not_ready = [], []
             for r in refs:
                 (ready if r.id.binary() in ready_bin
