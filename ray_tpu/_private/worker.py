@@ -404,6 +404,18 @@ class CoreWorker:
         self._func_blobs: Dict[bytes, bytes] = {}
         self.actors: Dict[ActorID, Any] = {}
         self._closed = False
+        # __del__ deferral: ObjectRef finalizers fire at arbitrary points —
+        # notably inside transport.send's pickling while _send_lock is held
+        # (a ref dropped by the pickler re-enters send → self-deadlock on
+        # the non-reentrant lock) — so a dropped ref is queued here and a
+        # drainer thread does the transport I/O.
+        from collections import deque
+
+        self._ref_gc_queue: "deque" = deque()
+        self._ref_gc_wake = threading.Event()
+        self._ref_gc_thread = threading.Thread(
+            target=self._ref_gc_loop, name="rtpu-ref-gc", daemon=True)
+        self._ref_gc_thread.start()
 
     # ---- reference counting ----
     def enable_direct(self, server, host_key: str):
@@ -456,11 +468,38 @@ class CoreWorker:
             self._local_refs[oid] = n + 1
             first = n == 0
         if first:
+            # Fire-and-forget: the reply is a bare ack, and a blocking
+            # round trip here can deadlock — refs are unpickled on
+            # transport reader threads (conn.recv), which must never wait
+            # on a reply only they can deliver.  Same-connection ordering
+            # keeps add_ref ahead of any later remove_ref.
             try:
-                self.transport.request("add_ref",
-                                       {"oid": oid, "holder": self.worker_id.binary()})
+                self.transport.request_oneway(
+                    "add_ref", {"oid": oid, "holder": self.worker_id.binary()})
             except Exception:
                 pass
+
+    def remove_local_ref_deferred(self, oid: ObjectID,
+                                  owner_addr: Optional[dict] = None):
+        """ObjectRef.__del__ entry point: no I/O on the caller's thread."""
+        if self._closed:
+            return
+        self._ref_gc_queue.append((oid, owner_addr))
+        self._ref_gc_wake.set()
+
+    def _ref_gc_loop(self):
+        while not self._closed:
+            self._ref_gc_wake.wait(timeout=0.5)
+            self._ref_gc_wake.clear()
+            while self._ref_gc_queue:
+                try:
+                    oid, owner_addr = self._ref_gc_queue.popleft()
+                except IndexError:
+                    break
+                try:
+                    self.remove_local_ref(oid, owner_addr)
+                except Exception:
+                    pass
 
     def remove_local_ref(self, oid: ObjectID, owner_addr: Optional[dict] = None):
         if self._closed:
@@ -513,8 +552,9 @@ class CoreWorker:
             self._value_cache.pop(oid, None)
             self._shm_registry.pop(oid, None)
             try:
-                self.transport.request("remove_ref",
-                                       {"oid": oid, "holder": self.worker_id.binary()})
+                self.transport.request_oneway(
+                    "remove_ref",
+                    {"oid": oid, "holder": self.worker_id.binary()})
             except Exception:
                 pass
 

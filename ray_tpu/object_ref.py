@@ -83,11 +83,14 @@ class ObjectRef:
         return (_deserialize_ref, (self.id.binary(), owner))
 
     def __del__(self):
+        # Finalizers run at arbitrary points (including inside transport
+        # sends/recvs mid-pickle): hand the removal to the worker's ref-gc
+        # drainer instead of doing transport I/O on this thread.
         if self._owner_registered:
             w = _get_global_worker()
             if w is not None:
                 try:
-                    w.remove_local_ref(self.id, self.owner_addr)
+                    w.remove_local_ref_deferred(self.id, self.owner_addr)
                 except Exception:
                     pass
 
