@@ -1390,6 +1390,18 @@ class CoreWorker:
         self.transport.request("cancel", {"task_id": task_id})
 
     def shutdown(self):
+        # Drain deferred ref drops BEFORE closing: a ref dropped just
+        # before shutdown must still send its remove_ref/unpin (the
+        # synchronous __del__ path used to guarantee this).
+        while self._ref_gc_queue:
+            try:
+                oid, owner_addr = self._ref_gc_queue.popleft()
+            except IndexError:
+                break
+            try:
+                self.remove_local_ref(oid, owner_addr)
+            except Exception:
+                pass
         self._closed = True
         if self._direct is not None:
             try:

@@ -26,11 +26,11 @@ def mha_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Dispatches to the Pallas flash kernel on real TPU backends for long
     sequences, XLA reference otherwise.  The crossover is measured, not
-    assumed: on v5e (GPT-2 heads, d=64) the fused kernel's fwd+bwd beats
-    XLA ~1.25x at 4k ctx, 1.5x at 8k, 2.4x at 16k — but below ~2k the
-    XLA path wins because attention is a small FLOP fraction there and
-    the d<128 lane padding around the custom call costs more than the
-    [L, L] materialization it avoids."""
+    assumed: on v5e (GPT-2 heads, d=64) with the tuned (256, 1024)
+    blocks the fused kernel's fwd+bwd beats XLA ~1.5x at 1k ctx, ~1.7x
+    at 4k, more beyond — below 1k the XLA path wins because attention is
+    a tiny FLOP fraction there and the d<128 lane padding around the
+    custom call costs more than the [L, L] materialization it avoids."""
     b, lq, h, _ = q.shape
     lk = k.shape[1]
     # [B, H, Lq, Lk] score-matrix footprint the XLA path materializes
@@ -39,11 +39,11 @@ def mha_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if use_flash is None:
         use_flash = (jax.default_backend() not in ("cpu",)
                      and lq % 128 == 0 and lk % 128 == 0
-                     # Speed crossover is ~2k ctx (below it the XLA path
-                     # wins), but memory forces flash earlier: per-layer
-                     # score matrices past ~512MB OOM real training steps
-                     # (e.g. GPT-2 batch 32 @ 1k ctx on a 16G chip).
-                     and (lq >= 2048 or score_bytes > 512 * 1024 * 1024)
+                     # Speed crossover is ~1k ctx with the tuned block
+                     # sizes; memory can force flash even earlier:
+                     # per-layer score matrices past ~512MB OOM real
+                     # training steps on a 16G chip.
+                     and (lq >= 1024 or score_bytes > 512 * 1024 * 1024)
                      # Flash's causal mask is diagonal-aligned (self-
                      # attention); the XLA path's is bottom-right-aligned
                      # for lq != lk (decode), so only lq == lk may
@@ -149,31 +149,43 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal,
 
     num_k_blocks = seq_len_k // block_k
 
-    def body(kb, carry):
-        m, l, o = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[:, None] + jnp.dot(p.astype(v_blk.dtype), v_blk,
-                                            preferred_element_type=jnp.float32)
-        return m_new, l_new, o_new
+    def make_body(masked):
+        def body(kb, carry):
+            m, l, o = carry
+            k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+            s = jnp.dot(q, k_blk.T,
+                        preferred_element_type=jnp.float32) * sm_scale
+            if masked:
+                rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            corr = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            if masked:
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            l_new = l * corr + jnp.sum(p, axis=-1)
+            o_new = o * corr[:, None] + jnp.dot(
+                p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, o_new
+        return body
 
     if causal:
-        # Only blocks at or below the diagonal contribute.
+        # Interior blocks (strictly below the diagonal band) skip the mask
+        # entirely — the iota/select pair is pure VPU overhead there; only
+        # the diagonal-crossing tail blocks mask.
+        num_full = q_off // block_k
         last = (q_off + block_q + block_k - 1) // block_k
         num_iter = jnp.minimum(last, num_k_blocks)
-        m, l, o = jax.lax.fori_loop(0, num_iter, body, (m, l, o))
+        m, l, o = jax.lax.fori_loop(0, num_full, make_body(False), (m, l, o))
+        m, l, o = jax.lax.fori_loop(num_full, num_iter, make_body(True),
+                                    (m, l, o))
     else:
-        m, l, o = jax.lax.fori_loop(0, num_k_blocks, body, (m, l, o))
+        m, l, o = jax.lax.fori_loop(0, num_k_blocks, make_body(False),
+                                    (m, l, o))
 
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[...] = (o / l_safe[:, None]).astype(o_ref.dtype)
@@ -198,27 +210,34 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_off = pl.program_id(1) * block_q
     num_k_blocks = seq_len_k // block_k
 
-    def body(kb, dq):
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+    def make_body(masked):
+        def body(kb, dq):
+            k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+            s = jnp.dot(q, k_blk.T,
+                        preferred_element_type=jnp.float32) * sm_scale
+            if masked:
+                rows = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
+            return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        return body
 
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     if causal:
+        num_full = q_off // block_k
         last = (q_off + block_q + block_k - 1) // block_k
         num_iter = jnp.minimum(last, num_k_blocks)
-        dq = jax.lax.fori_loop(0, num_iter, body, dq)
+        dq = jax.lax.fori_loop(0, num_full, make_body(False), dq)
+        dq = jax.lax.fori_loop(num_full, num_iter, make_body(True), dq)
     else:
-        dq = jax.lax.fori_loop(0, num_k_blocks, body, dq)
+        dq = jax.lax.fori_loop(0, num_k_blocks, make_body(False), dq)
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
@@ -233,35 +252,46 @@ def _flash_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     k_off = pl.program_id(1) * block_k
     num_q_blocks = seq_len_q // block_q
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
-        do_blk = do_ref[pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
-        s = jnp.dot(q_blk, k_blk.T,
-                    preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        dv = dv + jnp.dot(p.astype(do_blk.dtype).T, do_blk,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q_blk.dtype)
-        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
-        return dk, dv
+    def make_body(masked):
+        def body(qb, carry):
+            dk, dv = carry
+            q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
+            do_blk = do_ref[pl.ds(qb * block_q, block_q), :]
+            lse = lse_ref[0, pl.ds(qb * block_q, block_q)]
+            delta = delta_ref[0, pl.ds(qb * block_q, block_q)]
+            s = jnp.dot(q_blk, k_blk.T,
+                        preferred_element_type=jnp.float32) * sm_scale
+            if masked:
+                rows = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                cols = k_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            dv = dv + jnp.dot(p.astype(do_blk.dtype).T, do_blk,
+                              preferred_element_type=jnp.float32)
+            dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None]) * sm_scale).astype(q_blk.dtype)
+            dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+            return dk, dv
+        return body
 
     dk = jnp.zeros(k_blk.shape, jnp.float32)
     dv = jnp.zeros(v_blk.shape, jnp.float32)
     if causal:
-        # Only q blocks at or past this k block's diagonal contribute.
+        # Only q blocks at or past this k block's diagonal contribute;
+        # blocks fully below the diagonal band skip the mask.
         first = k_off // block_q
-        dk, dv = jax.lax.fori_loop(first, num_q_blocks, body, (dk, dv))
+        first_full = (k_off + block_k + block_q - 1) // block_q
+        first_full = jnp.minimum(first_full, num_q_blocks)
+        dk, dv = jax.lax.fori_loop(first, first_full, make_body(True),
+                                   (dk, dv))
+        dk, dv = jax.lax.fori_loop(first_full, num_q_blocks,
+                                   make_body(False), (dk, dv))
     else:
-        dk, dv = jax.lax.fori_loop(0, num_q_blocks, body, (dk, dv))
+        dk, dv = jax.lax.fori_loop(0, num_q_blocks, make_body(False),
+                                   (dk, dv))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
@@ -408,16 +438,38 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _auto_blocks(lq: int, lk: int) -> Tuple[int, int]:
+    """Measured on v5e (GPT-2 heads, d=64, 4k ctx): (256, 1024) runs the
+    fwd+bwd 2.1x faster than (128, 128) — bigger K tiles amortize the
+    per-block loop/bookkeeping and keep the MXU fed; past ~(512, 2048)
+    the f32 score/probability tiles blow the 16M VMEM scoped budget."""
+    def pick(l, target):
+        b = target
+        while b > 128 and l % b:
+            b //= 2
+        return b if l % b == 0 else 128
+
+    if lk >= 1024:
+        return pick(lq, 256), pick(lk, 1024)
+    return pick(lq, 128), pick(lk, 128)
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: bool = False) -> jax.Array:
     """Fused attention on TPU via Pallas, differentiable (custom VJP
     recomputes P blockwise from the saved log-sum-exp — the flash
-    backward). q,k,v: [B, L, H, D] → [B, L, H, D]."""
+    backward). q,k,v: [B, L, H, D] → [B, L, H, D].
+
+    Block sizes default to a measured per-length choice (_auto_blocks);
+    pass them explicitly to override."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    auto_q, auto_k = _auto_blocks(lq, lk)
+    block_q = auto_q if block_q is None else block_q
+    block_k = auto_k if block_k is None else block_k
     if lq % block_q or lk % block_k:
         raise ValueError(f"sequence lengths ({lq},{lk}) must be multiples of "
                          f"block sizes ({block_q},{block_k})")

@@ -25,6 +25,11 @@ class Algorithm:
         if self.config.mode == "anakin":
             self._setup_anakin()
         else:
+            if getattr(self.config, "num_devices", None) is not None:
+                from ray_tpu.rllib.utils.mesh import reject_data_mesh
+
+                reject_data_mesh(self.config, "actor mode (the learner "
+                                 "runs single-device; use anakin mode)")
             self._setup_actor_mode()
 
     def train(self) -> Dict[str, Any]:

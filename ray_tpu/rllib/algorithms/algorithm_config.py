@@ -53,6 +53,13 @@ class AlgorithmConfig:
         # resources / misc
         self.seed = 0
         self.framework_str = "jax"
+        # Data-parallel learner mesh (reference: num_gpus on the learner,
+        # rllib/core/rl_trainer/trainer_runner.py:75-90 — one DDP bucket
+        # per GPU).  TPU-first redesign: the anakin train step shard_maps
+        # over a `data` mesh axis — envs sharded, grads psum'd over ICI.
+        # None = legacy single-device jit; an int (1 is valid) compiles
+        # the SPMD program over that many devices.
+        self.num_devices: Optional[int] = None
 
     # ---- fluent sections ----
     def environment(self, env=None, env_config: Optional[dict] = None):
@@ -141,7 +148,9 @@ class AlgorithmConfig:
                              "of user models belong in user space")
         return self
 
-    def resources(self, **kw):
+    def resources(self, num_devices: Optional[int] = None, **kw):
+        if num_devices is not None:
+            self.num_devices = num_devices
         return self
 
     def debugging(self, seed: Optional[int] = None, **kw):

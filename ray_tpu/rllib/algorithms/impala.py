@@ -76,6 +76,7 @@ class IMPALA(Algorithm):
     def _setup_anakin(self):
         from ray_tpu.rllib.algorithms import ppo as ppo_mod
         from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+        from ray_tpu.rllib.utils import mesh as mesh_util
 
         config = self.config
         env = make_jax_env(config.env) if isinstance(config.env, str) \
@@ -88,14 +89,25 @@ class IMPALA(Algorithm):
         N, T = config.num_envs, config.unroll_length
         loss_fn = self._make_loss()
 
-        def init_fn(seed=0):
+        # Data-parallel mesh (same SPMD shape as PPO's: envs sharded on
+        # the `data` axis, grads pmean'd — see ppo.make_anakin_ppo).
+        D, sharded, mesh = mesh_util.setup_data_mesh(config, N)
+
+        def _init(seed):
             rng = jax.random.PRNGKey(seed)
             rng, k_init, k_env = jax.random.split(rng, 3)
             env_states, obs = vector_reset(env, k_env, N)
             params = module.init(k_init, obs)
             return ppo_mod.AnakinState(params, tx.init(params), env_states,
-                                       obs, rng, jnp.zeros(N), jnp.zeros(()),
+                                       obs, mesh_util.split_rng(rng, D, sharded),
+                                       jnp.zeros(N), jnp.zeros(()),
                                        jnp.zeros(()))
+
+        if sharded:
+            init_fn = jax.jit(_init, out_shardings=mesh_util.state_sharding(
+                mesh, ppo_mod.anakin_state_specs()))
+        else:
+            init_fn = _init
 
         def rollout_step(carry, _):
             params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
@@ -111,10 +123,13 @@ class IMPALA(Algorithm):
             return (params, env_states, next_obs, rng, ep_ret, dsum, dcnt), out
 
         def train_step(state):
-            carry = (state.params, state.env_states, state.obs, state.rng,
-                     state.ep_return, state.done_return_sum, state.done_count)
+            rng_in = mesh_util.unwrap_rng(state.rng, sharded)
+            carry = (state.params, state.env_states, state.obs, rng_in,
+                     state.ep_return, jnp.zeros(()), jnp.zeros(()))
             carry, traj = jax.lax.scan(rollout_step, carry, None, length=T)
-            params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
+            params, env_states, obs, rng, ep_ret, dsum_d, dcnt_d = carry
+            dsum = state.done_return_sum + mesh_util.psum_if(dsum_d, sharded)
+            dcnt = state.done_count + mesh_util.psum_if(dcnt_d, sharded)
             obs_t, act_t, logp_t, rew_t, done_t = traj
             _, last_value = module.apply(params, obs)
             batch = {"obs": obs_t, "actions": act_t, "behaviour_logp": logp_t,
@@ -122,16 +137,24 @@ class IMPALA(Algorithm):
                      "last_value": last_value}
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, module, batch)
+            grads = mesh_util.pmean_if(grads, sharded)
+            loss = mesh_util.pmean_if(loss, sharded)
+            aux = mesh_util.pmean_if(aux, sharded)
             updates, opt_state = tx.update(grads, state.opt_state, params)
             params = optax.apply_updates(params, updates)
-            new_state = ppo_mod.AnakinState(params, opt_state, env_states,
-                                            obs, rng, ep_ret, dsum, dcnt)
+            new_state = ppo_mod.AnakinState(
+                params, opt_state, env_states, obs,
+                mesh_util.wrap_rng(rng, sharded), ep_ret, dsum, dcnt)
             metrics = {"total_loss": loss, **aux,
                        "episode_return_sum": dsum, "episode_count": dcnt}
             return new_state, metrics
 
         self._anakin_state = init_fn(config.seed)
-        self._train_step = jax.jit(train_step)
+        if sharded:
+            self._train_step = mesh_util.shard_train_step(
+                train_step, mesh, ppo_mod.anakin_state_specs())
+        else:
+            self._train_step = jax.jit(train_step)
         self._steps_per_iter = N * T
 
     def _training_step_anakin(self):

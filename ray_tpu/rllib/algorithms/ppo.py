@@ -67,12 +67,20 @@ def ppo_loss(params, module, batch, *, clip_param, vf_clip_param,
 
 
 def run_ppo_sgd(params, opt_state, rng, loss_fn, make_mb, total, mb_size,
-                num_mb, num_sgd_iter, tx):
+                num_mb, num_sgd_iter, tx, sharded: bool = False):
     """The shared permute→minibatch→update scaffolding for every PPO
     variant (feedforward, recurrent, attention): `make_mb(idx)` maps an
     index vector over `total` items (steps or env sequences) to a loss
     batch; `loss_fn(params, mb) -> (loss, aux)`.  One copy so fixes to
-    the minibatch loop (e.g. the perm remainder drop) land everywhere."""
+    the minibatch loop (e.g. the perm remainder drop) land everywhere.
+
+    With `sharded=True` the caller runs inside a shard_map over the
+    `data` mesh axis: `total`/`mb_size` are per-device, each device
+    permutes its own shard, and the gradient (plus loss metrics) is
+    pmean'd across the axis before the optimizer update — params stay
+    replicated because every device applies the identical update."""
+    from ray_tpu.rllib.utils.mesh import pmean_if
+
     def sgd_epoch(carry, _):
         params, opt_state, rng = carry
         rng, k = jax.random.split(rng)
@@ -82,6 +90,9 @@ def run_ppo_sgd(params, opt_state, rng, loss_fn, make_mb, total, mb_size,
             params, opt_state = carry
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, make_mb(idx))
+            grads = pmean_if(grads, sharded)
+            loss = pmean_if(loss, sharded)
+            aux = pmean_if(aux, sharded)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), (loss, aux)
@@ -108,8 +119,29 @@ class AnakinState(NamedTuple):
     done_count: jax.Array
 
 
+def anakin_state_specs():
+    """PartitionSpec prefix for AnakinState on the `data` mesh: params +
+    optimizer replicated, env batch (states/obs/rng/returns) sharded on
+    the axis, episode counters replicated (psum'd deltas)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.rllib.utils.mesh import DATA_AXIS
+
+    return AnakinState(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                       P(DATA_AXIS), P(), P())
+
+
 def make_anakin_ppo(config: AlgorithmConfig):
-    """Builds (init_fn, jitted train_step) for fully-on-device PPO."""
+    """Builds (init_fn, jitted train_step) for fully-on-device PPO.
+
+    With ``config.num_devices`` set, the step is one SPMD program over a
+    1-D ``data`` mesh (reference DP shape: one replica per GPU with grad
+    all-reduce, rllib/core/rl_trainer/trainer_runner.py:75-90): each
+    device rolls out N/D envs and runs the minibatch scan on its shard,
+    with gradients/moments pmean'd across the axis — the only cross-chip
+    traffic is the grad all-reduce riding ICI."""
+    from ray_tpu.rllib.utils import mesh as mesh_util
+
     env = make_jax_env(config.env) if isinstance(config.env, str) \
         else config.env
     obs_shape = getattr(env, "obs_shape", None)
@@ -126,13 +158,30 @@ def make_anakin_ppo(config: AlgorithmConfig):
     mb_size = min(config.sgd_minibatch_size, batch_total)
     num_mb = batch_total // mb_size
 
-    def init_fn(seed: int = 0) -> AnakinState:
+    D, sharded, mesh = mesh_util.setup_data_mesh(config, N)
+    if sharded:
+        if mb_size % D:
+            raise ValueError(f"sgd_minibatch_size={mb_size} not divisible "
+                             f"by num_devices={D}")
+        N_loc, mb_loc = N // D, mb_size // D
+    else:
+        N_loc, mb_loc = N, mb_size
+    batch_loc = N_loc * T
+
+    def _init(seed) -> AnakinState:
         rng = jax.random.PRNGKey(seed)
         rng, k_init, k_env = jax.random.split(rng, 3)
         env_states, obs = vector_reset(env, k_env, N)
         params = module.init(k_init, obs)
-        return AnakinState(params, tx.init(params), env_states, obs, rng,
+        return AnakinState(params, tx.init(params), env_states, obs,
+                           mesh_util.split_rng(rng, D, sharded),
                            jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
+
+    if sharded:
+        out_sh = mesh_util.state_sharding(mesh, anakin_state_specs())
+        init_fn = jax.jit(_init, out_shardings=out_sh)
+    else:
+        init_fn = _init
 
     loss_fn = functools.partial(
         ppo_loss, clip_param=config.clip_param,
@@ -154,34 +203,43 @@ def make_anakin_ppo(config: AlgorithmConfig):
         return (params, env_states, next_obs, rng, ep_ret, dsum, dcnt), out
 
     def train_step(state: AnakinState) -> Tuple[AnakinState, Dict[str, jax.Array]]:
-        carry = (state.params, state.env_states, state.obs, state.rng,
-                 state.ep_return, state.done_return_sum, state.done_count)
+        # Inside shard_map every array is the per-device block: N_loc envs,
+        # a [1, 2] rng row (unwrapped to this device's key), and the
+        # replicated params/opt/counters.
+        rng_in = mesh_util.unwrap_rng(state.rng, sharded)
+        carry = (state.params, state.env_states, state.obs, rng_in,
+                 state.ep_return, jnp.zeros(()), jnp.zeros(()))
         carry, traj = jax.lax.scan(rollout_step, carry, None, length=T)
-        params, env_states, obs, rng, ep_ret, dsum, dcnt = carry
-        obs_t, act_t, logp_t, val_t, rew_t, done_t = traj  # [T, N, ...]
+        params, env_states, obs, rng, ep_ret, dsum_d, dcnt_d = carry
+        obs_t, act_t, logp_t, val_t, rew_t, done_t = traj  # [T, N_loc, ...]
+
+        dsum = state.done_return_sum + mesh_util.psum_if(dsum_d, sharded)
+        dcnt = state.done_count + mesh_util.psum_if(dcnt_d, sharded)
 
         _, last_value = module.apply(params, obs)
         adv, vtarg = gae_jax(rew_t, val_t, done_t, last_value,
                              config.gamma, config.lambda_)
-        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        adv = mesh_util.normalize_global(adv, sharded)
 
         flat = {
-            "obs": (obs_t.reshape(batch_total, *obs_shape)
+            "obs": (obs_t.reshape(batch_loc, *obs_shape)
                     if obs_shape is not None
-                    else obs_t.reshape(batch_total, -1)),
-            "actions": act_t.reshape(batch_total),
-            "action_logp": logp_t.reshape(batch_total),
-            "advantages": adv.reshape(batch_total),
-            "value_targets": vtarg.reshape(batch_total),
+                    else obs_t.reshape(batch_loc, -1)),
+            "actions": act_t.reshape(batch_loc),
+            "action_logp": logp_t.reshape(batch_loc),
+            "advantages": adv.reshape(batch_loc),
+            "value_targets": vtarg.reshape(batch_loc),
         }
 
         (params, opt_state, rng), (losses, auxes) = run_ppo_sgd(
             params, state.opt_state, rng,
             lambda p, mb: loss_fn(p, module, mb),
             lambda idx: {k_: v[idx] for k_, v in flat.items()},
-            batch_total, mb_size, num_mb, config.num_sgd_iter, tx)
+            batch_loc, mb_loc, num_mb, config.num_sgd_iter, tx,
+            sharded=sharded)
 
-        new_state = AnakinState(params, opt_state, env_states, obs, rng,
+        new_state = AnakinState(params, opt_state, env_states, obs,
+                                mesh_util.wrap_rng(rng, sharded),
                                 ep_ret, dsum, dcnt)
         metrics = {
             "total_loss": losses.mean(),
@@ -196,7 +254,12 @@ def make_anakin_ppo(config: AlgorithmConfig):
     # No donate_argnums: freshly-inited zero leaves (opt mu/nu, counters) can
     # share deduped buffers, which XLA rejects as double-donation.  The state
     # here is tiny; donation pays off in the LM train step, not this one.
-    return module, init_fn, jax.jit(train_step), batch_total
+    if sharded:
+        step = mesh_util.shard_train_step(train_step, mesh,
+                                          anakin_state_specs())
+    else:
+        step = jax.jit(train_step)
+    return module, init_fn, step, batch_total
 
 
 class PPO(Algorithm):
@@ -206,6 +269,10 @@ class PPO(Algorithm):
     def _setup_anakin(self):
         if self.config.use_lstm and self.config.use_attention:
             raise ValueError("use_lstm and use_attention are exclusive")
+        if self.config.use_lstm or self.config.use_attention:
+            from ray_tpu.rllib.utils.mesh import reject_data_mesh
+
+            reject_data_mesh(self.config, "recurrent/attention PPO")
         if self.config.use_lstm:
             from ray_tpu.rllib.algorithms.ppo_rnn import make_anakin_ppo_rnn
 

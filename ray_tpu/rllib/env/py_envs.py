@@ -60,10 +60,17 @@ class GymEnvAdapter:
 
     def __init__(self, name: str, seed: Optional[int] = None, **make_kwargs):
         import gymnasium
-        from gymnasium import spaces
 
         self.env = gymnasium.make(name, **make_kwargs)
-        space = self.env.observation_space
+        self._check_spaces(name, self.env)
+        self._next_seed = seed
+
+    def _check_spaces(self, name: str, env) -> None:
+        """Validate + record the env's spaces (split out so wrappers and
+        tests can run the contract check on an arbitrary env object)."""
+        from gymnasium import spaces
+
+        space = env.observation_space
         if not isinstance(space, spaces.Box):
             # Discrete/MultiDiscrete obs have a shape too, but flattening
             # a state INDEX to one float is a near-meaningless encoding —
@@ -73,8 +80,8 @@ class GymEnvAdapter:
                 f"bridgeable (one-hot/embed discrete states in a wrapper "
                 f"first), got {space}")
         self.obs_dim = int(np.prod(space.shape))
-        act = self.env.action_space
-        if hasattr(act, "n"):
+        act = env.action_space
+        if isinstance(act, spaces.Discrete):
             self.num_actions = int(act.n)
             self.action_dim = None
         elif isinstance(act, spaces.Box):
@@ -89,7 +96,6 @@ class GymEnvAdapter:
             raise ValueError(
                 f"gym env {name!r}: only Discrete or Box action spaces "
                 f"are bridgeable, got {act}")
-        self._next_seed = seed
 
     def _flat(self, obs) -> np.ndarray:
         return np.asarray(obs, np.float32).reshape(-1)

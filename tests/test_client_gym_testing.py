@@ -31,11 +31,30 @@ class TestGymBridge:
 
         assert isinstance(make_py_env("CartPole-v1"), PyCartPole)
 
-    def test_continuous_action_space_rejected(self):
+    def test_continuous_action_space_bridges(self):
+        # Box actions are first-class since the SAC/TD3 actor path landed
+        # (they drive gym continuous-control envs); only non-Discrete,
+        # non-Box action spaces are rejected.
         from ray_tpu.rllib.env.py_envs import make_py_env
 
-        with pytest.raises(ValueError, match="Discrete"):
-            make_py_env("Pendulum-v1")
+        env = make_py_env("Pendulum-v1")
+        assert env.num_actions is None and env.action_dim == 1
+        env.reset(seed=0)
+        obs, r, term, trunc, _ = env.step(np.zeros(1, np.float32))
+        assert obs.shape == (3,) and math.isfinite(r)
+
+    def test_unbridgeable_action_space_rejected(self):
+        from gymnasium import spaces
+
+        from ray_tpu.rllib.env.py_envs import GymEnvAdapter
+
+        class _WeirdActions:
+            observation_space = spaces.Box(-1, 1, (2,), np.float32)
+            action_space = spaces.MultiBinary(3)
+
+        adapter = GymEnvAdapter.__new__(GymEnvAdapter)
+        with pytest.raises(ValueError, match="Discrete or Box"):
+            GymEnvAdapter._check_spaces(adapter, "weird", _WeirdActions())
 
     def test_discrete_observation_space_rejected(self):
         # FrozenLake's Discrete(16) obs would flatten to one meaningless
