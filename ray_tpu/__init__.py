@@ -71,12 +71,20 @@ def _boot_head(resources: Dict[str, float], labels=None,
 
 def _apply_job_config(worker, job_config: Optional[dict]) -> None:
     """Job-level defaults → driver worker state (reference: JobConfig's
-    ray_namespace/runtime_env semantics): per-call options still win."""
+    ray_namespace/runtime_env semantics): per-call options still win.
+    Local py_modules paths are packaged + uploaded here (once, at
+    connect) so every spec carrying the default ships pkg:// URIs that
+    resolve on any node; job_config is updated in place so head
+    registration records the normalized form."""
     if not job_config:
         return
     if job_config.get("namespace"):
         worker.namespace = job_config["namespace"]
     if job_config.get("runtime_env"):
+        from ray_tpu._private.runtime_env_pkg import normalize_py_modules
+
+        job_config["runtime_env"] = normalize_py_modules(
+            job_config["runtime_env"], worker.transport)
         worker.default_runtime_env = job_config["runtime_env"]
 
 

@@ -56,6 +56,16 @@ class RemoteDriverRuntime:
                 target=self._read_loop, name="rtpu-driver-reader",
                 daemon=True)
             self._reader.start()
+            # Package local py_modules BEFORE registration so the head's
+            # job record (which pooled workers adopt for nested submits)
+            # carries pkg:// URIs, never driver-local paths.
+            if self._job_config and self._job_config.get("runtime_env"):
+                from ray_tpu._private.runtime_env_pkg import \
+                    normalize_py_modules
+
+                self._job_config = dict(self._job_config)
+                self._job_config["runtime_env"] = normalize_py_modules(
+                    self._job_config["runtime_env"], self.transport)
             self._send_register()
             if not self._registered.wait(timeout):
                 raise TimeoutError(

@@ -315,6 +315,10 @@ class _WorkingDirOverlay:
 
 _workdir_overlay = _WorkingDirOverlay()
 
+from ray_tpu._private.runtime_env_pkg import PyModulesOverlay  # noqa: E402
+
+_pymods_overlay = PyModulesOverlay()
+
 
 def _arena_lease_releaser(transport, oid_bin: bytes, holder_bin: bytes):
     """Standalone finalizer (must not capture the buffer owner) that returns
@@ -487,19 +491,22 @@ class CoreWorker:
         self._ref_gc_queue.append((oid, owner_addr))
         self._ref_gc_wake.set()
 
+    def _drain_ref_gc_queue(self):
+        while self._ref_gc_queue:
+            try:
+                oid, owner_addr = self._ref_gc_queue.popleft()
+            except IndexError:
+                break
+            try:
+                self.remove_local_ref(oid, owner_addr)
+            except Exception:
+                pass
+
     def _ref_gc_loop(self):
         while not self._closed:
             self._ref_gc_wake.wait(timeout=0.5)
             self._ref_gc_wake.clear()
-            while self._ref_gc_queue:
-                try:
-                    oid, owner_addr = self._ref_gc_queue.popleft()
-                except IndexError:
-                    break
-                try:
-                    self.remove_local_ref(oid, owner_addr)
-                except Exception:
-                    pass
+            self._drain_ref_gc_queue()
 
     def remove_local_ref(self, oid: ObjectID, owner_addr: Optional[dict] = None):
         if self._closed:
@@ -1215,6 +1222,7 @@ class CoreWorker:
         results: List[TaskResult] = []
         env_vars: Dict[str, Any] = {}
         workdir_applied = False
+        pymods_applied = False
         renv = spec.runtime_env
         try:
             if renv:
@@ -1231,7 +1239,16 @@ class CoreWorker:
                 if working_dir:
                     _workdir_overlay.apply(working_dir)
                     workdir_applied = True
-                unsupported = set(renv) - {"env_vars", "working_dir"}
+                py_modules = renv.get("py_modules")
+                if py_modules:
+                    from ray_tpu._private.runtime_env_pkg import ensure_local
+
+                    roots = [ensure_local(u, self.transport)
+                             for u in py_modules]
+                    _pymods_overlay.apply(roots)
+                    pymods_applied = True
+                unsupported = set(renv) - {"env_vars", "working_dir",
+                                           "py_modules"}
                 if unsupported:
                     raise exc.RayTpuError(
                         f"runtime_env fields {sorted(unsupported)} are not "
@@ -1301,6 +1318,11 @@ class CoreWorker:
                     _workdir_overlay.adopt()
                 else:
                     _workdir_overlay.restore()
+            if pymods_applied:
+                if spec.task_type == TaskType.ACTOR_CREATION:
+                    _pymods_overlay.adopt()
+                else:
+                    _pymods_overlay.restore()
             # Actor creation keeps the adopted defaults: the worker is
             # dedicated to this actor's job from here on.
             if spec.task_type != TaskType.ACTOR_CREATION:
@@ -1393,15 +1415,7 @@ class CoreWorker:
         # Drain deferred ref drops BEFORE closing: a ref dropped just
         # before shutdown must still send its remove_ref/unpin (the
         # synchronous __del__ path used to guarantee this).
-        while self._ref_gc_queue:
-            try:
-                oid, owner_addr = self._ref_gc_queue.popleft()
-            except IndexError:
-                break
-            try:
-                self.remove_local_ref(oid, owner_addr)
-            except Exception:
-                pass
+        self._drain_ref_gc_queue()
         self._closed = True
         if self._direct is not None:
             try:

@@ -17,6 +17,17 @@ from ray_tpu._private.task_spec import TaskSpec, TaskType
 from ray_tpu.remote_function import _resources_from_options, _strategy_from_options
 
 
+def _normalize_renv(renv, worker):
+    """Package local py_modules into pkg:// URIs at actor creation (the
+    default path is already normalized at connect; this covers per-call
+    .options(runtime_env=...))."""
+    if not renv or not renv.get("py_modules"):
+        return renv
+    from ray_tpu._private.runtime_env_pkg import normalize_py_modules
+
+    return normalize_py_modules(renv, worker.transport)
+
+
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
                  options: Optional[Dict[str, Any]] = None):
@@ -191,10 +202,11 @@ class ActorClass:
                        if opts.get("namespace") is not None
                        else getattr(global_worker, "namespace", None)),
             lifetime=opts.get("lifetime"),
-            runtime_env=(opts.get("runtime_env")
-                         if opts.get("runtime_env") is not None
-                         else getattr(global_worker, "default_runtime_env",
-                                      None)),
+            runtime_env=_normalize_renv(
+                opts.get("runtime_env")
+                if opts.get("runtime_env") is not None
+                else getattr(global_worker, "default_runtime_env", None),
+                global_worker),
         )
         spec.owner_worker_id = global_worker.worker_id
         spec.parent_task_id = global_worker.current_task_id()

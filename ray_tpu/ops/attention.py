@@ -127,10 +127,6 @@ def finalize_blockwise(o, l):
 # reference path OOMs at batch 32 / 1024 ctx on a 16G chip while this
 # doesn't).
 # ---------------------------------------------------------------------------
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
-
-
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal,
                       sm_scale, block_k, seq_len_k):
     import jax.experimental.pallas as pl

@@ -110,6 +110,15 @@ class RemoteFunction:
         # resource vector / strategy / shared knobs once (submission path).
         cached = self._call_cache
         if cached is None:
+            renv_opt = opts.get("runtime_env")
+            if renv_opt and renv_opt.get("py_modules"):
+                # Package + upload local py_modules once per RemoteFunction
+                # (cached): specs must carry pkg:// URIs, not driver paths.
+                from ray_tpu._private.runtime_env_pkg import \
+                    normalize_py_modules
+
+                renv_opt = normalize_py_modules(renv_opt,
+                                                global_worker.transport)
             cached = self._call_cache = (
                 opts.get("name") or self.__name__,
                 _resources_from_options(opts),
@@ -117,7 +126,7 @@ class RemoteFunction:
                 opts.get("num_returns", 1),
                 opts.get("max_retries", 3),
                 bool(opts.get("retry_exceptions", False)),
-                opts.get("runtime_env"),
+                renv_opt,
             )
         name, resources, strategy, num_returns, max_retries, retry_exc, \
             renv = cached

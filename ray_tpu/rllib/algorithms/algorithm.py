@@ -20,16 +20,25 @@ class Algorithm:
         self._num_env_steps_sampled = 0
         self.setup()
 
+    # Opt-in: algorithms whose anakin step implements the shard_map data
+    # mesh set this True (PPO feedforward, IMPALA/APPO).  Fail-closed:
+    # any path without the flag REFUSES num_devices rather than silently
+    # running single-device while the user believes they are N-way DP.
+    _data_mesh_capable = False
+
     # ---- lifecycle ----
     def setup(self):
+        if getattr(self.config, "num_devices", None) is not None \
+                and not (self._data_mesh_capable
+                         and self.config.mode == "anakin"):
+            from ray_tpu.rllib.utils.mesh import reject_data_mesh
+
+            reject_data_mesh(
+                self.config,
+                f"{type(self).__name__} in {self.config.mode} mode")
         if self.config.mode == "anakin":
             self._setup_anakin()
         else:
-            if getattr(self.config, "num_devices", None) is not None:
-                from ray_tpu.rllib.utils.mesh import reject_data_mesh
-
-                reject_data_mesh(self.config, "actor mode (the learner "
-                                 "runs single-device; use anakin mode)")
             self._setup_actor_mode()
 
     def train(self) -> Dict[str, Any]:

@@ -354,9 +354,6 @@ class DQN(Algorithm):
     _default_config_cls = DQNConfig
 
     def _setup_anakin(self):
-        from ray_tpu.rllib.utils.mesh import reject_data_mesh
-
-        reject_data_mesh(self.config, type(self).__name__ + " anakin")
         (self.module, init_fn, self._train_step,
          self._steps_per_iter) = make_anakin_dqn(self.config)
         self._anakin_state = init_fn(self.config.seed)

@@ -60,6 +60,7 @@ def impala_loss(params, module, batch, *, gamma, clip_rho, clip_c,
 
 class IMPALA(Algorithm):
     _default_config_cls = IMPALAConfig
+    _data_mesh_capable = True  # anakin data mesh (APPO inherits)
 
     def _make_loss(self):
         """Loss-fn hook: APPO overrides this to swap in the clipped

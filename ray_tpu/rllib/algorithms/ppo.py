@@ -264,6 +264,7 @@ def make_anakin_ppo(config: AlgorithmConfig):
 
 class PPO(Algorithm):
     _default_config_cls = PPOConfig
+    _data_mesh_capable = True  # feedforward anakin only; guarded below
 
     # ---- anakin mode ----
     def _setup_anakin(self):

@@ -242,9 +242,6 @@ class TD3(Algorithm):
     _default_config_cls = TD3Config
 
     def _setup_anakin(self):
-        from ray_tpu.rllib.utils.mesh import reject_data_mesh
-
-        reject_data_mesh(self.config, type(self).__name__ + " anakin")
         (self.module, init_fn, self._train_step,
          self._steps_per_iter) = make_anakin_td3(self.config)
         self._anakin_state = init_fn(self.config.seed)

@@ -1,0 +1,125 @@
+"""py_modules runtime env: content-hash packaging, head-KV upload, and the
+worker-side URI cache (reference: python/ray/_private/runtime_env/
+packaging.py + uri_cache.py; VERDICT r4 item #4).
+
+The remote-agent test is the done-criterion: a package that exists ONLY
+in the driver's temp dir is imported inside a task pinned to a separate
+agent process whose package cache is a different directory — the bytes
+can only have travelled driver → head KV → worker cache."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env_pkg import (
+    PKG_SCHEME,
+    normalize_py_modules,
+    package_path,
+)
+
+
+def _write_pkg(tmp_path, name="drvpkg", value=41):
+    pkg = tmp_path / name
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(f"MAGIC = {value}\n")
+    (pkg / "extra.py").write_text(textwrap.dedent(f"""
+        def answer():
+            return {value} + 1
+    """))
+    return str(pkg)
+
+
+def test_package_path_content_addressed(tmp_path):
+    p = _write_pkg(tmp_path)
+    uri1, blob1 = package_path(p)
+    uri2, blob2 = package_path(p)
+    assert uri1 == uri2 and uri1.startswith(PKG_SCHEME)
+    assert blob1 == blob2
+    # Any edit changes the URI.
+    (tmp_path / "drvpkg" / "__init__.py").write_text("MAGIC = 99\n")
+    uri3, _ = package_path(p)
+    assert uri3 != uri1
+
+
+def test_py_modules_task_and_actor(tmp_path, shutdown_only):
+    pkg_dir = _write_pkg(tmp_path, value=41)
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024**2)
+
+    @ray_tpu.remote(runtime_env={"py_modules": [pkg_dir]})
+    def use_pkg():
+        import drvpkg
+        from drvpkg.extra import answer
+
+        return drvpkg.MAGIC, answer()
+
+    assert ray_tpu.get(use_pkg.remote()) == (41, 42)
+
+    @ray_tpu.remote(runtime_env={"py_modules": [pkg_dir]})
+    class A:
+        def read(self):
+            import drvpkg
+
+            return drvpkg.MAGIC
+
+    a = A.remote()
+    assert ray_tpu.get(a.read.remote()) == 41
+
+
+def test_normalize_uploads_once(tmp_path, shutdown_only):
+    pkg_dir = _write_pkg(tmp_path, name="oncepkg")
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024**2)
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    env1 = normalize_py_modules({"py_modules": [pkg_dir]}, w.transport)
+    env2 = normalize_py_modules({"py_modules": [pkg_dir]}, w.transport)
+    assert env1["py_modules"] == env2["py_modules"]
+    assert env1["py_modules"][0].startswith(PKG_SCHEME)
+    # pkg:// entries pass through untouched.
+    env3 = normalize_py_modules(env1, w.transport)
+    assert env3["py_modules"] == env1["py_modules"]
+
+
+def test_py_modules_on_remote_agent(tmp_path, shutdown_only):
+    """Driver-local package runs inside a task on a remote agent node
+    with its own (empty) package cache."""
+    ray_tpu.init(num_cpus=1, object_store_memory=128 * 1024**2)
+    head = ray_tpu._head
+    agent_cache = str(tmp_path / "agent_pkg_cache")
+    env = dict(os.environ)
+    env["RTPU_PKG_CACHE"] = agent_cache
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_agent",
+         "--address", f"127.0.0.1:{head.tcp_port}",
+         "--authkey", head.authkey.hex(),
+         "--num-cpus", "2",
+         "--resources", '{"pkgnode": 1}',
+         "--store-capacity", str(128 * 1024 * 1024)],
+        env=env)
+    try:
+        deadline = time.monotonic() + 30
+        while len(head.raylets) < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(head.raylets) >= 2, "agent node never joined"
+
+        pkg_dir = _write_pkg(tmp_path, name="remotepkg", value=7)
+
+        @ray_tpu.remote(resources={"pkgnode": 1},
+                        runtime_env={"py_modules": [pkg_dir]})
+        def use_pkg():
+            import remotepkg
+
+            return remotepkg.MAGIC, os.environ.get("RTPU_PKG_CACHE")
+
+        magic, cache = ray_tpu.get(use_pkg.remote(), timeout=120)
+        assert magic == 7
+        # Proves the worker ran on the agent (separate cache dir) and the
+        # package was materialized there from the KV plane.
+        assert cache == agent_cache
+        assert os.path.isdir(agent_cache) and os.listdir(agent_cache)
+    finally:
+        agent.kill()

@@ -176,6 +176,24 @@ def test_sharded_impala_runs_and_counts_episodes():
     assert algo._prev_counters[1] > 0
 
 
+def test_num_devices_rejected_on_unsupported_paths():
+    """Fail-closed: paths without a shard_map step refuse num_devices
+    instead of silently running single-device."""
+    from ray_tpu.rllib import DQNConfig, PPOConfig
+
+    with pytest.raises(NotImplementedError, match="num_devices"):
+        (DQNConfig().environment("CartPole-v1")
+         .resources(num_devices=2).build())
+    with pytest.raises(NotImplementedError, match="num_devices"):
+        (PPOConfig().environment("CartPole-v1")
+         .training(model={"use_lstm": True})
+         .resources(num_devices=2).build())
+    with pytest.raises(NotImplementedError, match="num_devices"):
+        (PPOConfig().environment("CartPole-v1")
+         .rollouts(num_rollout_workers=1, mode="actor")
+         .resources(num_devices=2).build())
+
+
 def test_num_devices_one_uses_spmd_path():
     """num_devices=1 must compile and run the shard_map path (the real
     chip bench runs exactly this shape)."""
