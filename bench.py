@@ -53,7 +53,14 @@ def peak_flops_for(device_kind: str) -> float:
 
 
 def gpt2_train_loop(config):
-    """Runs inside the Train worker (TPU-visible process)."""
+    """Runs inside the Train worker (TPU-visible process).
+
+    When a "train" dataset shard is attached, every measured step's
+    tokens arrive through the Data plane — get_dataset_shard →
+    iter_device_batches (object-store block fetch + device_put prefetch)
+    — so Data→Train ingest is INSIDE the tokens/s measurement
+    (north-star config: GPT-2 + streaming data; reference analogue
+    python/ray/train/_internal/dataset_spec.py:100)."""
     import functools
 
     import jax
@@ -69,7 +76,20 @@ def gpt2_train_loop(config):
                                 max_position_embeddings=max(1024, S))
     model = GPT2(cfg)
     key = jax.random.PRNGKey(0)
-    ids = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+    iters = config.get("iters", 20)
+
+    shard = session.get_dataset_shard("train")
+    if shard is not None:
+        def batch_stream():
+            while True:  # re-iterate if the shard is shorter than needed
+                for b in shard.iter_device_batches(B):
+                    yield b["tokens"]
+        stream = batch_stream()
+        next_batch = lambda: next(stream)  # noqa: E731
+        ids = next_batch()
+    else:
+        ids = jax.random.randint(key, (B, S), 0, cfg.vocab_size)
+        next_batch = lambda: ids  # noqa: E731
     params = model.init(key, ids)["params"]
     tx = optax.adamw(3e-4)
     opt = tx.init(params)
@@ -84,10 +104,9 @@ def gpt2_train_loop(config):
     params, opt, loss = step(params, opt, ids)
     float(jax.device_get(loss))  # compile + warmup, true host barrier
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    iters = config.get("iters", 20)
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, opt, loss = step(params, opt, ids)
+        params, opt, loss = step(params, opt, next_batch())
     # device_get is the only trustworthy barrier: block_until_ready can
     # return before remote execution finishes on tunneled backends, which
     # silently inflates tokens/s past the chip's physical peak.
@@ -105,6 +124,7 @@ def gpt2_train_loop(config):
         "loss": float(loss),
         "device_kind": kind,
         "n_params": int(n_params),
+        "streaming_ingest": shard is not None,
     })
 
 
@@ -125,9 +145,22 @@ def bench_gpt2() -> dict:
 
     ray_tpu.init(num_cpus=8, num_tpus=1, ignore_reinit_error=True)
     try:
+        import numpy as np
+
+        import ray_tpu.data as rdata
+
+        def token_dataset(batch, seq, iters):
+            """Synthetic token shards in the object store: the measured
+            loop pulls every batch through Data→Train ingest."""
+            rows = batch * (iters + 2)  # warmup + measured, no partials
+            rng = np.random.default_rng(0)
+            toks = rng.integers(0, 50257, size=(rows, seq), dtype=np.int32)
+            return rdata.from_numpy({"tokens": toks}, parallelism=8)
+
         trainer = train.JaxTrainer(
             gpt2_train_loop,
             train_loop_config={"batch": 16, "seq": 1024, "iters": 20},
+            datasets={"train": token_dataset(16, 1024, 20)},
             jax_config=JaxConfig(),
             scaling_config=ScalingConfig(num_workers=1, use_tpu=True,
                                          chips_per_worker=1))
@@ -154,6 +187,7 @@ def bench_gpt2() -> dict:
                     # the measured MFU peak at 4k on a 16G v5e (45.2%
                     # vs 43.0% at b=2, OOM at b=16).
                     train_loop_config={"batch": 4, "seq": 4096, "iters": 10},
+                    datasets={"train": token_dataset(4, 4096, 10)},
                     jax_config=JaxConfig(),
                     scaling_config=ScalingConfig(num_workers=1, use_tpu=True,
                                                  chips_per_worker=1))
@@ -276,6 +310,55 @@ def bench_ppo_breakout() -> dict:
     return out
 
 
+def bench_ppo_real_env() -> dict:
+    """Real-environment anchor (VERDICT r4 #2/#3): actor-path PPO — CPU
+    rollout actors stepping REAL gymnasium LunarLander-v3, learner update
+    on the chip — gated on reward 0 (random ~-200, solved 200; the
+    published scale makes this falsifiable, unlike the rebuilt on-device
+    envs), then actor-path env-steps/s measured.  ALE is not installable
+    here (zero egress); LunarLander is the real-dynamics gate and the
+    pixel wrapper stack is anchored on CarRacing in tests/test_real_env.py."""
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+
+    floor = 0.0
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    try:
+        algo = (PPOConfig()
+                .environment("LunarLander-v3")
+                .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                          rollout_fragment_length=256, mode="actor")
+                .training(lr=3e-4, num_sgd_iter=6, sgd_minibatch_size=512,
+                          entropy_coeff=0.01, gamma=0.999)
+                .debugging(seed=0)
+                .build())
+        floor_met, reward, best = _learn_to_floor(algo, floor,
+                                                  max_iters=120)
+        out = {
+            "ppo_real_env_name": "LunarLander-v3 (gymnasium, actor path)",
+            "ppo_real_env_reward_floor": floor,
+            "ppo_real_env_reward_floor_met": floor_met,
+            "ppo_real_env_reward": round(reward, 2),
+        }
+        if not floor_met:
+            out["ppo_real_env_best_reward"] = round(best, 2)
+            return out
+        steps_per_iter = (algo.config.num_rollout_workers
+                          * algo.config.num_envs_per_worker
+                          * algo.config.rollout_fragment_length)
+        steps_per_s, last_reward = _measure_steps_per_s(
+            algo, steps_per_iter, iters=6)
+        out["ppo_real_env_steps_per_s"] = round(steps_per_s)
+        if last_reward == last_reward:
+            out["ppo_real_env_reward"] = round(last_reward, 2)
+        algo.workers.stop()
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        return {"ppo_real_env_error": f"{type(e).__name__}: {e}"}
+    finally:
+        ray_tpu.shutdown()
+
+
 def _learn_to_floor(algo, floor: float, max_iters: int):
     """Train until the reward floor passes (NaN-safe, with a 10-iter
     stability guard).  Returns (floor_met, gate_reward, best) — the
@@ -340,6 +423,7 @@ def bench_impala_breakout() -> dict:
 
 def main():
     out = bench_gpt2()
+    out.update(bench_ppo_real_env())
     out.update(bench_impala_breakout())
     out.update(bench_ppo_breakout())
     out.update(bench_ppo_atari84())  # last: the headline metric keys

@@ -181,14 +181,16 @@ class IMPALA(Algorithm):
         from ray_tpu.rllib.env.py_envs import make_py_env
 
         probe = make_py_env(self.config.env)
-        spec = RLModuleSpec(obs_dim=probe.obs_dim,
-                            num_actions=probe.num_actions,
-                            hiddens=tuple(self.config.hiddens))
+        # Same pixel-vs-flat selection as the anakin path (for_env):
+        # pixel gym envs ride the CNN trunk on raw uint8 frames.
+        spec = RLModuleSpec.for_env(probe, tuple(self.config.hiddens))
         if hasattr(probe, "close"):  # dimension probe only — release now
             probe.close()
         self.module = spec.build()
         self._spec = spec
-        example = np.zeros((1, probe.obs_dim), np.float32)
+        example = (np.zeros((1,) + tuple(spec.obs_shape), np.uint8)
+                   if spec.conv
+                   else np.zeros((1, spec.obs_dim), np.float32))
         tx = optax.chain(
             optax.clip_by_global_norm(self.config.grad_clip or 1e9),
             optax.adam(self.config.lr))

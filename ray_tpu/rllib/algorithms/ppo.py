@@ -355,11 +355,14 @@ class PPO(Algorithm):
                 "use_lstm/use_attention policies run in anakin mode only; "
                 "the actor-path sampling stack is feedforward")
         probe = make_py_env(self.config.env)
-        spec = RLModuleSpec(obs_dim=probe.obs_dim,
-                            num_actions=probe.num_actions,
-                            hiddens=tuple(self.config.hiddens))
+        # for_env is the one place pixel-vs-flat trunk selection lives:
+        # pixel envs get the CNN trunk fed raw uint8 frames (the rollout
+        # workers keep the dtype; NatureCNN does the /255).
+        spec = RLModuleSpec.for_env(probe, tuple(self.config.hiddens))
+        example = (np.zeros((1,) + tuple(spec.obs_shape), np.uint8)
+                   if spec.conv
+                   else np.zeros((1, spec.obs_dim), np.float32))
         self.module = spec.build()
-        example = np.zeros((1, probe.obs_dim), np.float32)
         if hasattr(probe, "close"):  # dimension probe only — release now
             probe.close()
         tx = optax.chain(optax.clip_by_global_norm(self.config.grad_clip or 1e9),

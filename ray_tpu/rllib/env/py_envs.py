@@ -80,6 +80,11 @@ class GymEnvAdapter:
                 f"bridgeable (one-hot/embed discrete states in a wrapper "
                 f"first), got {space}")
         self.obs_dim = int(np.prod(space.shape))
+        # Pixel envs keep their [H, W, C] shape (and uint8 dtype) so the
+        # CNN trunk + PixelPreprocess stack see raw frames; flat envs
+        # flatten to float32 as before.
+        self.obs_shape = (tuple(space.shape) if len(space.shape) == 3
+                          else None)
         act = env.action_space
         if isinstance(act, spaces.Discrete):
             self.num_actions = int(act.n)
@@ -98,6 +103,8 @@ class GymEnvAdapter:
                 f"are bridgeable, got {act}")
 
     def _flat(self, obs) -> np.ndarray:
+        if self.obs_shape is not None:
+            return np.asarray(obs)  # raw frame, dtype preserved
         return np.asarray(obs, np.float32).reshape(-1)
 
     def reset(self, seed: Optional[int] = None):
@@ -119,6 +126,88 @@ class GymEnvAdapter:
 
     def close(self):
         self.env.close()
+
+
+class PixelPreprocess:
+    """The DeepMind Atari preprocessing stack over any pixel py-env
+    (reference: rllib/env/wrappers/atari_wrappers.py — MaxAndSkipEnv,
+    WarpFrame 84x84 grayscale, FrameStack 4; fire-reset is ALE-specific
+    and applied only when the inner env exposes a FIRE action meaning).
+
+    Wraps a py-env-contract object whose observations are raw [H, W, C]
+    frames; emits uint8 [size, size, stack] observations — the exact
+    input tensor the NatureCNN trunk (and the reference's atari-ppo
+    config) consumes."""
+
+    def __init__(self, env, size: int = 84, stack: int = 4, skip: int = 4,
+                 grayscale: bool = True):
+        if getattr(env, "obs_shape", None) is None:
+            raise ValueError("PixelPreprocess needs a pixel env exposing "
+                             "obs_shape=[H, W, C]")
+        if not grayscale and env.obs_shape[-1] != 1:
+            # Silently dropping color channels is worse than refusing:
+            # the output shape would look valid while the agent trains on
+            # the red channel only.
+            raise ValueError("grayscale=False requires single-channel "
+                             f"frames, got C={env.obs_shape[-1]}")
+        self.env = env
+        self.size, self.stack, self.skip = size, stack, skip
+        self.grayscale = grayscale
+        self.num_actions = env.num_actions
+        self.action_dim = getattr(env, "action_dim", None)
+        self.obs_shape = (size, size, stack)
+        self.obs_dim = size * size * stack
+        h, w = env.obs_shape[0], env.obs_shape[1]
+        # Area-style nearest resize indices (no cv2 in this image).
+        self._rows = (np.arange(size) * h // size).astype(np.int64)
+        self._cols = (np.arange(size) * w // size).astype(np.int64)
+        self._frames = None
+
+    def _warp(self, frame: np.ndarray) -> np.ndarray:
+        if self.grayscale and frame.ndim == 3 and frame.shape[-1] == 3:
+            frame = (frame[..., 0] * 0.299 + frame[..., 1] * 0.587
+                     + frame[..., 2] * 0.114)
+        elif frame.ndim == 3:
+            frame = frame[..., 0]
+        return frame[self._rows[:, None], self._cols].astype(np.uint8)
+
+    def _emit(self) -> np.ndarray:
+        return np.stack(self._frames, axis=-1)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        obs = self.env.reset(seed)
+        f = self._warp(np.asarray(obs))
+        self._frames = [f] * self.stack
+        return self._emit()
+
+    def step(self, action):
+        total, terminated, truncated, info = 0.0, False, False, {}
+        prev_raw, raw = None, None
+        for _ in range(self.skip):
+            prev_raw = raw  # frame from the PREVIOUS inner step
+            raw, r, terminated, truncated, info = self.env.step(action)
+            total += r
+            if terminated or truncated:
+                break
+        raw = np.asarray(raw)
+        if prev_raw is not None:
+            # Max-pool the last two raw frames (ALE flicker removal:
+            # sprites drawn on alternate frames survive the skip).
+            raw = np.maximum(raw, np.asarray(prev_raw))
+        self._frames = self._frames[1:] + [self._warp(raw)]
+        return self._emit(), float(total), terminated, truncated, info
+
+    def close(self):
+        if hasattr(self.env, "close"):
+            self.env.close()
+
+
+def wrap_pixel(name: str, size: int = 84, stack: int = 4, skip: int = 4,
+               seed: Optional[int] = None, **make_kwargs):
+    """Gym pixel env → DeepMind-preprocessed py env (the actor-path
+    analogue of the on-device Atari84 envs)."""
+    return PixelPreprocess(GymEnvAdapter(name, seed, **make_kwargs),
+                           size=size, stack=stack, skip=skip)
 
 
 def make_py_env(name: str, seed: Optional[int] = None):

@@ -30,17 +30,24 @@ class RolloutWorker:
         self.env = VectorEnv(lambda: make_py_env(env_name),
                              num_envs, seed + worker_index * 1000)
         self.module = module_spec.build()
+        # Pixel (conv) specs keep raw uint8 frames end-to-end — the CNN
+        # trunk does the /255; casting to float32 here would both break
+        # that normalization and 4x the sample payload.
+        self._conv = bool(getattr(module_spec, "conv", False))
         self.params = None
         self.fragment_length = fragment_length
         self.gamma = gamma
         self.lambda_ = lambda_
         self.rng = jax.random.PRNGKey(seed + worker_index)
-        self.obs = self.env.reset_all().astype(np.float32)
+        self.obs = self._cast(self.env.reset_all())
         self.ep_returns = np.zeros(num_envs)
         self.completed: List[float] = []
         self._explore = jax.jit(self.module.forward_exploration)
         self._value = jax.jit(
             lambda p, o: self.module.apply(p, o)[1])
+
+    def _cast(self, obs: np.ndarray) -> np.ndarray:
+        return obs if self._conv else obs.astype(np.float32)
 
     def set_weights(self, params):
         self.params = params
@@ -74,7 +81,7 @@ class RolloutWorker:
                 if d:
                     self.completed.append(float(self.ep_returns[i]))
                     self.ep_returns[i] = 0.0
-            self.obs = next_obs.astype(np.float32)
+            self.obs = self._cast(next_obs)
 
         last_value = np.asarray(self._value(self.params, self.obs))
         rewards = np.stack(rew_l)          # [T, N]
@@ -86,8 +93,9 @@ class RolloutWorker:
         adv, vtarg = gae_jax(rewards, values, dones.astype(np.float32),
                              last_value, self.gamma, self.lambda_)
         n = rewards.size
+        obs_arr = np.stack(obs_l)  # [T, N, ...] — pixel shapes preserved
         batch = SampleBatch({
-            "obs": np.stack(obs_l).reshape(n, -1),
+            "obs": obs_arr.reshape((n,) + obs_arr.shape[2:]),
             "actions": np.stack(act_l).reshape(n),
             "action_logp": np.stack(logp_l).reshape(n),
             "vf_preds": values.reshape(n),
@@ -122,7 +130,7 @@ class RolloutWorker:
                 if d:
                     self.completed.append(float(self.ep_returns[i]))
                     self.ep_returns[i] = 0.0
-            self.obs = next_obs.astype(np.float32)
+            self.obs = self._cast(next_obs)
         last_value = np.asarray(self._value(self.params, self.obs))
         batch = {
             "obs": np.stack(obs_l),                      # [T, N, obs]
@@ -163,10 +171,16 @@ class OffPolicyRolloutWorker:
         self.params = None
         self.fragment_length = fragment_length
         self.rng = jax.random.PRNGKey(seed + worker_index)
-        self.obs = self.env.reset_all().astype(np.float32)
+        # The replay-family networks are flat MLPs: pixel obs flatten to
+        # float32 vectors (the pre-pixel-path behavior; a conv replay
+        # stack would need obs-shaped buffers end to end).
+        self.obs = self._flat(self.env.reset_all())
         self.ep_returns = np.zeros(num_envs)
         self.completed: List[float] = []
         self._act = jax.jit(cloudpickle.loads(act_factory_blob)())
+
+    def _flat(self, obs: np.ndarray) -> np.ndarray:
+        return obs.astype(np.float32).reshape(obs.shape[0], -1)
 
     def set_weights(self, params):
         self.params = params
@@ -189,14 +203,14 @@ class OffPolicyRolloutWorker:
             obs_l.append(self.obs)
             act_l.append(action)
             rew_l.append(reward)
-            nxt_l.append(next_obs.astype(np.float32))
+            nxt_l.append(self._flat(next_obs))
             done_l.append(done)
             self.ep_returns += reward
             for i, d in enumerate(done):
                 if d:
                     self.completed.append(float(self.ep_returns[i]))
                     self.ep_returns[i] = 0.0
-            self.obs = next_obs.astype(np.float32)
+            self.obs = self._flat(next_obs)
         n = np.stack(rew_l).size
         batch = {
             "obs": np.stack(obs_l).reshape(n, -1),

@@ -1,0 +1,134 @@
+"""Real-environment correctness anchors (VERDICT r4 item #3).
+
+The on-device Atari84 envs are rebuilt dynamics; these tests anchor the
+stack on REAL gymnasium environments so reward claims are falsifiable:
+
+- the DeepMind preprocessing stack (grayscale/84x84/skip+maxpool/stack,
+  reference rllib/env/wrappers/atari_wrappers.py) is unit-tested against
+  exact expected arithmetic and driven over real CarRacing-v3 pixels
+  (ALE is not installable in this image — zero egress — so CarRacing is
+  the real pixel env);
+- actor-path PPO must LEARN real LunarLander-v3 (Box2D dynamics, public
+  reward scale: random ~-200, solved 200) — the learning gate;
+- actor-path PPO + NatureCNN runs end-to-end on real CarRacing frames
+  (its ~12 wrapped steps/s/env makes a learning gate infeasible; the
+  pipeline anchor is shape/dtype/finite-loss).
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.env.py_envs import PixelPreprocess, wrap_pixel
+
+
+class _FakePixelEnv:
+    """Deterministic 8x8 RGB env: pixel value == step count."""
+
+    def __init__(self):
+        self.num_actions = 3
+        self.obs_shape = (8, 8, 3)
+        self.t = 0
+
+    def _frame(self):
+        return np.full((8, 8, 3), min(self.t, 255), np.uint8)
+
+    def reset(self, seed=None):
+        self.t = 0
+        return self._frame()
+
+    def step(self, action):
+        self.t += 1
+        return self._frame(), 1.0, self.t >= 100, False, {}
+
+
+class TestPixelPreprocess:
+    def test_warp_stack_skip_arithmetic(self):
+        env = PixelPreprocess(_FakePixelEnv(), size=4, stack=3, skip=2,
+                              grayscale=True)
+        obs = env.reset()
+        assert obs.shape == (4, 4, 3) and obs.dtype == np.uint8
+        assert np.all(obs == 0)  # reset frame replicated across the stack
+        obs, r, term, trunc, _ = env.step(0)
+        # skip=2: two inner steps happened, reward summed, frame max-pooled
+        # over the raw pair (values 1 and 2 -> 2; grayscale of uniform
+        # gray v is v to rounding).
+        assert r == 2.0
+        assert np.all(obs[..., :2] == 0) and np.all(obs[..., 2] >= 1)
+        obs2, *_ = env.step(0)
+        # Stack shifts by exactly one processed frame per wrapped step.
+        np.testing.assert_array_equal(obs2[..., 1], obs[..., 2])
+
+    def test_episode_end_mid_skip_stops_early(self):
+        env = PixelPreprocess(_FakePixelEnv(), size=4, stack=2, skip=4)
+        env.reset()
+        for _ in range(30):
+            _, _, term, trunc, _ = env.step(0)
+            if term or trunc:
+                break
+        assert term  # 100 inner steps / 4-skip = 25 wrapped steps max
+
+    def test_real_carracing_frames(self):
+        env = wrap_pixel("CarRacing-v3", skip=4, continuous=False)
+        obs = env.reset(seed=0)
+        assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+        assert env.num_actions == 5
+        obs2, r, term, trunc, _ = env.step(3)  # gas
+        assert obs2.shape == (84, 84, 4) and np.isfinite(r)
+        # Real frames have actual image content, not a constant field.
+        assert obs2.std() > 1.0
+        env.close()
+
+
+@pytest.mark.slow
+def test_actor_path_ppo_learns_real_lunarlander(shutdown_only):
+    """The real-env learning gate: PPO through CPU rollout actors on
+    gymnasium's LunarLander-v3 must improve from random (~-200) to >= -50
+    (untuned random policies essentially never reach this; PPO passes 0
+    within the budget on this recipe)."""
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024**2)
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("LunarLander-v3")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
+                      rollout_fragment_length=256, mode="actor")
+            .training(lr=3e-4, num_sgd_iter=6, sgd_minibatch_size=512,
+                      entropy_coeff=0.01, gamma=0.999)
+            .debugging(seed=0)
+            .build())
+    first, best = None, float("-inf")
+    for _ in range(45):
+        m = algo.train()
+        r = m.get("episode_reward_mean", float("nan"))
+        if np.isfinite(r):
+            if first is None:
+                first = r
+            best = max(best, r)
+        if best >= -50:
+            break
+    algo.workers.stop()
+    assert best >= -50, (f"actor-path PPO failed to learn real "
+                         f"LunarLander: first={first} best={best}")
+
+
+@pytest.mark.slow
+def test_actor_path_ppo_real_pixels_end_to_end(shutdown_only):
+    """NatureCNN actor path over real CarRacing pixels: uint8 frames ride
+    the object store unflattened, the learner update is finite."""
+    ray_tpu.init(num_cpus=6, object_store_memory=512 * 1024**2)
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env.py_envs import wrap_pixel
+
+    algo = (PPOConfig()
+            .environment(lambda: wrap_pixel("CarRacing-v3", skip=4,
+                                            continuous=False))
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=2,
+                      rollout_fragment_length=16, mode="actor")
+            .training(lr=1e-4, num_sgd_iter=1, sgd_minibatch_size=32)
+            .build())
+    assert algo.module.spec.conv  # probe picked the CNN trunk
+    m = {}
+    for _ in range(2):
+        m = algo.train()
+    algo.workers.stop()
+    assert np.isfinite(m["total_loss"])
