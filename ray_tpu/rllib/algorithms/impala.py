@@ -188,9 +188,7 @@ class IMPALA(Algorithm):
             probe.close()
         self.module = spec.build()
         self._spec = spec
-        example = (np.zeros((1,) + tuple(spec.obs_shape), np.uint8)
-                   if spec.conv
-                   else np.zeros((1, spec.obs_dim), np.float32))
+        example = spec.example_obs()
         tx = optax.chain(
             optax.clip_by_global_norm(self.config.grad_clip or 1e9),
             optax.adam(self.config.lr))

@@ -359,9 +359,7 @@ class PPO(Algorithm):
         # pixel envs get the CNN trunk fed raw uint8 frames (the rollout
         # workers keep the dtype; NatureCNN does the /255).
         spec = RLModuleSpec.for_env(probe, tuple(self.config.hiddens))
-        example = (np.zeros((1,) + tuple(spec.obs_shape), np.uint8)
-                   if spec.conv
-                   else np.zeros((1, spec.obs_dim), np.float32))
+        example = spec.example_obs()
         self.module = spec.build()
         if hasattr(probe, "close"):  # dimension probe only — release now
             probe.close()

@@ -10,6 +10,7 @@ from typing import Any, Optional, Sequence, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ray_tpu.models.mlp import MLP
 from ray_tpu.models.nature_cnn import MinAtarCNN, NatureCNN
@@ -25,6 +26,15 @@ class RLModuleSpec:
 
     def build(self) -> "DiscreteActorCritic":
         return DiscreteActorCritic(self)
+
+    def example_obs(self, batch: int = 1) -> np.ndarray:
+        """A zero observation batch matching this spec's trunk input —
+        uint8 frames for the conv trunk (NatureCNN does the /255), flat
+        float32 vectors otherwise.  The one place example-obs shape/dtype
+        selection lives (actor-mode learner init uses this)."""
+        if self.conv:
+            return np.zeros((batch,) + tuple(self.obs_shape), np.uint8)
+        return np.zeros((batch, self.obs_dim), np.float32)
 
     @classmethod
     def for_env(cls, env, hiddens: Tuple[int, ...]) -> "RLModuleSpec":
