@@ -415,7 +415,7 @@ def bench_impala_breakout() -> dict:
     num_envs, unroll = 16384, 64
     out = {"impala_reward_floor": floor, "impala_margin_target": target}
     tried = []
-    gate_algo, gate_reward, gate_seed = None, float("-inf"), None
+    gate_reward, gate_seed = float("-inf"), None
     for seed in (0, 1, 2):
         algo = (IMPALAConfig().environment("Breakout-MinAtar-v0")
                 .anakin(num_envs=num_envs, unroll_length=unroll)
@@ -430,20 +430,23 @@ def bench_impala_breakout() -> dict:
                       "best": round(best, 2) if best > float("-inf")
                       else None})
         if floor_met and reward > gate_reward:
-            gate_algo, gate_reward, gate_seed = algo, reward, seed
+            gate_reward, gate_seed = reward, seed
+            # Measure throughput NOW on this passing seed's live state —
+            # keeping the algo alive while the next seed builds would
+            # double the 16384-env device footprint.
+            steps_per_s, last_reward = _measure_steps_per_s(
+                algo, num_envs * unroll)
+            out["impala_env_steps_per_s"] = round(steps_per_s)
+            if last_reward == last_reward:
+                out["impala_episode_reward_mean"] = round(last_reward, 2)
+        del algo  # free HBM before the next seed compiles
         if floor_met and reward >= target:
             break
     out["impala_seeds_tried"] = tried
-    out["impala_reward_floor_met"] = gate_algo is not None
+    out["impala_reward_floor_met"] = gate_seed is not None
     out["impala_gate_seed"] = gate_seed
-    if gate_algo is None:
-        return out
-    out["impala_gate_reward"] = round(gate_reward, 2)
-    steps_per_s, last_reward = _measure_steps_per_s(gate_algo,
-                                                    num_envs * unroll)
-    out["impala_env_steps_per_s"] = round(steps_per_s)
-    if last_reward == last_reward:
-        out["impala_episode_reward_mean"] = round(last_reward, 2)
+    if gate_seed is not None:
+        out["impala_gate_reward"] = round(gate_reward, 2)
     return out
 
 

@@ -38,8 +38,7 @@ import ray_tpu
 from ray_tpu.data import block as block_mod
 
 
-@ray_tpu.remote
-def _apply_stage(blk, kind: str, fn, batch_format: str):
+def _apply_stage_local(blk, kind: str, fn, batch_format: str):
     if kind == "map_batches":
         return block_mod.apply_batch_fn(blk, fn, batch_format)
     if kind == "filter":
@@ -48,6 +47,24 @@ def _apply_stage(blk, kind: str, fn, batch_format: str):
         mask = [bool(fn(row)) for row in blk.to_pylist()]
         return blk.filter(pa.array(mask))
     raise ValueError(kind)
+
+
+@ray_tpu.remote
+def _apply_stage(blk, kind: str, fn, batch_format: str):
+    return _apply_stage_local(blk, kind, fn, batch_format)
+
+
+@ray_tpu.remote
+def _fused_read_apply(reader, path: str, columns, stages):
+    """Operator fusion (the logical optimizer's one rewrite that matters
+    for this executor): read + every chained per-block stage execute in
+    ONE task, so a read→map→filter pipeline costs one store write per
+    block instead of one per stage (reference: the Read→MapBatches fusion
+    in data/_internal/logical/optimizers.py)."""
+    blk = reader(path, columns)
+    for kind, fn, batch_format in stages:
+        blk = _apply_stage_local(blk, kind, fn, batch_format)
+    return blk
 
 
 @ray_tpu.remote
@@ -71,6 +88,23 @@ def _combine_parts(seed: int, *parts):
     rng = np.random.default_rng(seed)
     order = rng.permutation(out.num_rows)
     return out.take(order)
+
+
+@ray_tpu.remote
+def _merge_parts(*parts):
+    """Push-shuffle merge: fold a round of mapper parts into the
+    partition's accumulator (reference: the merge stage of
+    push_based_shuffle.py — merges pipeline WITH the map rounds, so
+    mapper outputs never pile up as thousands of tiny store objects)."""
+    return block_mod.concat_blocks([p for p in parts if p is not None])
+
+
+@ray_tpu.remote
+def _finalize_partition(seed: int, blk):
+    if blk is None:
+        return block_mod.block_from_items([])
+    rng = np.random.default_rng(seed)
+    return blk.take(rng.permutation(blk.num_rows))
 
 
 class StreamingDataset:
@@ -98,13 +132,13 @@ class StreamingDataset:
 
     @staticmethod
     def read(paths, fmt: str, columns=None, **kw) -> "StreamingDataset":
-        from ray_tpu.data.dataset import _read_file
         from ray_tpu.data.datasource import expand_paths, resolve_datasource
 
         reader = resolve_datasource(fmt)
-        thunks = [(lambda p=p: _read_file.remote(reader, p, columns))
-                  for p in expand_paths(paths)]
-        return StreamingDataset(thunks, **kw)
+        # Structured descriptors (not opaque thunks) so the planner can
+        # fuse the read with downstream per-block stages into one task.
+        sources = [("read", reader, p, columns) for p in expand_paths(paths)]
+        return StreamingDataset(sources, **kw)
 
     def _derive(self, stages) -> "StreamingDataset":
         return StreamingDataset(self._sources, stages, self.store_budget,
@@ -118,9 +152,43 @@ class StreamingDataset:
     def filter(self, fn) -> "StreamingDataset":
         return self._derive(self._stages + [("filter", fn, "numpy")])
 
-    def random_shuffle(self, seed: Optional[int] = None
-                       ) -> "StreamingDataset":
-        return self._derive(self._stages + [("shuffle", seed, None)])
+    def random_shuffle(self, seed: Optional[int] = None,
+                       full: bool = False) -> "StreamingDataset":
+        """``full=False``: window-scoped two-phase shuffle (mixing radius
+        = the in-flight window — cheap, bounded, the right default for
+        epoch-style ML shuffling).  ``full=True``: push-based FULL
+        shuffle — every output block draws from every input block
+        (reference semantics, push_based_shuffle.py); the dataset is
+        accumulated across P partition accumulators (spilling past the
+        store budget) while scratch stays round-bounded."""
+        if self._shuffle_stages:
+            # Only shuffles[0] executes; silently dropping a second
+            # (possibly full-radius) shuffle would be a wrong-results bug.
+            raise ValueError("this pipeline already has a shuffle stage; "
+                             "chain at most one random_shuffle")
+        kind = "push_shuffle" if full else "shuffle"
+        return self._derive(self._stages + [(kind, seed, None)])
+
+    def explain(self) -> str:
+        """The logical plan after fusion, one operator per line."""
+        per_block = [s[0] for s in self._per_block_stages]
+        fused_reads = sum(1 for s in self._sources
+                          if isinstance(s, tuple) and s[0] == "read")
+        lines = []
+        if fused_reads:
+            fused = " -> ".join(["read"] + per_block)
+            lines.append(f"Fused[{fused}] x{fused_reads} sources "
+                         "(1 task/block)")
+        else:
+            lines.append(f"Sources x{len(self._sources)}")
+            for s in per_block:
+                lines.append(f"  -> {s} (1 task/block)")
+        for s in self._stages:
+            if s[0] == "shuffle":
+                lines.append("  -> shuffle[window-scoped]")
+            elif s[0] == "push_shuffle":
+                lines.append("  -> shuffle[push-based, full radius]")
+        return "\n".join(lines)
 
     # ---------------- execution ----------------
     def _window_size(self, first_ref) -> int:
@@ -141,20 +209,28 @@ class StreamingDataset:
         # Half the budget: map stages briefly hold input+output per block.
         return max(2, int(self.store_budget * 0.5 // max(1, info["size"])))
 
-    def _chain(self, ref):
-        """Apply per-block stages (up to but excluding any shuffle) to one
-        source ref, dropping intermediate refs as we go."""
-        for kind, fn, batch_format in self._per_block_stages:
+    def _chain_source(self, src):
+        """Materialize one source with every per-block stage applied:
+        structured read sources fuse read+stages into ONE task; opaque
+        thunks fall back to a task per stage."""
+        stages = self._per_block_stages
+        if isinstance(src, tuple) and src[0] == "read":
+            _, reader, path, columns = src
+            return _fused_read_apply.remote(reader, path, columns, stages)
+        ref = src()
+        for kind, fn, batch_format in stages:
             ref = _apply_stage.remote(ref, kind, fn, batch_format)
         return ref
 
     @property
     def _per_block_stages(self):
-        return [s for s in self._stages if s[0] != "shuffle"]
+        return [s for s in self._stages
+                if s[0] not in ("shuffle", "push_shuffle")]
 
     @property
     def _shuffle_stages(self):
-        return [s for s in self._stages if s[0] == "shuffle"]
+        return [s for s in self._stages
+                if s[0] in ("shuffle", "push_shuffle")]
 
     def iter_block_refs(self) -> Iterator[Any]:
         """The executor: yields output block refs, ≤ window in flight.
@@ -166,21 +242,25 @@ class StreamingDataset:
         first = next(sources, None)
         if first is None:
             return
-        first_src_ref = first()
-        # Measure the first block to size the window (waits for it).
-        ray_tpu.wait([first_src_ref], num_returns=1, timeout=300)
-        window = self._window_size(first_src_ref)
-        pending.append(self._chain(first_src_ref))
-        del first_src_ref
+        first_ref = self._chain_source(first)
+        # Measure the first (fused) output block to size the window.
+        ray_tpu.wait([first_ref], num_returns=1, timeout=300)
+        window = self._window_size(first_ref)
+        pending.append(first_ref)
+        del first_ref
 
         def fill():
             while len(pending) < window:
-                thunk = next(sources, None)
-                if thunk is None:
+                src = next(sources, None)
+                if src is None:
                     return False
-                pending.append(self._chain(thunk()))
+                pending.append(self._chain_source(src))
             return True
 
+        if shuffles and shuffles[0][0] == "push_shuffle":
+            yield from self._push_shuffle_refs(pending, sources, window,
+                                               shuffles[0][1])
+            return
         if not shuffles:
             fill()
             while pending:
@@ -219,6 +299,75 @@ class StreamingDataset:
                 del ref
             outs = None
             group_idx += 1
+
+    def _push_shuffle_refs(self, pending, sources, window, seed_base):
+        """Push-based FULL shuffle (reference: push_based_shuffle.py's
+        pipelined map+merge rounds).  Map tasks partition each block into
+        P parts; after every window-sized round the parts FOLD into P
+        per-partition accumulators (one merge task each), so live scratch
+        is one round of parts — never the full P x num_blocks part
+        matrix.  The accumulators jointly hold the whole dataset (the
+        store spills past its budget; a full shuffle cannot emit row one
+        until the last input row is seen), and finalize permutes each
+        partition into an output block."""
+        P = max(1, len(self._sources))
+        rng = random.Random(seed_base)
+        seed0 = (seed_base if seed_base is not None
+                 else rng.randrange(2**31))
+        accs: List[Any] = [None] * P
+        parts_held: List[List[Any]] = [[] for _ in range(P)]
+        blk_idx = 0
+        # Fold cadence: merging every round would rewrite the whole
+        # accumulated prefix each round (O(dataset x rounds) IO); holding
+        # up to ~8 mapped blocks' parts per fold amortizes that while
+        # keeping scratch bounded to fold_every rounds of parts.
+        fold_every = max(1, 8 // max(1, window))
+        rounds_since_fold = 0
+
+        def fold():
+            folded = []
+            for j in range(P):
+                if not parts_held[j]:
+                    continue
+                prev = [accs[j]] if accs[j] is not None else []
+                accs[j] = _merge_parts.remote(*prev, *parts_held[j])
+                parts_held[j] = []
+                folded.append(accs[j])
+            # Barrier: the held part refs die when these merges land.
+            if folded:
+                ray_tpu.wait(folded, num_returns=len(folded), timeout=600)
+
+        while True:
+            batch, pending = list(pending), []
+            while len(batch) < window:
+                src = next(sources, None)
+                if src is None:
+                    break
+                batch.append(self._chain_source(src))
+            if not batch:
+                break
+            for b in batch:
+                parts = _partition_block.options(num_returns=P).remote(
+                    b, P, seed0 + blk_idx)
+                if P == 1:
+                    parts = [parts]
+                blk_idx += 1
+                for j in range(P):
+                    parts_held[j].append(parts[j])
+            del batch
+            rounds_since_fold += 1
+            if rounds_since_fold >= fold_every:
+                fold()
+                rounds_since_fold = 0
+        fold()
+        for j in range(P):
+            have = [accs[j]] if accs[j] is not None else []
+            if not have:
+                continue
+            out = _finalize_partition.remote(seed0 + 31 + j, accs[j])
+            accs[j] = None
+            yield out
+            del out
 
     def iter_batches(self, batch_size: int = 256,
                      batch_format: str = "numpy",

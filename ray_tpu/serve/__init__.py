@@ -21,6 +21,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     get_deployment_handle,
     run,
     shutdown,
+    start_http_proxies,
     start_http_proxy,
 )
 from ray_tpu.serve.autoscaling import calculate_desired_num_replicas  # noqa: F401

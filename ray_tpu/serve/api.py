@@ -115,6 +115,25 @@ class DeploymentHandle:
             self._replicas.append(replica)
             self._in_flight[replica] = 0
 
+    def set_replicas(self, replicas):
+        """Swap the replica set IN PLACE, matching by actor id: retained
+        replicas keep their handle objects (so outstanding requests'
+        done-callbacks still decrement the live counters — a rebuilt
+        handle would zero the autoscaling signal on every broadcast)."""
+        with self._lock:
+            by_id = {r._actor_id: r for r in self._replicas}
+            new_list = []
+            for r in replicas:
+                existing = by_id.pop(getattr(r, "_actor_id", None), None)
+                if existing is not None:
+                    new_list.append(existing)
+                else:
+                    new_list.append(r)
+                    self._in_flight[r] = 0
+            self._replicas = new_list
+            for gone in by_id.values():
+                self._in_flight.pop(gone, None)
+
     def pop_replica(self):
         """Remove (and return) the least-loaded replica, or None at size 1.
 
@@ -233,10 +252,13 @@ def run(dep: Deployment, name: Optional[str] = None) -> DeploymentHandle:
     """serve.run (reference: serve/api.py:455)."""
     key = name or dep.name
     old = _deployments.pop(key, None)
-    if old is not None:
-        old._teardown()
     handle = dep._deploy()
     _deployments[key] = dep
+    # Broadcast the NEW replicas before tearing down the old ones, so
+    # node proxies never route into the teardown window.
+    broadcast_routes()
+    if old is not None:
+        old._teardown()
     return handle
 
 
@@ -246,6 +268,8 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 
 def delete(name: str):
     dep = _deployments.pop(name, None)
+    # Unroute everywhere first, then kill.
+    broadcast_routes()
     if dep is not None:
         dep._teardown()
 
@@ -257,92 +281,116 @@ def shutdown():
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
+    for p in _node_proxies:
+        try:
+            ray_tpu.kill(p)
+        except Exception:
+            pass
+    _node_proxies.clear()
     from ray_tpu.serve.controller import reset_controller
 
     reset_controller()
+
+
+def _make_http_handler(resolve):
+    """HTTP handler class over a route resolver: ``resolve(name)`` →
+    (DeploymentHandle, is_ingress) or None.  The driver proxy resolves
+    against the live ``_deployments`` registry; per-node proxy ACTORS
+    resolve against their broadcast route table — one handler, two
+    routers (reference: HTTPProxy's shared request path,
+    serve/_private/http_proxy.py:230)."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _route(self):
+            from urllib.parse import urlsplit
+
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            split = urlsplit(self.path)
+            # Name comes from the PATH only — '/echo?x=1' must route
+            # to 'echo', not 404 on a name containing the query.
+            name = split.path.strip("/").split("/")[0]
+            resolved = resolve(name)
+            if resolved is None:
+                self.send_response(404)
+                self.end_headers()
+                self.wfile.write(b'{"error": "no such deployment"}')
+                return
+            handle, is_ingress = resolved
+            if is_ingress:
+                # ASGI path: ship the full request dict; the replica
+                # drives the app and returns {status, headers, body}.
+                sub = split.path[len(name) + 1:] or "/"
+                req = {"method": self.command, "path": sub,
+                       "query_string": split.query,
+                       "headers": list(self.headers.items()),
+                       "body": body}
+                try:
+                    resp = ray_tpu.get(handle.remote(req))
+                except Exception as e:  # noqa: BLE001
+                    out = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                    return
+                payload = resp.get("body") or b""
+                self.send_response(resp.get("status", 200))
+                hdrs = resp.get("headers") or []
+                hdrs = hdrs.items() if isinstance(hdrs, dict) else hdrs
+                for k, v in hdrs:
+                    if k.lower() != "content-length":
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            if self.command != "POST":
+                # Plain JSON deployments keep the POST-only contract:
+                # stray GETs (crawlers, health checks) must not invoke
+                # user code with a None payload.
+                self.send_response(405)
+                self.end_headers()
+                self.wfile.write(b'{"error": "POST only"}')
+                return
+            try:
+                payload = json.loads(body) if body else None
+                result = ray_tpu.get(handle.remote(payload))
+                out = json.dumps({"result": result}).encode()
+                self.send_response(200)
+            except Exception as e:  # noqa: BLE001
+                out = json.dumps({"error": str(e)}).encode()
+                self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        do_POST = do_GET = do_PUT = do_DELETE = do_PATCH = _route
+
+        def log_message(self, *a):
+            pass
+
+    return Handler
+
+
+def _driver_resolve(name: str):
+    dep = _deployments.get(name)
+    if dep is None or dep.handle is None:
+        return None
+    return dep.handle, bool(getattr(dep, "is_ingress", False))
 
 
 class _HttpProxy:
     """Threaded stdlib HTTP server forwarding POST /<deployment> bodies
     (JSON) to handles (reference: HTTPProxy ASGI actor)."""
 
-    def __init__(self, port: int):
+    def __init__(self, port: int, resolve=None, bind: str = "127.0.0.1"):
         import http.server
 
-        proxy = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def _route(self):
-                from urllib.parse import urlsplit
-
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length) if length else b""
-                split = urlsplit(self.path)
-                # Name comes from the PATH only — '/echo?x=1' must route
-                # to 'echo', not 404 on a name containing the query.
-                name = split.path.strip("/").split("/")[0]
-                dep = _deployments.get(name)
-                if dep is None or dep.handle is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "no such deployment"}')
-                    return
-                if getattr(dep, "is_ingress", False):
-                    # ASGI path: ship the full request dict; the replica
-                    # drives the app and returns {status, headers, body}.
-                    sub = split.path[len(name) + 1:] or "/"
-                    req = {"method": self.command, "path": sub,
-                           "query_string": split.query,
-                           "headers": list(self.headers.items()),
-                           "body": body}
-                    try:
-                        resp = ray_tpu.get(dep.handle.remote(req))
-                    except Exception as e:  # noqa: BLE001
-                        out = json.dumps({"error": str(e)}).encode()
-                        self.send_response(500)
-                        self.send_header("Content-Length", str(len(out)))
-                        self.end_headers()
-                        self.wfile.write(out)
-                        return
-                    payload = resp.get("body") or b""
-                    self.send_response(resp.get("status", 200))
-                    hdrs = resp.get("headers") or []
-                    hdrs = hdrs.items() if isinstance(hdrs, dict) else hdrs
-                    for k, v in hdrs:
-                        if k.lower() != "content-length":
-                            self.send_header(k, v)
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                    return
-                if self.command != "POST":
-                    # Plain JSON deployments keep the POST-only contract:
-                    # stray GETs (crawlers, health checks) must not invoke
-                    # user code with a None payload.
-                    self.send_response(405)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "POST only"}')
-                    return
-                try:
-                    payload = json.loads(body) if body else None
-                    result = ray_tpu.get(dep.handle.remote(payload))
-                    out = json.dumps({"result": result}).encode()
-                    self.send_response(200)
-                except Exception as e:  # noqa: BLE001
-                    out = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(out)))
-                self.end_headers()
-                self.wfile.write(out)
-
-            do_POST = do_GET = do_PUT = do_DELETE = do_PATCH = _route
-
-            def log_message(self, *a):
-                pass
-
-        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
-                                                      Handler)
+        handler = _make_http_handler(resolve or _driver_resolve)
+        self.server = http.server.ThreadingHTTPServer((bind, port), handler)
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True)
@@ -352,9 +400,157 @@ class _HttpProxy:
         self.server.shutdown()
 
 
+@ray_tpu.remote
+class HTTPProxyActor:
+    """Per-node HTTP ingress (reference: one HTTPProxy actor per node,
+    serve/_private/http_proxy.py:230).  Routes against a broadcast table
+    of replica actor handles — the driver pushes updates on every deploy/
+    delete/autoscale event, so all node proxies serve one coherent route
+    table while keeping their in-flight accounting local (the reference's
+    routers are also proxy-local)."""
+
+    def __init__(self, port: int = 0, bind: str = "0.0.0.0"):
+        self._routes: Dict[str, DeploymentHandle] = {}
+        self._ingress: Dict[str, bool] = {}
+        self._lock = threading.Lock()
+
+        def resolve(name):
+            with self._lock:
+                h = self._routes.get(name)
+                if h is None:
+                    return None
+                return h, self._ingress.get(name, False)
+
+        self._proxy = _HttpProxy(port, resolve=resolve, bind=bind)
+
+    def ready(self) -> int:
+        return self._proxy.port
+
+    def update_routes(self, routes: Dict[str, dict]) -> bool:
+        """routes: {name: {"replicas": [actor handles], "is_ingress": b}}.
+        Existing handles update in place (set_replicas) so in-flight
+        counters — the autoscaling signal — survive a broadcast."""
+        with self._lock:
+            new_routes: Dict[str, DeploymentHandle] = {}
+            for name, r in routes.items():
+                h = self._routes.get(name)
+                if h is None:
+                    h = DeploymentHandle(name, r["replicas"])
+                else:
+                    h.set_replicas(r["replicas"])
+                new_routes[name] = h
+            self._routes = new_routes
+            self._ingress = {name: bool(r.get("is_ingress"))
+                             for name, r in routes.items()}
+        return True
+
+    def queue_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-deployment in-flight load at THIS proxy — the autoscaling
+        signal the controller aggregates across proxies (reference: the
+        replicas' autoscaling metric push, autoscaling_metrics.py)."""
+        with self._lock:
+            return {name: h.queue_stats()
+                    for name, h in self._routes.items()}
+
+
+_node_proxies: List[Any] = []
+_proxy_strikes: Dict[int, int] = {}
+_PROXY_MAX_STRIKES = 3
+
+
+def _proxy_ok(p):
+    _proxy_strikes.pop(id(p), None)
+
+
+def _proxy_failed(p):
+    """Strike a proxy; after 3 consecutive failures drop it — a dead
+    node's proxy must not add its RPC timeout to every controller poll
+    and broadcast forever."""
+    n = _proxy_strikes.get(id(p), 0) + 1
+    _proxy_strikes[id(p)] = n
+    if n >= _PROXY_MAX_STRIKES:
+        try:
+            _node_proxies.remove(p)
+        except ValueError:
+            pass
+        _proxy_strikes.pop(id(p), None)
+
+
 def start_http_proxy(port: int = 0) -> int:
-    """Start the HTTP ingress; returns the bound port."""
+    """Start the driver-local HTTP ingress; returns the bound port."""
     global _proxy
     if _proxy is None:
         _proxy = _HttpProxy(port)
     return _proxy.port
+
+
+def start_http_proxies(port: int = 0) -> Dict[str, int]:
+    """Per-node ingress (reference: ProxyLocation.EveryNode): one
+    HTTPProxyActor pinned to EACH cluster node, all serving the same
+    route table.  Returns {node_id_hex: bound_port}."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    global _node_proxies
+    nodes = [n["node_id"] for n in ray_tpu.nodes() if n.get("alive", True)]
+    out = {}
+    for node_hex in nodes:
+        actor = HTTPProxyActor.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(node_hex),
+            max_concurrency=16).remote(port)
+        out[node_hex] = ray_tpu.get(actor.ready.remote())
+        _node_proxies.append(actor)
+    broadcast_routes()
+    return out
+
+
+def _current_routes() -> Dict[str, dict]:
+    return {name: {"replicas": list(dep._replicas),
+                   "is_ingress": bool(getattr(dep, "is_ingress", False))}
+            for name, dep in _deployments.items()
+            if dep.handle is not None}
+
+
+def aggregate_queue_stats(name: str, handle: DeploymentHandle
+                          ) -> Dict[str, float]:
+    """Cluster-wide queue metric for one deployment: the driver handle's
+    local in-flight plus every node proxy's — requests entering through
+    per-node ingress must drive autoscaling exactly like driver-side
+    calls."""
+    stats = handle.queue_stats()
+    total = stats["total_in_flight"]
+    for p in list(_node_proxies):
+        try:
+            pstats = ray_tpu.get(p.queue_stats.remote(), timeout=5)
+            total += pstats.get(name, {}).get("total_in_flight", 0.0)
+            _proxy_ok(p)
+        except Exception:
+            _proxy_failed(p)
+            continue
+    n = max(1, handle.num_replicas)
+    return {"total_in_flight": float(total),
+            "avg_per_replica": total / n,
+            "num_replicas": handle.num_replicas}
+
+
+def broadcast_routes() -> None:
+    """Push the deployment→replicas table to every node proxy (called on
+    deploy/delete and by the controller after autoscale events).  Waits
+    for the acks: serve.run() returning must mean every ingress routes
+    the new deployment."""
+    if not _node_proxies:
+        return
+    routes = _current_routes()
+    acks = []
+    for p in list(_node_proxies):
+        try:
+            acks.append((p, p.update_routes.remote(routes)))
+        except Exception:
+            _proxy_failed(p)
+    for p, a in acks:
+        try:
+            ray_tpu.get(a, timeout=10)
+            _proxy_ok(p)
+        except Exception:
+            _proxy_failed(p)

@@ -66,7 +66,9 @@ class ServeController:
         cfg = dep.autoscaling_config or {}
         if handle is None:
             return
-        stats = handle.queue_stats()
+        from ray_tpu.serve.api import aggregate_queue_stats
+
+        stats = aggregate_queue_stats(dep.name, handle)
         win = self._window.setdefault(dep.name, [])
         win.append(stats["avg_per_replica"])
         look_back = max(1, int(cfg.get("look_back_polls", 3)))
@@ -81,8 +83,12 @@ class ServeController:
             min_replicas=int(cfg.get("min_replicas", 1)),
             max_replicas=int(cfg.get("max_replicas", current)),
             smoothing_factor=float(cfg.get("smoothing_factor", 1.0)))
+        from ray_tpu.serve import api as serve_api
+
+        scaled = desired != handle.num_replicas
         while desired > handle.num_replicas:
             handle.add_replica(dep._make_replica())
+        doomed = []
         while desired < handle.num_replicas:
             r = handle.pop_replica()
             if r is None:
@@ -91,9 +97,18 @@ class ServeController:
                 dep._replicas.remove(r)
             except ValueError:
                 pass
+            doomed.append(r)
+        if scaled:
+            # Broadcast BEFORE any kill: node proxies must stop routing
+            # to a doomed replica before it dies, or their in-window
+            # requests land on a corpse.
+            serve_api.broadcast_routes()
+        for r in doomed:
             # Graceful drain (reference: DeploymentState stops a replica
-            # only after it finishes outstanding requests): routing already
-            # stopped at pop_replica; wait for in-flight to hit zero.
+            # only after it finishes outstanding requests): routing
+            # stopped at pop_replica + broadcast; wait for in-flight to
+            # hit zero (driver side; proxy-side stragglers are covered by
+            # the same drain window).
             deadline = time.time() + float(
                 cfg.get("downscale_drain_timeout_s", 5.0))
             while handle.in_flight_of(r) > 0 and time.time() < deadline:
