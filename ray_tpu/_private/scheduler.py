@@ -146,12 +146,23 @@ class ClusterScheduler:
                 return self._pick_spread(spec)
             return self._pick_default(spec, preferred)
 
+    # Node shapes an attached autoscaler can launch (reference: infeasible
+    # tasks stay pending when the autoscaler's node types could satisfy
+    # them — resource_demand_scheduler feasibility).  Set by
+    # StandardAutoscaler; empty means no autoscaler.
+    external_capacity: list = []
+
     def _check_feasible(self, spec: TaskSpec):
-        if not any(n.feasible(spec.resources) for n in self.nodes.values()):
-            raise Infeasible(
-                f"no node can ever satisfy {spec.resources}; "
-                f"cluster totals {dict(self.total_resources())}"
-            )
+        if any(n.feasible(spec.resources) for n in self.nodes.values()):
+            return
+        for cap in self.external_capacity:
+            if all(cap.get(k, 0.0) >= v
+                   for k, v in spec.resources.items()):
+                return  # the autoscaler can launch a node for this
+        raise Infeasible(
+            f"no node can ever satisfy {spec.resources}; "
+            f"cluster totals {dict(self.total_resources())}"
+        )
 
     def _pick_default(self, spec: TaskSpec,
                       preferred: Optional[NodeID]) -> Optional[NodeID]:

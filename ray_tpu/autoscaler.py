@@ -29,6 +29,9 @@ class NodeProvider:
     def non_terminated_nodes(self) -> List:
         raise NotImplementedError
 
+    def node_type_counts(self) -> Dict[str, int]:
+        return {}
+
 
 class LocalNodeProvider(NodeProvider):
     """Adds/removes virtual raylets in the running head (the fake-multinode
@@ -39,19 +42,100 @@ class LocalNodeProvider(NodeProvider):
 
         self.head = head or ray_tpu._global_head()
         self.created: List = []
+        self._types: Dict = {}
 
     def create_node(self, node_type: str, resources: Dict[str, float]):
         node_id = self.head.add_node(resources, labels={"node_type": node_type})
         self.created.append(node_id)
+        self._types[node_id] = node_type
         return node_id
 
     def terminate_node(self, node_id):
         self.head.remove_node(node_id)
         if node_id in self.created:
             self.created.remove(node_id)
+        self._types.pop(node_id, None)
 
     def non_terminated_nodes(self) -> List:
         return list(self.created)
+
+    def node_type_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for t in self._types.values():
+            counts[t] = counts.get(t, 0) + 1
+        return counts
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches REAL node-agent subprocesses (reference: the fake-
+    multinode provider that starts actual raylets on one machine,
+    autoscaler/_private/fake_multi_node/node_provider.py:237).  Each
+    launch carries a unique token resource so the provider can bind the
+    subprocess to the node id the head assigns when the agent
+    registers."""
+
+    def __init__(self, head=None, register_timeout_s: float = 30.0):
+        import ray_tpu
+
+        self.head = head or ray_tpu._global_head()
+        self.register_timeout_s = register_timeout_s
+        self._procs: Dict = {}     # node_id -> subprocess
+        self._types: Dict = {}     # node_id -> node_type
+        self._counter = 0
+
+    def create_node(self, node_type: str, resources: Dict[str, float]):
+        import json as _json
+        import subprocess
+        import sys
+        import time as _time
+
+        self._counter += 1
+        token = f"_launch_{self._counter}"
+        res = dict(resources)
+        cpus = res.pop("CPU", 1)
+        res[token] = 1.0
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_agent",
+             "--address", f"127.0.0.1:{self.head.tcp_port}",
+             "--authkey", self.head.authkey.hex(),
+             "--num-cpus", str(int(cpus)),
+             "--resources", _json.dumps(res),
+             "--store-capacity", str(128 * 1024 * 1024)])
+        deadline = _time.monotonic() + self.register_timeout_s
+        while _time.monotonic() < deadline:
+            for node_id, nres in list(self.head.scheduler.nodes.items()):
+                if nres.total.get(token):
+                    self._procs[node_id] = proc
+                    self._types[node_id] = node_type
+                    return node_id
+            _time.sleep(0.1)
+        proc.kill()
+        raise TimeoutError(f"node of type {node_type!r} never registered")
+
+    def terminate_node(self, node_id):
+        proc = self._procs.pop(node_id, None)
+        self._types.pop(node_id, None)
+        self.head.remove_node(node_id)
+        if proc is not None:
+            try:
+                proc.terminate()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List:
+        return [n for n, p in self._procs.items() if p.poll() is None]
+
+    def node_type_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for n, t in self._types.items():
+            if n in self._procs and self._procs[n].poll() is None:
+                counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def shutdown(self):
+        for node_id in list(self._procs):
+            self.terminate_node(node_id)
 
 
 class StandardAutoscaler:
@@ -68,40 +152,123 @@ class StandardAutoscaler:
         self.max_nodes = max_nodes
         self.idle_timeout_s = idle_timeout_s
         self._node_idle_since: Dict = {}
+        # Register the launchable shapes with the scheduler so demands
+        # only a future node can satisfy stay PENDING (for this loop to
+        # serve) instead of erroring as infeasible at submit.  (Like the
+        # reference, a demand that fits a node type but exhausts the
+        # launch budget waits pending rather than erroring.)  detach()
+        # restores strict feasibility when the autoscaler stops.
+        self.head.scheduler.external_capacity = [
+            dict(nt["resources"]) for nt in node_types.values()]
 
-    # ---- one reconciliation pass (reference: update :366) ----
+    def detach(self):
+        """Stop advertising launchable capacity: without a live monitor,
+        a pending-forever demand should raise Infeasible at submit."""
+        self.head.scheduler.external_capacity = []
+
+    # ---- one reconciliation pass (reference: update :366 + the
+    # resource_demand_scheduler bin-packing) ----
     def update(self) -> Dict[str, int]:
-        launched: Dict[str, int] = {}
         demands = self._pending_demands()
-        for demand in demands:
-            if len(self.provider.non_terminated_nodes()) >= self.max_nodes:
-                break
-            nt = self._fit_node_type(demand)
-            if nt is not None:
-                self.provider.create_node(nt, dict(
-                    self.node_types[nt]["resources"]))
-                launched[nt] = launched.get(nt, 0) + 1
+        # 1) Existing nodes absorb what fits into their free capacity —
+        #    a queued task the cluster can already run must not launch a
+        #    node.  Anti-affinity demands (STRICT_SPREAD bundles) sharing
+        #    a key must land on DISTINCT nodes.
+        with self.head._lock:
+            free = [[dict(n.available), set()]
+                    for n in self.head.scheduler.nodes.values()]
+        unmet = []
+        for d, key in demands:
+            if not d:
+                continue
+            placed = False
+            for f, keys in free:
+                if key is not None and key in keys:
+                    continue
+                if all(f.get(k, 0.0) >= v for k, v in d.items()):
+                    for k, v in d.items():
+                        f[k] = f.get(k, 0.0) - v
+                    if key is not None:
+                        keys.add(key)
+                    placed = True
+                    break
+            if not placed:
+                unmet.append((d, key))
+        # 2) First-fit-decreasing pack of the remainder onto virtual new
+        #    nodes: one launched node serves MANY demands (the reference
+        #    packs demands per node type before asking the provider).
+        planned: List[list] = []  # [node_type, remaining, anti_keys]
+        budget = self.max_nodes - len(self.provider.non_terminated_nodes())
+        per_type = {name: 0 for name in self.node_types}
+        for d, key in sorted(unmet, key=lambda dk: -sum(dk[0].values())):
+            placed = False
+            for plan in planned:
+                _nt, rem, keys = plan
+                if key is not None and key in keys:
+                    continue
+                if all(rem.get(k, 0.0) >= v for k, v in d.items()):
+                    for k, v in d.items():
+                        rem[k] = rem.get(k, 0.0) - v
+                    if key is not None:
+                        keys.add(key)
+                    placed = True
+                    break
+            if placed or len(planned) >= max(0, budget):
+                continue
+            nt = self._fit_node_type(d, per_type)
+            if nt is None:
+                continue
+            rem = dict(self.node_types[nt]["resources"])
+            for k, v in d.items():
+                rem[k] = rem.get(k, 0.0) - v
+            planned.append([nt, rem, {key} if key is not None else set()])
+            per_type[nt] += 1
+        launched: Dict[str, int] = {}
+        for nt, _rem, _keys in planned:
+            self.provider.create_node(nt, dict(
+                self.node_types[nt]["resources"]))
+            launched[nt] = launched.get(nt, 0) + 1
         self._terminate_idle()
         return launched
 
-    def _pending_demands(self) -> List[Dict[str, float]]:
+    def _pending_demands(self) -> List[tuple]:
+        """Pending (resources, anti_affinity_key) pairs.  The key is set
+        for STRICT_SPREAD placement-group bundles: two demands sharing a
+        key must NOT count against one node's capacity (free absorption
+        or planned-node packing) — they need distinct nodes."""
         with self.head._lock:
-            demands = [dict(spec.resources) for spec in self.head.pending]
-            for raylet in self.head.raylets.values():
-                demands.extend(dict(s.resources) for s in raylet.queued)
+            # head.pending = demands NO node could place (the scale-up
+            # signal).  Tasks queued at a raylet already hold allocated
+            # resources there (waiting on a worker slot), so counting
+            # them would double-book demand against capacity.
+            demands = [(dict(spec.resources), None)
+                       for spec in self.head.pending]
             # Pending placement groups contribute bundle demands.
             for pg in self.head._pending_pgs:
-                demands.extend(dict(b.resources) for b in pg.bundles)
+                strict = getattr(pg, "strategy", "") == "STRICT_SPREAD"
+                key = pg.pg_id if strict else None
+                demands.extend((dict(b.resources), key)
+                           for b in pg.bundles if b.node_id is None)
         return demands
 
-    def _fit_node_type(self, demand: Dict[str, float]) -> Optional[str]:
+    def _fit_node_type(self, demand: Dict[str, float],
+                       planned_per_type: Optional[Dict[str, int]] = None
+                       ) -> Optional[str]:
+        """Smallest node type whose resources cover the demand, honoring
+        per-type max_workers (existing + planned this pass)."""
+        planned_per_type = planned_per_type or {}
+        existing_per_type = self.provider.node_type_counts()
+        candidates = []
         for name, nt in self.node_types.items():
             res = nt["resources"]
-            if all(res.get(k, 0.0) >= v for k, v in demand.items()):
-                count = sum(1 for n in self.provider.non_terminated_nodes())
-                if count < nt.get("max_workers", self.max_nodes):
-                    return name
-        return None
+            if not all(res.get(k, 0.0) >= v for k, v in demand.items()):
+                continue
+            count = (existing_per_type.get(name, 0)
+                     + planned_per_type.get(name, 0))
+            if count >= nt.get("max_workers", self.max_nodes):
+                continue
+            candidates.append((sum(res.values()), name))
+        return min(candidates)[1] if candidates else None
 
     def _terminate_idle(self):
         now = time.monotonic()
@@ -145,3 +312,4 @@ class Monitor:
 
     def stop(self):
         self._stop.set()
+        self.autoscaler.detach()

@@ -347,10 +347,15 @@ class StreamingDataset:
             if not batch:
                 break
             for b in batch:
+                if P == 1:
+                    # Single partition: no exchange needed — the block IS
+                    # its one part (num_returns=1 would wrap the kernel's
+                    # tuple return as a single tuple-valued object).
+                    parts_held[0].append(b)
+                    blk_idx += 1
+                    continue
                 parts = _partition_block.options(num_returns=P).remote(
                     b, P, seed0 + blk_idx)
-                if P == 1:
-                    parts = [parts]
                 blk_idx += 1
                 for j in range(P):
                     parts_held[j].append(parts[j])

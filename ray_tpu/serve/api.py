@@ -252,13 +252,17 @@ def run(dep: Deployment, name: Optional[str] = None) -> DeploymentHandle:
     """serve.run (reference: serve/api.py:455)."""
     key = name or dep.name
     old = _deployments.pop(key, None)
+    if old is not None:
+        # Unroute everywhere FIRST (proxies briefly 404 the name), then
+        # free the old replicas' resources before deploying the new ones
+        # — deploy-before-teardown would deadlock a redeploy whose old
+        # replicas hold resources the new ones need, and broadcast-after-
+        # kill would route proxies at corpses.
+        broadcast_routes()
+        old._teardown()
     handle = dep._deploy()
     _deployments[key] = dep
-    # Broadcast the NEW replicas before tearing down the old ones, so
-    # node proxies never route into the teardown window.
     broadcast_routes()
-    if old is not None:
-        old._teardown()
     return handle
 
 
@@ -463,9 +467,10 @@ def _proxy_ok(p):
 
 
 def _proxy_failed(p):
-    """Strike a proxy; after 3 consecutive failures drop it — a dead
-    node's proxy must not add its RPC timeout to every controller poll
-    and broadcast forever."""
+    """Strike a proxy; after 3 consecutive failures drop AND KILL it — a
+    dead node's proxy must not add its RPC timeout to every controller
+    poll forever, and a merely-slow one must not keep serving a stale
+    route table after it stops receiving broadcasts."""
     n = _proxy_strikes.get(id(p), 0) + 1
     _proxy_strikes[id(p)] = n
     if n >= _PROXY_MAX_STRIKES:
@@ -474,6 +479,10 @@ def _proxy_failed(p):
         except ValueError:
             pass
         _proxy_strikes.pop(id(p), None)
+        try:
+            ray_tpu.kill(p)
+        except Exception:
+            pass
 
 
 def start_http_proxy(port: int = 0) -> int:
@@ -512,22 +521,36 @@ def _current_routes() -> Dict[str, dict]:
             if dep.handle is not None}
 
 
-def aggregate_queue_stats(name: str, handle: DeploymentHandle
-                          ) -> Dict[str, float]:
-    """Cluster-wide queue metric for one deployment: the driver handle's
-    local in-flight plus every node proxy's — requests entering through
-    per-node ingress must drive autoscaling exactly like driver-side
-    calls."""
-    stats = handle.queue_stats()
-    total = stats["total_in_flight"]
+def collect_proxy_stats() -> Dict[str, float]:
+    """ONE stats RPC per proxy per controller tick (shared across every
+    watched deployment): {deployment: summed in-flight across proxies}.
+    A proxy failing the poll takes exactly one strike per tick."""
+    totals: Dict[str, float] = {}
     for p in list(_node_proxies):
         try:
             pstats = ray_tpu.get(p.queue_stats.remote(), timeout=5)
-            total += pstats.get(name, {}).get("total_in_flight", 0.0)
             _proxy_ok(p)
         except Exception:
             _proxy_failed(p)
             continue
+        for name, s in pstats.items():
+            totals[name] = totals.get(name, 0.0) \
+                + s.get("total_in_flight", 0.0)
+    return totals
+
+
+def aggregate_queue_stats(name: str, handle: DeploymentHandle,
+                          proxy_totals: Optional[Dict[str, float]] = None
+                          ) -> Dict[str, float]:
+    """Cluster-wide queue metric for one deployment: the driver handle's
+    local in-flight plus every node proxy's — requests entering through
+    per-node ingress must drive autoscaling exactly like driver-side
+    calls.  Pass ``proxy_totals`` (collect_proxy_stats) to share one
+    poll across deployments."""
+    if proxy_totals is None:
+        proxy_totals = collect_proxy_stats()
+    stats = handle.queue_stats()
+    total = stats["total_in_flight"] + proxy_totals.get(name, 0.0)
     n = max(1, handle.num_replicas)
     return {"total_in_flight": float(total),
             "avg_per_replica": total / n,

@@ -53,22 +53,29 @@ class ServeController:
         while not self._stop.wait(self.interval_s):
             with self._lock:
                 deployments = list(self._watched.values())
+            # One proxy-stats poll per tick, shared by every deployment.
+            try:
+                from ray_tpu.serve.api import collect_proxy_stats
+
+                proxy_totals = collect_proxy_stats()
+            except Exception:
+                proxy_totals = {}
             for dep in deployments:
                 try:
-                    self._reconcile(dep)
+                    self._reconcile(dep, proxy_totals)
                 except Exception:
                     import traceback
 
                     traceback.print_exc()
 
-    def _reconcile(self, dep):
+    def _reconcile(self, dep, proxy_totals=None):
         handle = dep.handle
         cfg = dep.autoscaling_config or {}
         if handle is None:
             return
         from ray_tpu.serve.api import aggregate_queue_stats
 
-        stats = aggregate_queue_stats(dep.name, handle)
+        stats = aggregate_queue_stats(dep.name, handle, proxy_totals)
         win = self._window.setdefault(dep.name, [])
         win.append(stats["avg_per_replica"])
         look_back = max(1, int(cfg.get("look_back_polls", 3)))
