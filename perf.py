@@ -84,6 +84,19 @@ def bench_put_gbps(ray_tpu, size=64 * MB, n=8):
     return n * size / dt / 1e9, dt
 
 
+def bench_memcpy_gbps(size=256 * MB):
+    """Single-core memcpy ceiling on THIS box — the context for
+    put_gb_per_s: cold puts first-touch fresh arena pages, so the bound
+    is host memory bandwidth, not the store software (warmed re-puts of
+    cached segments measure >4 GB/s)."""
+    src = np.random.randint(0, 255, size, dtype=np.uint8)
+    dst = bytearray(size)
+    t0 = time.perf_counter()
+    memoryview(dst)[:] = src.data
+    dt = time.perf_counter() - t0
+    return size / dt / 1e9, dt
+
+
 def bench_get_gbps(ray_tpu, size=64 * MB, n=8):
     data = np.random.randint(0, 255, size, dtype=np.uint8)
     refs = [ray_tpu.put(data) for _ in range(n)]
@@ -126,6 +139,7 @@ def main():
         out["async_actor_calls_per_s"], _ = bench_actor_calls_async(ray_tpu)
         out["put_small_per_s"], _ = bench_put_small(ray_tpu)
         out["put_gb_per_s"], _ = bench_put_gbps(ray_tpu)
+        out["memcpy_gb_per_s"], _ = bench_memcpy_gbps()
         out["get_gb_per_s"], _ = bench_get_gbps(ray_tpu)
         out = {k: round(v, 2) for k, v in out.items()}
         out["store"] = "arena" if args.native_arena == "1" else "segments"

@@ -258,6 +258,12 @@ class StandardAutoscaler:
         per-type max_workers (existing + planned this pass)."""
         planned_per_type = planned_per_type or {}
         existing_per_type = self.provider.node_type_counts()
+        live = self.provider.non_terminated_nodes()
+        if not existing_per_type and live:
+            # Provider without per-type accounting (base default {}):
+            # fall back to the conservative total-count bound so
+            # max_workers can never be silently exceeded.
+            existing_per_type = {name: len(live) for name in self.node_types}
         candidates = []
         for name, nt in self.node_types.items():
             res = nt["resources"]
@@ -267,7 +273,12 @@ class StandardAutoscaler:
                      + planned_per_type.get(name, 0))
             if count >= nt.get("max_workers", self.max_nodes):
                 continue
-            candidates.append((sum(res.values()), name))
+            # Tightest fit ON THE DEMANDED resources: summing raw units
+            # would let a GB-scale resource (memory) dominate and pick a
+            # grossly oversized node for a 1-CPU demand.
+            overprovision = sum(res.get(k, 0.0) / v
+                                for k, v in demand.items() if v > 0)
+            candidates.append((overprovision, name))
         return min(candidates)[1] if candidates else None
 
     def _terminate_idle(self):

@@ -232,31 +232,120 @@ class Dashboard:
         return out
 
     def _overview_html(self) -> str:
+        """Server-rendered cluster overview: live resources, per-node
+        stats, actors, jobs, and a task summary (reference scope: the
+        dashboard's cluster/actors/jobs views — rendered server-side
+        here instead of shipping a React bundle)."""
         total, avail = [], []
         self.head.req_cluster_resources({}, total.append, None)
         self.head.req_cluster_resources({"available": True}, avail.append,
                                         None)
         nodes = self._state("nodes")
         actors = self._state("actors")
+        jobs = self._state("jobs")
+        tasks_by_status: dict = {}
+        for t in self._state("tasks"):
+            tasks_by_status[t["status"]] = \
+                tasks_by_status.get(t["status"], 0) + 1
         buf = io.StringIO()
-        buf.write("<html><head><title>ray_tpu dashboard</title></head>"
-                  "<body style='font-family:monospace'>")
+        buf.write(
+            "<html><head><title>ray_tpu dashboard</title>"
+            "<meta http-equiv='refresh' content='5'>"
+            "<style>body{font-family:monospace;margin:2em}"
+            "table{border-collapse:collapse;margin-bottom:1.5em}"
+            "td,th{border:1px solid #999;padding:4px 10px;text-align:left}"
+            "th{background:#eee}</style></head><body>")
         buf.write("<h2>ray_tpu cluster</h2>")
-        buf.write(f"<p>nodes: {len(nodes)} &middot; actors: {len(actors)}"
-                  "</p><h3>resources</h3><table border=1 cellpadding=4>"
+        buf.write(f"<p>nodes: {len(nodes)} &middot; actors: {len(actors)} "
+                  f"&middot; jobs: {len(jobs)} (auto-refreshes)</p>")
+
+        buf.write("<h3>resources</h3><table>"
                   "<tr><th>resource</th><th>available</th><th>total</th>"
                   "</tr>")
         for k, v in sorted(total[0].items()):
             buf.write(f"<tr><td>{k}</td><td>{avail[0].get(k, 0):g}</td>"
                       f"<td>{v:g}</td></tr>")
-        buf.write("</table><p>JSON API: /api/cluster /api/nodes /api/actors "
-                  "/api/tasks /api/objects /api/jobs /api/summary /api/logs "
-                  "/metrics</p></body></html>")
+        buf.write("</table>")
+
+        buf.write("<h3>nodes</h3><table><tr><th>node</th><th>alive</th>"
+                  "<th>resources</th><th>cpu%</th><th>mem%</th>"
+                  "<th>store used</th></tr>")
+        for n in nodes:
+            st = n.get("stats") or {}
+            res = " ".join(f"{k}:{v:g}" for k, v in
+                           sorted((n.get("resources") or {}).items())
+                           if k != "memory")
+            used = st.get("store_used_bytes")
+            buf.write(
+                f"<tr><td>{n['node_id'][:12]}</td>"
+                f"<td>{'yes' if n.get('alive', True) else 'NO'}</td>"
+                f"<td>{res}</td>"
+                f"<td>{st.get('cpu_percent', '-')}</td>"
+                f"<td>{st.get('mem_percent', '-')}</td>"
+                f"<td>{_fmt_bytes(used) if used is not None else '-'}</td>"
+                "</tr>")
+        buf.write("</table>")
+
+        if actors:
+            import html as _html
+
+            esc = _html.escape
+            buf.write("<h3>actors</h3><table><tr><th>actor</th>"
+                      "<th>class</th><th>name</th><th>state</th>"
+                      "<th>node</th><th>restarts</th></tr>")
+            for a in actors[:100]:
+                # User-controlled strings (class/actor names) must not
+                # inject markup into the page.
+                buf.write(
+                    f"<tr><td>{esc(str(a.get('actor_id', ''))[:12])}</td>"
+                    f"<td>{esc(str(a.get('class_name', '')))}</td>"
+                    f"<td>{esc(str(a.get('name') or ''))}</td>"
+                    f"<td>{esc(str(a.get('state', '')))}</td>"
+                    f"<td>{esc(str(a.get('node_id') or '')[:12])}</td>"
+                    f"<td>{a.get('num_restarts', 0)}</td></tr>")
+            buf.write("</table>")
+
+        if jobs:
+            import html as _html
+
+            esc = _html.escape
+            buf.write("<h3>jobs</h3><table><tr><th>job</th><th>status</th>"
+                      "</tr>")
+            for j in jobs[:50]:
+                buf.write(f"<tr><td>{esc(str(j.get('job_id', '')))}</td>"
+                          f"<td>{esc(str(j.get('status', '')))}</td></tr>")
+            buf.write("</table>")
+
+        if tasks_by_status:
+            buf.write("<h3>tasks</h3><table><tr><th>status</th>"
+                      "<th>count</th></tr>")
+            for k, v in sorted(tasks_by_status.items()):
+                buf.write(f"<tr><td>{k}</td><td>{v}</td></tr>")
+            buf.write("</table>")
+
+        buf.write("<p>JSON API: <a href='/api/cluster'>/api/cluster</a> "
+                  "<a href='/api/nodes'>/api/nodes</a> "
+                  "<a href='/api/actors'>/api/actors</a> "
+                  "<a href='/api/tasks'>/api/tasks</a> "
+                  "<a href='/api/objects'>/api/objects</a> "
+                  "<a href='/api/jobs'>/api/jobs</a> "
+                  "<a href='/api/summary'>/api/summary</a> "
+                  "<a href='/api/logs'>/api/logs</a> "
+                  "<a href='/metrics'>/metrics</a></p></body></html>")
         return buf.getvalue()
 
     def shutdown(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TB"
 
 
 def _tail(path: str, lines: int) -> str:

@@ -6,7 +6,16 @@ mesh learner — the generality path, shaped like the reference)."""
 from ray_tpu.rllib.algorithms.algorithm import Algorithm  # noqa: F401
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.bandit import (  # noqa: F401
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+)
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.dyna_q import DynaQ, DynaQConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.es import ES, ESConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig  # noqa: F401
@@ -24,7 +33,10 @@ from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch  # no
 ALGORITHMS = {"PPO": PPOConfig, "IMPALA": IMPALAConfig, "DQN": DQNConfig,
               "SAC": SACConfig, "BC": BCConfig, "MAPPO": MAPPOConfig,
               "APPO": APPOConfig, "TD3": TD3Config, "DDPG": DDPGConfig,
-              "MARWIL": MARWILConfig}
+              "MARWIL": MARWILConfig, "ES": ESConfig,
+              "BanditLinUCB": BanditLinUCBConfig,
+              "BanditLinTS": BanditLinTSConfig,
+              "DynaQ": DynaQConfig, "QMIX": QMixConfig}
 
 
 def get_algorithm_config(name: str) -> AlgorithmConfig:

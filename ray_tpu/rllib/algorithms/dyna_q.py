@@ -1,0 +1,244 @@
+"""DynaQ: model-based DQN (the Dyna architecture).
+
+Reference family: rllib's model-based algorithms (MBMPO,
+rllib/algorithms/mbmpo/ — learn an ensemble dynamics model from real
+transitions, train the policy on imagined rollouts).  This representative
+keeps the family's defining loop — real experience trains a DYNAMICS
+MODEL, the model manufactures imagined transitions, and the value
+learner consumes both — in the anakin shape: env rollout, replay,
+model fit, imagination, and the double-Q update are all one jitted
+train step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.dqn import (
+    DQNConfig,
+    QNetwork,
+    ReplayState,
+    _replay_insert,
+    make_replay_state,
+)
+from ray_tpu.rllib.env.jax_envs import make_jax_env, vector_reset, vector_step
+from ray_tpu.models.mlp import MLP
+
+
+class DynaQConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DynaQ
+        # Model-based knobs: imagined minibatches per real update and
+        # the dynamics-model learning rate.
+        self.model_lr = 1e-3
+        self.imagined_ratio = 1.0   # imagined batch size / real batch size
+        self.model_updates_per_iter = 4
+
+
+class DynaState(NamedTuple):
+    params: Any
+    target_params: Any
+    opt_state: Any
+    model_params: Any
+    model_opt: Any
+    env_states: Any
+    obs: jax.Array
+    rng: jax.Array
+    replay: ReplayState
+    env_steps: jax.Array
+    ep_return: jax.Array
+    done_return_sum: jax.Array
+    done_count: jax.Array
+    model_loss: jax.Array
+
+
+class DynaQ(Algorithm):
+    _default_config_cls = DynaQConfig
+
+    def _setup_anakin(self):
+        config = self.config
+        env = make_jax_env(config.env) if isinstance(config.env, str) \
+            else config.env
+        N, T = config.num_envs, config.unroll_length
+        obs_dim = env.obs_dim
+        A = env.num_actions
+        qnet = QNetwork(obs_dim, A, tuple(config.hiddens))
+        # Dynamics model: (obs, onehot action) -> (delta obs, reward,
+        # done logit).
+        model = MLP(features=tuple(config.hiddens),
+                    out_dim=obs_dim + 2)
+        gamma = config.gamma
+        B = config.dqn_batch_size
+        BI = int(B * config.imagined_ratio)
+        tx = optax.adam(config.lr)
+        mtx = optax.adam(config.model_lr)
+
+        def model_in(obs, act):
+            return jnp.concatenate(
+                [obs, jax.nn.one_hot(act, A)], axis=-1)
+
+        def model_pred(mp, obs, act):
+            out = model.apply(mp, model_in(obs, act))
+            next_obs = obs + out[..., :obs_dim]
+            reward = out[..., obs_dim]
+            done_logit = out[..., obs_dim + 1]
+            return next_obs, reward, done_logit
+
+        def model_loss_fn(mp, batch):
+            next_pred, r_pred, d_logit = model_pred(
+                mp, batch["obs"], batch["actions"])
+            l_obs = jnp.mean((next_pred - batch["next_obs"]) ** 2)
+            l_r = jnp.mean((r_pred - batch["rewards"]) ** 2)
+            l_d = jnp.mean(optax.sigmoid_binary_cross_entropy(
+                d_logit, batch["dones"]))
+            return l_obs + l_r + l_d
+
+        def q_loss_fn(p, tp, batch):
+            q = qnet.apply(p, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), 1)[:, 0]
+            nq_online = qnet.apply(p, batch["next_obs"])
+            nq_target = qnet.apply(tp, batch["next_obs"])
+            # Double-Q: online argmax, target evaluation.
+            na = jnp.argmax(nq_online, axis=-1)
+            nv = jnp.take_along_axis(nq_target, na[:, None], 1)[:, 0]
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * nv
+            return jnp.mean((q_sel - jax.lax.stop_gradient(target)) ** 2)
+
+        def sample_real(replay, rng, n):
+            idx = jax.random.randint(rng, (n,), 0,
+                                     jnp.maximum(replay.size, 1))
+            return {k: getattr(replay, k)[idx]
+                    for k in ("obs", "actions", "rewards", "next_obs",
+                              "dones")}
+
+        def imagine(mp, p, replay, rng, n):
+            """Dyna imagination: start from REAL replayed states, act
+            epsilon-greedily with the CURRENT policy, step the MODEL."""
+            k_idx, k_eps, k_act = jax.random.split(rng, 3)
+            idx = jax.random.randint(k_idx, (n,), 0,
+                                     jnp.maximum(replay.size, 1))
+            obs = replay.obs[idx]
+            greedy = jnp.argmax(qnet.apply(p, obs), axis=-1)
+            rand = jax.random.randint(k_act, (n,), 0, A)
+            act = jnp.where(jax.random.uniform(k_eps, (n,)) < 0.1,
+                            rand, greedy)
+            next_obs, reward, done_logit = model_pred(mp, obs, act)
+            return {"obs": obs, "actions": act, "rewards": reward,
+                    "next_obs": jax.lax.stop_gradient(next_obs),
+                    "dones": (jax.nn.sigmoid(done_logit) > 0.5
+                              ).astype(jnp.float32)}
+
+        def rollout(state, rng):
+            def one(carry, _):
+                env_states, obs, rng, ep_ret, dsum, dcnt, steps, p = carry
+                rng, k_eps, k_rand, k_step = jax.random.split(rng, 4)
+                eps = jnp.clip(
+                    1.0 - (1.0 - config.epsilon_final) * steps
+                    / config.epsilon_decay_steps,
+                    config.epsilon_final, 1.0)
+                greedy = jnp.argmax(qnet.apply(p, obs), axis=-1)
+                rand = jax.random.randint(k_rand, (N,), 0, A)
+                act = jnp.where(
+                    jax.random.uniform(k_eps, (N,)) < eps, rand, greedy)
+                env_states, next_obs, r, done, _ = vector_step(
+                    env, env_states, act, k_step)
+                ep_ret = ep_ret + r
+                dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+                dcnt = dcnt + jnp.sum(done)
+                ep_ret = jnp.where(done, 0.0, ep_ret)
+                out = (obs, act, r, next_obs, done.astype(jnp.float32))
+                return (env_states, next_obs, rng, ep_ret, dsum, dcnt,
+                        steps + N, p), out
+
+            carry = (state.env_states, state.obs, rng, state.ep_return,
+                     state.done_return_sum, state.done_count,
+                     state.env_steps, state.params)
+            carry, tr = jax.lax.scan(one, carry, None, length=T)
+            env_states, obs, _, ep_ret, dsum, dcnt, steps, _ = carry
+            o, a, r, no, d = tr
+            flat = {"obs": o.reshape(N * T, obs_dim),
+                    "actions": a.reshape(N * T),
+                    "rewards": r.reshape(N * T),
+                    "next_obs": no.reshape(N * T, obs_dim),
+                    "dones": d.reshape(N * T)}
+            return env_states, obs, ep_ret, dsum, dcnt, steps, flat
+
+        def train_step(state: DynaState):
+            rng, k_roll, k_model, k_q = jax.random.split(state.rng, 4)
+            (env_states, obs, ep_ret, dsum, dcnt, steps,
+             flat) = rollout(state, k_roll)
+            replay = _replay_insert(state.replay, flat)
+
+            # 1) Fit the dynamics model on real replayed transitions.
+            def model_update(carry, k):
+                mp, mopt = carry
+                batch = sample_real(replay, k, B)
+                loss, grads = jax.value_and_grad(model_loss_fn)(mp, batch)
+                up, mopt = mtx.update(grads, mopt, mp)
+                return (optax.apply_updates(mp, up), mopt), loss
+
+            (mp, mopt), mlosses = jax.lax.scan(
+                model_update, (state.model_params, state.model_opt),
+                jax.random.split(k_model, config.model_updates_per_iter))
+
+            # 2) Q updates on real + imagined transitions.
+            def q_update(carry, k):
+                p, tp, opt = carry
+                k_real, k_imag = jax.random.split(k)
+                real = sample_real(replay, k_real, B)
+                imag = imagine(mp, p, replay, k_imag, BI)
+                batch = {kk: jnp.concatenate([real[kk], imag[kk]])
+                         for kk in real}
+                loss, grads = jax.value_and_grad(q_loss_fn)(p, tp, batch)
+                up, opt = tx.update(grads, opt, p)
+                p = optax.apply_updates(p, up)
+                tp = jax.tree.map(
+                    lambda t, o: t * (1 - config.target_network_tau)
+                    + o * config.target_network_tau, tp, p)
+                return (p, tp, opt), loss
+
+            warm = replay.size >= config.learning_starts
+            (p, tp, opt), qlosses = jax.lax.scan(
+                q_update, (state.params, state.target_params,
+                           state.opt_state),
+                jax.random.split(k_q, config.num_updates_per_iter))
+            p, tp, opt = jax.tree.map(
+                lambda new, old: jnp.where(warm, new, old),
+                (p, tp, opt),
+                (state.params, state.target_params, state.opt_state))
+
+            new_state = DynaState(p, tp, opt, mp, mopt, env_states, obs,
+                                  rng, replay, steps, ep_ret, dsum, dcnt,
+                                  mlosses.mean())
+            metrics = {"total_loss": qlosses.mean(),
+                       "model_loss": mlosses.mean(),
+                       "episode_return_sum": dsum,
+                       "episode_count": dcnt}
+            return new_state, metrics
+
+        key = jax.random.PRNGKey(config.seed)
+        k_q, k_m, k_env, k_rng = jax.random.split(key, 4)
+        env_states, obs0 = vector_reset(env, k_env, N)
+        qp = qnet.init(k_q, obs0)
+        mp = model.init(k_m, model_in(obs0, jnp.zeros(N, jnp.int32)))
+        self._anakin_state = DynaState(
+            qp, qp, tx.init(qp), mp, mtx.init(mp), env_states, obs0,
+            k_rng, make_replay_state(config.buffer_size, N * T, obs_dim),
+            jnp.zeros((), jnp.int32), jnp.zeros(N), jnp.zeros(()),
+            jnp.zeros(()), jnp.zeros(()))
+        self._train_step = jax.jit(train_step)
+        self._steps_per_iter = N * T
+        self.module = qnet
+
+    def _training_step_anakin(self) -> Dict[str, Any]:
+        self._anakin_state, metrics = self._train_step(self._anakin_state)
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics = self._episode_counter_metrics(metrics)
+        metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
+        return metrics

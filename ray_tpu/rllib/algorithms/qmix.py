@@ -1,0 +1,243 @@
+"""QMIX: cooperative multi-agent Q-learning with monotonic value mixing.
+
+Reference: rllib/algorithms/qmix/ (QMixTorchPolicy — per-agent Q
+networks + a state-conditioned hypernetwork mixer whose non-negative
+weights keep argmax_a Q_tot = per-agent argmaxes).  TPU-first redesign
+on the array-axis multi-agent protocol (rllib/env/multi_agent.py):
+agents are a leading axis, the per-agent net is weight-shared (agent id
+rides in the observation), and rollout, replay, and the mixed TD update
+compile into one anakin step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.env.multi_agent import (
+    ma_vector_reset,
+    ma_vector_step,
+    make_ma_env,
+)
+from ray_tpu.models.mlp import MLP
+
+
+class QMixConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=QMix)
+        self.env = "CoordinationGame-v0"
+        self.lr = 5e-4
+        self.buffer_size = 20_000
+        self.learning_starts = 500
+        self.target_network_tau = 0.01
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 30_000
+        self.num_updates_per_iter = 4
+        self.qmix_batch_size = 128
+        self.mixing_embed_dim = 32
+        self.num_envs = 32
+        self.unroll_length = 16
+
+
+class Mixer:
+    """Monotonic mixing network: Q_tot = w2(s)^T elu(W1(s) q + b1) + b2,
+    with |W1|, |w2| enforcing dQ_tot/dQ_i >= 0 (reference:
+    qmix/model.py QMixer)."""
+
+    def __init__(self, num_agents: int, state_dim: int, embed: int):
+        self.M, self.embed = num_agents, embed
+        self.hyper_w1 = MLP(features=(64,), out_dim=num_agents * embed)
+        self.hyper_b1 = MLP(features=(64,), out_dim=embed)
+        self.hyper_w2 = MLP(features=(64,), out_dim=embed)
+        self.hyper_b2 = MLP(features=(64,), out_dim=1)
+
+    def init(self, key, state):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        return {"w1": self.hyper_w1.init(k1, state),
+                "b1": self.hyper_b1.init(k2, state),
+                "w2": self.hyper_w2.init(k3, state),
+                "b2": self.hyper_b2.init(k4, state)}
+
+    def apply(self, params, qs, state):
+        """qs: [B, M] chosen per-agent values; state: [B, s]."""
+        B = qs.shape[0]
+        w1 = jnp.abs(self.hyper_w1.apply(params["w1"], state)).reshape(
+            B, self.M, self.embed)
+        b1 = self.hyper_b1.apply(params["b1"], state)
+        w2 = jnp.abs(self.hyper_w2.apply(params["w2"], state))
+        b2 = self.hyper_b2.apply(params["b2"], state)[:, 0]
+        h = jax.nn.elu(jnp.einsum("bm,bme->be", qs, w1) + b1)
+        return jnp.einsum("be,be->b", h, w2) + b2
+
+
+class QMixState(NamedTuple):
+    params: Any          # {"agent": ..., "mixer": ...}
+    target_params: Any
+    opt_state: Any
+    env_states: Any
+    obs: jax.Array       # [N, M, obs_dim]
+    rng: jax.Array
+    replay: Any          # dict of arrays
+    env_steps: jax.Array
+    ep_return: jax.Array  # [N]
+    done_return_sum: jax.Array
+    done_count: jax.Array
+
+
+class QMix(Algorithm):
+    _default_config_cls = QMixConfig
+
+    def _setup_anakin(self):
+        config = self.config
+        env = make_ma_env(config.env) if isinstance(config.env, str) \
+            else config.env
+        M, A, obs_dim = env.num_agents, env.num_actions, env.obs_dim
+        state_dim = M * obs_dim   # global state = concat agent obs
+        N, T = config.num_envs, config.unroll_length
+        qnet = MLP(features=tuple(config.hiddens), out_dim=A)
+        mixer = Mixer(M, state_dim, config.mixing_embed_dim)
+        gamma = config.gamma
+        B = config.qmix_batch_size
+        tx = optax.adam(config.lr)
+        cap = max(config.buffer_size, N * T)
+        cap = ((cap + N * T - 1) // (N * T)) * (N * T)
+
+        def agent_qs(ap, obs):
+            """obs [..., M, obs_dim] -> [..., M, A] (weight-shared)."""
+            return qnet.apply(ap, obs)
+
+        def td_loss(p, tp, batch):
+            qs = agent_qs(p["agent"], batch["obs"])          # [B, M, A]
+            chosen = jnp.take_along_axis(
+                qs, batch["actions"][..., None], -1)[..., 0]  # [B, M]
+            state = batch["obs"].reshape(B, state_dim)
+            q_tot = mixer.apply(p["mixer"], chosen, state)
+            nqs_online = agent_qs(p["agent"], batch["next_obs"])
+            nqs_target = agent_qs(tp["agent"], batch["next_obs"])
+            na = jnp.argmax(nqs_online, axis=-1)              # [B, M]
+            nv = jnp.take_along_axis(nqs_target, na[..., None], -1)[..., 0]
+            nstate = batch["next_obs"].reshape(B, state_dim)
+            nq_tot = mixer.apply(tp["mixer"], nv, nstate)
+            # CoordinationGame rewards are shared: the team reward is the
+            # per-agent reward (identical across agents).
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * nq_tot
+            return jnp.mean((q_tot - jax.lax.stop_gradient(target)) ** 2)
+
+        def rollout(state, rng):
+            def one(carry, _):
+                env_states, obs, rng, ep_ret, dsum, dcnt, steps, ap = carry
+                rng, k_eps, k_rand, k_step = jax.random.split(rng, 4)
+                eps = jnp.clip(
+                    1.0 - (1.0 - config.epsilon_final) * steps
+                    / config.epsilon_decay_steps,
+                    config.epsilon_final, 1.0)
+                greedy = jnp.argmax(agent_qs(ap, obs), axis=-1)  # [N, M]
+                rand = jax.random.randint(k_rand, (N, M), 0, A)
+                act = jnp.where(
+                    jax.random.uniform(k_eps, (N, M)) < eps, rand, greedy)
+                env_states, next_obs, rew, done, _ = ma_vector_step(
+                    env, env_states, act, k_step)
+                team_r = rew[:, 0]   # shared reward
+                ep_ret = ep_ret + team_r
+                dsum = dsum + jnp.sum(jnp.where(done, ep_ret, 0.0))
+                dcnt = dcnt + jnp.sum(done)
+                ep_ret = jnp.where(done, 0.0, ep_ret)
+                out = (obs, act, team_r, next_obs,
+                       done.astype(jnp.float32))
+                return (env_states, next_obs, rng, ep_ret, dsum, dcnt,
+                        steps + N, ap), out
+
+            carry = (state.env_states, state.obs, rng, state.ep_return,
+                     state.done_return_sum, state.done_count,
+                     state.env_steps, state.params["agent"])
+            carry, tr = jax.lax.scan(one, carry, None, length=T)
+            env_states, obs, _, ep_ret, dsum, dcnt, steps, _ = carry
+            o, a, r, no, d = tr
+            n = N * T
+            flat = {"obs": o.reshape(n, M, obs_dim),
+                    "actions": a.reshape(n, M),
+                    "rewards": r.reshape(n),
+                    "next_obs": no.reshape(n, M, obs_dim),
+                    "dones": d.reshape(n)}
+            return env_states, obs, ep_ret, dsum, dcnt, steps, flat
+
+        def replay_insert(replay, flat):
+            n = flat["rewards"].shape[0]
+            pos = replay["pos"]
+            out = {}
+            for k, v in flat.items():
+                out[k] = jax.lax.dynamic_update_slice(
+                    replay[k], v, (pos,) + (0,) * (v.ndim - 1))
+            out["pos"] = (pos + n) % cap
+            out["size"] = jnp.minimum(replay["size"] + n, cap)
+            return out
+
+        def train_step(state: QMixState):
+            rng, k_roll, k_q = jax.random.split(state.rng, 3)
+            (env_states, obs, ep_ret, dsum, dcnt, steps,
+             flat) = rollout(state, k_roll)
+            replay = replay_insert(state.replay, flat)
+
+            def q_update(carry, k):
+                p, tp, opt = carry
+                idx = jax.random.randint(
+                    k, (B,), 0, jnp.maximum(replay["size"], 1))
+                batch = {kk: replay[kk][idx]
+                         for kk in ("obs", "actions", "rewards",
+                                    "next_obs", "dones")}
+                loss, grads = jax.value_and_grad(td_loss)(p, tp, batch)
+                up, opt = tx.update(grads, opt, p)
+                p = optax.apply_updates(p, up)
+                tp = jax.tree.map(
+                    lambda t, o: t * (1 - config.target_network_tau)
+                    + o * config.target_network_tau, tp, p)
+                return (p, tp, opt), loss
+
+            warm = replay["size"] >= config.learning_starts
+            (p, tp, opt), losses = jax.lax.scan(
+                q_update,
+                (state.params, state.target_params, state.opt_state),
+                jax.random.split(k_q, config.num_updates_per_iter))
+            p, tp, opt = jax.tree.map(
+                lambda new, old: jnp.where(warm, new, old),
+                (p, tp, opt),
+                (state.params, state.target_params, state.opt_state))
+            new_state = QMixState(p, tp, opt, env_states, obs, rng,
+                                  replay, steps, ep_ret, dsum, dcnt)
+            metrics = {"total_loss": losses.mean(),
+                       "episode_return_sum": dsum,
+                       "episode_count": dcnt}
+            return new_state, metrics
+
+        key = jax.random.PRNGKey(config.seed)
+        k_q, k_m, k_env, k_rng = jax.random.split(key, 4)
+        env_states, obs0 = ma_vector_reset(env, k_env, N)
+        ap = qnet.init(k_q, obs0)
+        mp = mixer.init(k_m, obs0.reshape(N, state_dim))
+        params = {"agent": ap, "mixer": mp}
+        replay0 = {
+            "obs": jnp.zeros((cap, M, obs_dim), jnp.float32),
+            "actions": jnp.zeros((cap, M), jnp.int32),
+            "rewards": jnp.zeros((cap,), jnp.float32),
+            "next_obs": jnp.zeros((cap, M, obs_dim), jnp.float32),
+            "dones": jnp.zeros((cap,), jnp.float32),
+            "pos": jnp.zeros((), jnp.int32),
+            "size": jnp.zeros((), jnp.int32),
+        }
+        self._anakin_state = QMixState(
+            params, jax.tree.map(lambda x: x, params), tx.init(params),
+            env_states, obs0, k_rng, replay0, jnp.zeros((), jnp.int32),
+            jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
+        self._train_step = jax.jit(train_step)
+        self._steps_per_iter = N * T * M
+
+    def _training_step_anakin(self) -> Dict[str, Any]:
+        self._anakin_state, metrics = self._train_step(self._anakin_state)
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics = self._episode_counter_metrics(metrics)
+        metrics["num_env_steps_sampled_this_iter"] = self._steps_per_iter
+        return metrics

@@ -260,7 +260,19 @@ def run(dep: Deployment, name: Optional[str] = None) -> DeploymentHandle:
         # kill would route proxies at corpses.
         broadcast_routes()
         old._teardown()
-    handle = dep._deploy()
+    try:
+        handle = dep._deploy()
+    except BaseException:
+        if old is not None:
+            # Roll back: a failed redeploy must not leave a previously
+            # healthy name with zero replicas.
+            try:
+                old._deploy()
+                _deployments[key] = old
+                broadcast_routes()
+            except Exception:
+                pass
+        raise
     _deployments[key] = dep
     broadcast_routes()
     return handle
@@ -285,12 +297,13 @@ def shutdown():
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
-    for p in _node_proxies:
+    for p in _node_proxies + _demoted_proxies:
         try:
             ray_tpu.kill(p)
         except Exception:
             pass
     _node_proxies.clear()
+    _demoted_proxies.clear()
     from ray_tpu.serve.controller import reset_controller
 
     reset_controller()
@@ -458,6 +471,7 @@ class HTTPProxyActor:
 
 
 _node_proxies: List[Any] = []
+_demoted_proxies: List[Any] = []
 _proxy_strikes: Dict[int, int] = {}
 _PROXY_MAX_STRIKES = 3
 
@@ -467,10 +481,12 @@ def _proxy_ok(p):
 
 
 def _proxy_failed(p):
-    """Strike a proxy; after 3 consecutive failures drop AND KILL it — a
-    dead node's proxy must not add its RPC timeout to every controller
-    poll forever, and a merely-slow one must not keep serving a stale
-    route table after it stops receiving broadcasts."""
+    """Strike a proxy; after 3 consecutive failures DEMOTE it — its RPC
+    timeout must not stall every controller poll, but a merely-slow
+    proxy on a live node keeps its listening socket and still receives
+    best-effort route broadcasts (a successful broadcast ack promotes it
+    back); killing it would turn three slow polls into a permanent
+    ingress outage for that node."""
     n = _proxy_strikes.get(id(p), 0) + 1
     _proxy_strikes[id(p)] = n
     if n >= _PROXY_MAX_STRIKES:
@@ -478,11 +494,9 @@ def _proxy_failed(p):
             _node_proxies.remove(p)
         except ValueError:
             pass
+        if p not in _demoted_proxies:
+            _demoted_proxies.append(p)
         _proxy_strikes.pop(id(p), None)
-        try:
-            ray_tpu.kill(p)
-        except Exception:
-            pass
 
 
 def start_http_proxy(port: int = 0) -> int:
@@ -568,12 +582,25 @@ def broadcast_routes() -> None:
     acks = []
     for p in list(_node_proxies):
         try:
-            acks.append((p, p.update_routes.remote(routes)))
+            acks.append((p, False, p.update_routes.remote(routes)))
         except Exception:
             _proxy_failed(p)
-    for p, a in acks:
+    for p in list(_demoted_proxies):
+        try:
+            acks.append((p, True, p.update_routes.remote(routes)))
+        except Exception:
+            pass
+    for p, demoted, a in acks:
         try:
             ray_tpu.get(a, timeout=10)
+            if demoted:
+                # The proxy answered again: back into the healthy pool.
+                try:
+                    _demoted_proxies.remove(p)
+                except ValueError:
+                    pass
+                _node_proxies.append(p)
             _proxy_ok(p)
         except Exception:
-            _proxy_failed(p)
+            if not demoted:
+                _proxy_failed(p)

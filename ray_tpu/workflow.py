@@ -4,7 +4,16 @@ Reference: python/ray/workflow/ (workflow_executor.py, storage-backed step
 results; 10.1k LoC there).  The essentials here: steps are remote tasks
 whose results are checkpointed to a storage dir keyed by (workflow_id,
 step name); re-running a workflow skips completed steps (idempotent
-resume after a crash).
+resume after a crash).  Also covered from the reference surface:
+
+- exception retries with backoff + ``catch_exceptions`` (reference:
+  workflow step options retry_exceptions / catch_exceptions),
+- dynamic continuations — a step may RETURN another step node and the
+  workflow continues through it (reference: workflow.continuation /
+  recursive workflows, workflow_executor.py),
+- virtual actors — named durable objects whose state lives in workflow
+  storage and whose method calls are checkpointed steps (reference:
+  workflow/virtual_actor 1.x surface).
 """
 from __future__ import annotations
 
@@ -26,18 +35,29 @@ def init(storage: str):
 
 class StepNode:
     def __init__(self, fn: Callable, args: tuple, kwargs: dict,
-                 name: Optional[str] = None, max_retries: int = 3):
+                 name: Optional[str] = None, max_retries: int = 3,
+                 retry_exceptions: int = 0,
+                 catch_exceptions: bool = False):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
         self.max_retries = max_retries
+        self.retry_exceptions = retry_exceptions
+        self.catch_exceptions = catch_exceptions
         self.name = name or getattr(fn, "__name__", "step")
 
-    def options(self, name: Optional[str] = None, max_retries: Optional[int] = None):
+    def options(self, name: Optional[str] = None,
+                max_retries: Optional[int] = None,
+                retry_exceptions: Optional[int] = None,
+                catch_exceptions: Optional[bool] = None):
         if name:
             self.name = name
         if max_retries is not None:
             self.max_retries = max_retries
+        if retry_exceptions is not None:
+            self.retry_exceptions = retry_exceptions
+        if catch_exceptions is not None:
+            self.catch_exceptions = catch_exceptions
         return self
 
 
@@ -60,7 +80,10 @@ def _step_key(workflow_id: str, node: StepNode, resolved_args) -> str:
     try:
         h.update(pickle.dumps(resolved_args))
     except Exception:
-        pass
+        # Unpicklable args: repr-hash so same-name steps with different
+        # args still get distinct checkpoints (a bare-name fallback would
+        # collide recursive continuations onto one file).
+        h.update(repr(resolved_args).encode())
     return f"{workflow_id}/{node.name}_{h.hexdigest()[:12]}"
 
 
@@ -77,6 +100,8 @@ def run(node: StepNode, workflow_id: str) -> Any:
 
 
 def _run_node(node: StepNode, workflow_id: str) -> Any:
+    import time
+
     resolved_args = [
         _run_node(a, workflow_id) if isinstance(a, StepNode) else a
         for a in node.args
@@ -91,7 +116,35 @@ def _run_node(node: StepNode, workflow_id: str) -> Any:
         with open(path, "rb") as f:
             return pickle.load(f)  # resume: step already completed
     remote_fn = ray_tpu.remote(node.fn).options(max_retries=node.max_retries)
-    result = ray_tpu.get(remote_fn.remote(*resolved_args, **resolved_kwargs))
+    # Exception retries with backoff (worker-crash retries ride the task's
+    # own max_retries; USER exceptions retry here — reference: workflow
+    # step retry options).
+    attempt = 0
+    result, caught = None, None
+    while True:
+        try:
+            result = ray_tpu.get(
+                remote_fn.remote(*resolved_args, **resolved_kwargs))
+            break
+        except Exception as e:  # noqa: BLE001 — the retry/catch surface
+            attempt += 1
+            if attempt <= node.retry_exceptions:
+                time.sleep(min(0.2 * 2 ** (attempt - 1), 5.0))
+                continue
+            if node.catch_exceptions:
+                caught = e
+                break
+            raise
+    # Dynamic continuation FIRST (a caught-exception result is never a
+    # StepNode, and a successful StepNode return must execute before the
+    # catch contract wraps it): the continuation's steps checkpoint
+    # independently, and the PARENT records the final resolved value.
+    while isinstance(result, StepNode):
+        result = _run_node(result, workflow_id)
+    if node.catch_exceptions:
+        # ALWAYS the (result, error) pair — the reference's catch
+        # contract — checkpointed like any result.
+        result = (result, caught)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump(result, f)
@@ -102,3 +155,88 @@ def _run_node(node: StepNode, workflow_id: str) -> Any:
 def list_steps(workflow_id: str) -> List[str]:
     d = os.path.join(_storage_dir, workflow_id)
     return sorted(os.listdir(d)) if os.path.isdir(d) else []
+
+
+# ---------------------------------------------------------------------------
+# Virtual actors: named durable state in workflow storage; every method
+# call is a checkpointed step (reference: the 1.x workflow virtual-actor
+# surface — get_or_create / get_actor, state persisted per actor id).
+# ---------------------------------------------------------------------------
+class VirtualActorHandle:
+    def __init__(self, cls: type, actor_id: str):
+        self._cls = cls
+        self._actor_id = actor_id
+
+    def _state_path(self) -> str:
+        return os.path.join(_storage_dir, "_virtual_actors",
+                            f"{self._actor_id}.pkl")
+
+    def _load(self):
+        with open(self._state_path(), "rb") as f:
+            return pickle.load(f)
+
+    def _store(self, state) -> None:
+        path = self._state_path()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, path)  # atomic: a crash keeps the old state
+
+    def _ensure(self, init_args, init_kwargs) -> None:
+        path = self._state_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if not os.path.exists(path):
+            inst = self._cls(*init_args, **init_kwargs)
+            self._store(inst.__dict__)
+
+    def __getattr__(self, name: str):
+        method = getattr(self._cls, name)
+
+        def call(*args, **kwargs):
+            import fcntl
+
+            # Serialize load-mutate-store per actor id: without the lock
+            # two concurrent callers both read state N and both write
+            # N+1, silently losing an update (the reference serializes
+            # virtual-actor calls through its step queue).
+            with open(self._state_path() + ".lock", "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                inst = self._cls.__new__(self._cls)
+                inst.__dict__.update(self._load())
+                out = method(inst, *args, **kwargs)
+                self._store(inst.__dict__)
+            return out
+
+        return call
+
+
+def virtual_actor(cls: type):
+    """@workflow.virtual_actor: durable named instances.
+
+    ``Cls.get_or_create(actor_id, *args)`` creates (or loads) the actor's
+    persisted state; method calls load state, execute, and atomically
+    persist the mutated state — surviving process restarts."""
+
+    def get_or_create(actor_id: str, *args, **kwargs) -> VirtualActorHandle:
+        if _storage_dir is None:
+            raise RuntimeError("workflow.init(storage_dir) first")
+        h = VirtualActorHandle(cls, actor_id)
+        h._ensure(args, kwargs)
+        return h
+
+    def get_actor(actor_id: str) -> VirtualActorHandle:
+        h = VirtualActorHandle(cls, actor_id)
+        if not os.path.exists(h._state_path()):
+            raise KeyError(f"no virtual actor {actor_id!r}")
+        return h
+
+    cls.get_or_create = staticmethod(get_or_create)
+    cls.get_actor = staticmethod(get_actor)
+    return cls
+
+
+def get_actor(actor_id: str, cls: type) -> VirtualActorHandle:
+    h = VirtualActorHandle(cls, actor_id)
+    if not os.path.exists(h._state_path()):
+        raise KeyError(f"no virtual actor {actor_id!r}")
+    return h

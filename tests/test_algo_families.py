@@ -1,0 +1,93 @@
+"""The four remaining algorithm families (VERDICT r4 Missing #8):
+ES (evolution), contextual bandits (LinUCB/LinTS), model-based (DynaQ),
+and cooperative value factorization (QMIX).  Each gate is a LEARNING
+check, not a smoke run."""
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (
+    BanditLinTSConfig,
+    BanditLinUCBConfig,
+    DynaQConfig,
+    ESConfig,
+    QMixConfig,
+    get_algorithm_config,
+)
+
+
+def test_registry_has_all_families():
+    for name in ("ES", "BanditLinUCB", "BanditLinTS", "DynaQ", "QMIX"):
+        cfg = get_algorithm_config(name)
+        assert cfg.algo_class is not None
+
+
+def test_es_learns_cartpole():
+    algo = (ESConfig().environment("CartPole-v1")
+            .training(population_size=128, noise_stdev=0.1, lr=0.03,
+                      episode_length=200)
+            .debugging(seed=0).build())
+    best = -1.0
+    for _ in range(30):
+        m = algo.train()
+        best = max(best, m["episode_reward_mean"])
+        if best >= 120:
+            break
+    assert best >= 120, f"ES failed to evolve CartPole: best={best}"
+
+
+def test_linucb_regret_sublinear():
+    algo = BanditLinUCBConfig().debugging(seed=0).build()
+    m1 = algo.train()
+    for _ in range(8):
+        m = algo.train()
+    # Per-round regret in the last iter must be far below the first
+    # (exploration collapses onto the optimal arm).
+    assert m["regret_this_iter"] < 0.3 * max(m1["regret_this_iter"], 1e-9)
+    # Mean reward approaches the optimal arm's.
+    assert m["episode_reward_mean"] > 0.0
+
+
+def test_lints_regret_sublinear():
+    algo = BanditLinTSConfig().debugging(seed=1).build()
+    m1 = algo.train()
+    for _ in range(8):
+        m = algo.train()
+    assert m["regret_this_iter"] < 0.3 * max(m1["regret_this_iter"], 1e-9)
+
+
+def test_dynaq_learns_cartpole_and_model_converges():
+    algo = (DynaQConfig().environment("CartPole-v1")
+            .anakin(num_envs=32, unroll_length=16)
+            .training(lr=1e-3, learning_starts=500,
+                      num_updates_per_iter=8, epsilon_decay_steps=15_000)
+            .debugging(seed=0).build())
+    best, first_mloss, last = -1.0, None, {}
+    for _ in range(80):
+        last = algo.train()
+        r = last.get("episode_reward_mean", float("nan"))
+        if np.isfinite(r):
+            best = max(best, r)
+        if first_mloss is None and np.isfinite(last["model_loss"]):
+            first_mloss = last["model_loss"]
+        if best >= 100:
+            break
+    assert best >= 100, f"DynaQ failed to learn CartPole: best={best}"
+    # The dynamics model must actually fit (model-based, not decorative).
+    assert last["model_loss"] < first_mloss
+
+
+def test_qmix_learns_coordination():
+    algo = (QMixConfig().environment("CoordinationGame-v0")
+            .debugging(seed=0).build())
+    best = -1.0
+    for _ in range(150):
+        m = algo.train()
+        r = m.get("episode_reward_mean", float("nan"))
+        if np.isfinite(r):
+            best = max(best, r)
+        if best >= 12:
+            break
+    # 16-step episodes, reward 1 per coordinated step: random play scores
+    # ~8 in expectation for 2 agents... no: P(match)=0.5 -> ~8.  QMIX must
+    # clearly beat it (>= 12 of 16).
+    assert best >= 12, f"QMIX failed to coordinate: best={best}"
