@@ -635,7 +635,8 @@ A_RESOLVING, A_UP, A_CLASSIC = 0, 1, 2
 
 
 class _ActorClient:
-    __slots__ = ("actor_id", "state", "chan", "queue", "inflight")
+    __slots__ = ("actor_id", "state", "chan", "queue", "inflight",
+                 "worker_id")
 
     def __init__(self, actor_id):
         self.actor_id = actor_id
@@ -643,6 +644,11 @@ class _ActorClient:
         self.chan: Optional[DirectChannel] = None
         self.queue: deque = deque()      # specs waiting for the channel
         self.inflight: Dict[TaskID, TaskSpec] = {}
+        # The incarnation (worker id bytes) this client is connected to:
+        # dead-channel reroutes carry it so the head can tell "this call
+        # ran on the incarnation that died" from a fresh submission and
+        # never replays a budget-exhausted call on a restarted actor.
+        self.worker_id: Optional[bytes] = None
 
 
 class _Inflight:
@@ -824,6 +830,7 @@ class DirectSubmitter:
                 if ep is None:
                     self._actor_to_classic(ac, None)
                     return
+                ac.worker_id = got.get("worker_id")
                 try:
                     chan = DirectChannel(ep, self.authkey,
                                          on_done=self._on_done,
@@ -884,7 +891,8 @@ class DirectSubmitter:
                 self._reroute_classic(spec, actor=True)
 
     def _reroute_classic(self, spec: TaskSpec, actor: bool = False,
-                         inf: Optional[_Inflight] = None):
+                         inf: Optional[_Inflight] = None,
+                         dead_worker: Optional[bytes] = None):
         if inf is None:
             with self._lock:
                 inf = self._inflight.pop(spec.task_id.binary(), None)
@@ -894,8 +902,15 @@ class DirectSubmitter:
             self._make_extern_mirrored(oid)
         try:
             self.core._promote_owned_args(spec)
+            payload = {"spec": spec}
+            if dead_worker is not None:
+                # Budget-exhausted call from a dead channel: the head
+                # must FAIL it if the actor's incarnation has moved on —
+                # re-executing it on the restarted actor would replay a
+                # possibly-fatal call the caller already gave up on.
+                payload["dead_worker"] = dead_worker
             self.core.transport.request_oneway(
-                "actor_call" if actor else "submit", {"spec": spec})
+                "actor_call" if actor else "submit", payload)
         except Exception:
             meta, data = _pack_error(exc.RayTpuError(
                 "task lost: could not reach the head for fallback"))
@@ -1054,10 +1069,12 @@ class DirectSubmitter:
                     if lease.chan is chan:
                         lease.alive = False
                         pool.remove(lease)
+            dead_worker_id: Optional[bytes] = None
             for ac in self._actors.values():
                 if ac.chan is chan:
                     dead_actor = ac
                     ac.chan = None
+                    dead_worker_id = ac.worker_id
             if dead_actor is not None:
                 replay: List[TaskSpec] = []
                 no_budget: List[TaskSpec] = []
@@ -1107,7 +1124,8 @@ class DirectSubmitter:
                 self._reroute_classic(inf.spec)
         if dead_actor is not None:
             for spec in no_budget:
-                self._reroute_classic(spec, actor=True)
+                self._reroute_classic(spec, actor=True,
+                                      dead_worker=dead_worker_id)
             if not self._closed:
                 self._resolve_actor_async(dead_actor)
 

@@ -838,7 +838,7 @@ class Head:
 
     def req_actor_call(self, payload, reply, caller):
         spec: TaskSpec = payload["spec"]
-        self.submit_actor_task(spec)
+        self.submit_actor_task(spec, dead_worker=payload.get("dead_worker"))
         reply(True)
 
     def req_wait_actor_alive(self, payload, reply, caller):
@@ -1138,11 +1138,21 @@ class Head:
                                     node_id=node_id)
         raylet.queue_task(spec)
 
-    def submit_actor_task(self, spec: TaskSpec):
+    def submit_actor_task(self, spec: TaskSpec,
+                          dead_worker: Optional[bytes] = None):
         """Route an actor task to the actor's dedicated worker, or queue it
         while the actor is pending/restarting (reference: direct actor task
         submitter's per-actor ordered queue,
-        transport/direct_actor_task_submitter.h:67)."""
+        transport/direct_actor_task_submitter.h:67).
+
+        ``dead_worker`` marks a budget-exhausted call rerouted off a dead
+        direct channel: it may only land on the SAME incarnation (whose
+        death processing will then fail it authoritatively).  If the
+        actor has restarted — or is restarting — the call belongs to the
+        dead incarnation and must fail, never re-execute: replaying a
+        call the caller has no retry budget for onto a fresh incarnation
+        re-runs side effects (and a poison call would kill every restart
+        until the actor goes DEAD)."""
         with self._lock:
             info = self.gcs.get_actor_info(spec.actor_id)
             if info is None:
@@ -1152,6 +1162,13 @@ class Head:
                 self._fail_task(spec, exc.ActorDiedError(
                     info.death_cause or "actor is dead"))
                 return
+            if dead_worker is not None:
+                cur = (info.worker_id.binary()
+                       if info.worker_id is not None else None)
+                if info.state != ActorState.ALIVE or cur != dead_worker:
+                    self._fail_task(spec, exc.ActorDiedError(
+                        info.death_cause or "actor worker died"))
+                    return
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, TaskStatus.PENDING,
                 type="ACTOR_TASK", parent_task_id=spec.parent_task_id))
@@ -1169,7 +1186,16 @@ class Head:
         self.gcs.update_task_status(spec.task_id, TaskStatus.RUNNING,
                                     worker_id=info.worker_id)
         if not self._send_on(conn, {"type": "execute", "spec": spec}):
-            info.pending_calls.append(spec)
+            # Send failed: this worker's conn is breaking.  Run death
+            # processing NOW (idempotent; the lock is reentrant) so the
+            # spec left in `running` is adopted and the actor FSM decides
+            # replay-vs-fail by retry budget — a writer-only failure must
+            # not strand the call, and requeueing to pending_calls here
+            # would bypass the budget and re-execute the call on the NEXT
+            # incarnation (a poison call — e.g. one that os._exit()s the
+            # worker — would then kill every restart until the actor went
+            # DEAD).
+            self.on_conn_closed(info.worker_id)
 
     def on_task_done(self, msg: dict):
         from ray_tpu._private.chaos import maybe_delay

@@ -71,3 +71,43 @@ def test_app_error_not_retried_by_default(cluster):
     c = Counter.remote()
     with pytest.raises(Exception):
         ray_tpu.get(c.flaky.remote(), timeout=30)
+
+
+def test_poison_call_never_replays_on_restarted_incarnation(cluster):
+    """A budget-exhausted in-flight call that KILLS its worker (poison)
+    must fail with ActorDiedError and NEVER re-execute on the restarted
+    incarnation — the race where the dead channel's reroute (or a failed
+    send requeue) lands the call in pending_calls would otherwise replay
+    it and kill every restart until the actor went DEAD."""
+
+    @ray_tpu.remote
+    class Poisoned:
+        def __init__(self):
+            self.alive_checks = 0
+
+        def ping(self):
+            self.alive_checks += 1
+            return self.alive_checks
+
+        def poison(self):
+            os._exit(1)
+
+    for _ in range(3):  # the original bug was a race: iterate
+        a = Poisoned.options(max_restarts=1).remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(a.poison.remote(), timeout=60)
+        # The restarted incarnation must come up and STAY up.
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                assert ray_tpu.get(a.ping.remote(), timeout=10) == 1
+                ok = True
+                break
+            except AssertionError:
+                raise
+            except Exception:  # died OR still restarting under load
+                time.sleep(0.2)
+        assert ok, "restarted incarnation died (poison call replayed?)"
+        ray_tpu.kill(a)

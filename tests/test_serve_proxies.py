@@ -94,9 +94,21 @@ def test_per_node_proxies_route_and_autoscale(two_node_cluster):
     assert not errors, f"proxy requests failed under load: {errors[:3]}"
 
     # The broadcast reached the node proxies: their tables carry the
-    # scaled replica set, and requests still succeed on both.
+    # scaled replica set, and requests still succeed on both.  Retry a
+    # few times: right after load stops, a downscale drain can race a
+    # single request under heavy machine load.
     for p in port_list:
-        assert _post(p, "double", 5)["result"] == 10
+        deadline = time.monotonic() + 20
+        while True:
+            try:
+                assert _post(p, "double", 5)["result"] == 10
+                break
+            except AssertionError:
+                raise
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
 
     # Unknown routes 404 on node proxies too.
     with pytest.raises(urllib.error.HTTPError) as err:
