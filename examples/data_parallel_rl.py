@@ -1,0 +1,45 @@
+"""Data-parallel anakin PPO: ONE SPMD program over a `data` mesh.
+
+Run on any host with N accelerator chips (or simulate on CPU):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/data_parallel_rl.py
+
+With ``.resources(num_devices=N)`` the whole train step — env rollout,
+GAE, the minibatch SGD scan — compiles as one shard_map'd program: envs
+shard across the axis, params stay replicated, and the only cross-chip
+traffic is the gradient all-reduce riding ICI.  The same script scales
+from one chip to a pod slice without code changes.
+"""
+import jax
+
+from ray_tpu.rllib import PPOConfig
+
+
+def main():
+    n_dev = len(jax.devices())
+    print(f"devices: {n_dev} x {jax.devices()[0].platform}")
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .anakin(num_envs=8 * n_dev, unroll_length=64)
+            .training(lr=3e-4, num_sgd_iter=4,
+                      sgd_minibatch_size=64 * n_dev)
+            .resources(num_devices=n_dev)
+            .debugging(seed=0)
+            .build())
+    for i in range(30):
+        m = algo.train()
+        if i % 5 == 0:
+            print(f"iter {i:3d} reward={m.get('episode_reward_mean', float('nan')):7.2f} "
+                  f"loss={m['total_loss']:.4f}")
+    # Params are bitwise-replicated across every device: a broken
+    # all-reduce would drift the replicas apart.
+    leaf = jax.tree.leaves(algo._anakin_state.params)[0]
+    shards = {bytes(memoryview(s.data.tobytes()))
+              for s in leaf.addressable_shards}
+    assert len(shards) == 1, "replicas drifted!"
+    print("replicas identical across devices — OK")
+
+
+if __name__ == "__main__":
+    main()
