@@ -485,11 +485,17 @@ class CoreWorker:
 
     def remove_local_ref_deferred(self, oid: ObjectID,
                                   owner_addr: Optional[dict] = None):
-        """ObjectRef.__del__ entry point: no I/O on the caller's thread."""
+        """ObjectRef.__del__ entry point: no I/O on the caller's thread.
+
+        Deliberately NO wakeup per drop: setting the event would hand the
+        GIL to the drainer on every ObjectRef death (measured 4x slower
+        small-put throughput); the drainer polls on a short interval and
+        the event is only used to flush a flooded queue promptly."""
         if self._closed:
             return
         self._ref_gc_queue.append((oid, owner_addr))
-        self._ref_gc_wake.set()
+        if len(self._ref_gc_queue) >= 4096:
+            self._ref_gc_wake.set()
 
     def _drain_ref_gc_queue(self):
         while self._ref_gc_queue:
@@ -504,7 +510,7 @@ class CoreWorker:
 
     def _ref_gc_loop(self):
         while not self._closed:
-            self._ref_gc_wake.wait(timeout=0.5)
+            self._ref_gc_wake.wait(timeout=0.005)
             self._ref_gc_wake.clear()
             self._drain_ref_gc_queue()
 
