@@ -1,19 +1,45 @@
-"""Lazy DAG nodes (reference: python/ray/dag/dag_node.py — FunctionNode/
-ClassNode graphs used by Serve deployment graphs)."""
+"""Lazy DAG nodes (reference: python/ray/dag/ — DAGNode/FunctionNode/
+ClassNode/ClassMethodNode/InputNode/MultiOutputNode graphs, used
+standalone and by Serve deployment graphs).
+
+Semantics kept from the reference:
+
+- ``.bind(*args)`` builds the graph lazily; nothing runs until
+  ``execute``.
+- A shared subgraph (diamond) executes ONCE per ``execute`` call — node
+  results are memoized per run, not recomputed per consumer.
+- ``ActorClass.bind(...)`` creates the actor at first execute; method
+  nodes (``class_node.method.bind(...)``) call it, serializing through
+  the actor's ordered mailbox.
+- Upstream results flow as ObjectRefs straight into downstream
+  ``.remote`` calls — the object store carries the dataflow; the driver
+  never materializes intermediate values.
+"""
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
 
 
 class DAGNode:
-    def execute(self):
+    def execute(self, _ctx: Optional[dict] = None):
+        """Run the DAG rooted here; returns an ObjectRef (or a list for
+        MultiOutputNode).  `_ctx` memoizes shared subgraphs per run."""
+        ctx = {} if _ctx is None else _ctx
+        key = id(self)
+        if key not in ctx:
+            ctx[key] = self._run(ctx)
+        return ctx[key]
+
+    def _run(self, ctx: dict):
         raise NotImplementedError
 
-    def _resolve(self, v):
+    def _resolve(self, v, ctx: dict):
+        """DAG children execute (memoized); ObjectRefs pass through so
+        the dataflow rides the object store."""
         if isinstance(v, DAGNode):
-            return v.execute()
+            return v.execute(ctx)
         return v
 
 
@@ -23,20 +49,82 @@ class FunctionNode(DAGNode):
         self.args = args
         self.kwargs = kwargs
 
-    def execute(self):
-        args = [self._resolve(a) for a in self.args]
-        kwargs = {k: self._resolve(v) for k, v in self.kwargs.items()}
-        args = [ray_tpu.get(a) if hasattr(a, "id") else a for a in args]
+    def _run(self, ctx: dict):
+        args = [self._resolve(a, ctx) for a in self.args]
+        kwargs = {k: self._resolve(v, ctx) for k, v in self.kwargs.items()}
         return self.fn.remote(*args, **kwargs)
 
 
+class ClassNode(DAGNode):
+    """Actor instantiation node: executes to a live ActorHandle.  The
+    actor is created ONCE per ClassNode and reused across every
+    ``execute`` run (the reference's serve-graph semantics — class nodes
+    are long-lived replicas); without this, each run would leak a live
+    actor and its pinned resources, since actor handles have no scope
+    GC.  ``teardown()`` kills the actor."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        self.actor_cls = actor_cls
+        self.args = args
+        self.kwargs = kwargs
+        self._handle = None
+
+    def _run(self, ctx: dict):
+        if self._handle is None:
+            args = [self._resolve(a, ctx) for a in self.args]
+            kwargs = {k: self._resolve(v, ctx)
+                      for k, v in self.kwargs.items()}
+            self._handle = self.actor_cls.remote(*args, **kwargs)
+        return self._handle
+
+    def teardown(self):
+        if self._handle is not None:
+            try:
+                ray_tpu.kill(self._handle)
+            except Exception:
+                pass
+            self._handle = None
+
+    def __getattr__(self, name: str):
+        if name.startswith("_") or name == "teardown":
+            raise AttributeError(name)
+        return _ClassMethodBinder(self, name)
+
+
+class _ClassMethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        self.class_node = class_node
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+
+    def _run(self, ctx: dict):
+        handle = self.class_node.execute(ctx)  # memoized: one actor/run
+        args = [self._resolve(a, ctx) for a in self.args]
+        kwargs = {k: self._resolve(v, ctx) for k, v in self.kwargs.items()}
+        return getattr(handle, self.method).remote(*args, **kwargs)
+
+
 class InputNode(DAGNode):
-    """Placeholder bound at execute time: dag.execute(input=...)"""
+    """Placeholder bound at execute time: execute(dag, input_value).
+    The binding is thread-local so concurrent executes on different
+    driver threads cannot clobber each other's input."""
 
-    _current: Any = None
+    import threading as _threading
 
-    def execute(self):
-        return InputNode._current
+    _tls = _threading.local()
+
+    def _run(self, ctx: dict):
+        return getattr(InputNode._tls, "current", None)
 
     def __enter__(self):
         return self
@@ -45,13 +133,29 @@ class InputNode(DAGNode):
         return False
 
 
+class MultiOutputNode(DAGNode):
+    """Fan-in terminal: executes to a LIST of refs, one per output
+    (reference: dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        self.outputs = list(outputs)
+
+    def _run(self, ctx: dict):
+        return [self._resolve(o, ctx) for o in self.outputs]
+
+
 def bind(remote_fn, *args, **kwargs) -> FunctionNode:
     return FunctionNode(remote_fn, args, kwargs)
 
 
+def bind_class(actor_cls, *args, **kwargs) -> ClassNode:
+    return ClassNode(actor_cls, args, kwargs)
+
+
 def execute(node: DAGNode, input_value: Any = None):
-    InputNode._current = input_value
+    prev = getattr(InputNode._tls, "current", None)
+    InputNode._tls.current = input_value
     try:
         return node.execute()
     finally:
-        InputNode._current = None
+        InputNode._tls.current = prev  # restore: nested executes compose
