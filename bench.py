@@ -339,7 +339,10 @@ def bench_ppo_real_env() -> dict:
                                                   max_iters=120)
         out["ppo_real_env_reward_floor_met"] = floor_met
         if reward == reward:
-            out["ppo_real_env_reward"] = round(reward, 2)
+            # The reward at the moment the gate passed; the post-measure
+            # reading below is reported separately (LunarLander episode
+            # means are noisy iteration to iteration).
+            out["ppo_real_env_gate_reward"] = round(reward, 2)
         if not floor_met:
             if best > float("-inf"):
                 out["ppo_real_env_best_reward"] = round(best, 2)
