@@ -338,15 +338,15 @@ def bench_ppo_real_env() -> dict:
         floor_met, reward, best = _learn_to_floor(algo, floor,
                                                   max_iters=120)
         out["ppo_real_env_reward_floor_met"] = floor_met
+        if not floor_met:
+            if best > float("-inf"):
+                out["ppo_real_env_best_reward"] = round(best, 2)
+            return out
         if reward == reward:
             # The reward at the moment the gate passed; the post-measure
             # reading below is reported separately (LunarLander episode
             # means are noisy iteration to iteration).
             out["ppo_real_env_gate_reward"] = round(reward, 2)
-        if not floor_met:
-            if best > float("-inf"):
-                out["ppo_real_env_best_reward"] = round(best, 2)
-            return out
         steps_per_iter = (algo.config.num_rollout_workers
                           * algo.config.num_envs_per_worker
                           * algo.config.rollout_fragment_length)
