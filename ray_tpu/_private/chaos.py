@@ -7,13 +7,29 @@ actor (python/ray/_private/test_utils.py:1337).
 Enable delays with RAY_TPU_TESTING_DELAY_MS="<op_substr>:<min>:<max>", e.g.
 "submit:0:20" delays every task submission by 0-20ms.  `kill_random_worker`
 is the in-process node-killer equivalent.
+
+Gang-level fault injection (mesh fault-tolerance testing): set
+RAY_TPU_TESTING_KILL_SCHEDULE to a ``;``-separated list of
+``<op>:<rank>:<nth>[:<generation>]`` entries — when the matching op fires
+for the ``nth`` time (1-based, counted per process) at ``rank`` in gang
+``generation`` the process SIGKILLs itself, simulating a hard rank crash
+mid-collective.  ``rank`` and ``generation`` accept ``*`` (any); generation
+defaults to ``0`` so a restarted gang (which re-exports
+RTPU_MESH_GENERATION) survives by default, making restart-then-succeed
+loops deterministic.  Kill sites: ``mesh_run`` (MeshWorker.run entry) and
+``train_report`` (TrainWorker result reporting).  Driver-side,
+``kill_mesh_rank`` murders a specific (or seeded-random) rank of a live
+MeshGroup/WorkerGroup by killing its hosting worker process.
 """
 from __future__ import annotations
 
 import os
 import random
 import time
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
+
+KILL_SCHEDULE_ENV = "RAY_TPU_TESTING_KILL_SCHEDULE"
+GENERATION_ENV = "RTPU_MESH_GENERATION"
 
 
 def _parse() -> Optional[Tuple[str, float, float]]:
@@ -35,6 +51,124 @@ def maybe_delay(op: str):
     needle, lo, hi = parsed
     if needle in op:
         time.sleep(random.uniform(lo, hi) / 1000.0)
+
+
+class ChaosSchedule:
+    """A deterministic rank-kill schedule, parsed once per process.
+
+    Entries are (op, rank, nth, generation); rank/generation may be None
+    (wildcard).  ``should_die(op, rank)`` is called at each kill site with
+    the process's per-op invocation count and the gang generation from
+    RTPU_MESH_GENERATION."""
+
+    def __init__(self, entries: List[Tuple[str, Optional[int], int,
+                                           Optional[int]]]):
+        self.entries = list(entries)
+        self._counts: dict = {}
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ChaosSchedule":
+        entries = []
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) not in (3, 4):
+                continue
+            op = bits[0]
+            rank = None if bits[1] == "*" else int(bits[1])
+            nth = int(bits[2])
+            gen: Optional[int] = 0
+            if len(bits) == 4:
+                gen = None if bits[3] == "*" else int(bits[3])
+            entries.append((op, rank, nth, gen))
+        return cls(entries)
+
+    @classmethod
+    def from_env(cls) -> Optional["ChaosSchedule"]:
+        spec = os.environ.get(KILL_SCHEDULE_ENV)
+        return cls.from_spec(spec) if spec else None
+
+    def should_die(self, op: str, rank: Optional[int]) -> bool:
+        if not self.entries:
+            return False
+        count = self._counts.get(op, 0) + 1
+        self._counts[op] = count
+        try:
+            generation = int(os.environ.get(GENERATION_ENV, "0"))
+        except ValueError:
+            generation = 0
+        for e_op, e_rank, e_nth, e_gen in self.entries:
+            if e_op != op:
+                continue
+            if e_rank is not None and e_rank != rank:
+                continue
+            if e_gen is not None and e_gen != generation:
+                continue
+            if count == e_nth:
+                return True
+        return False
+
+
+_schedule: Optional[ChaosSchedule] = None
+_schedule_spec: Optional[str] = None
+
+
+def maybe_die(op: str, rank: Optional[int] = None) -> None:
+    """Worker-side kill site: consult the env schedule and SIGKILL the
+    current process on a match (a hard crash — no atexit, no cleanup —
+    exactly what a preempted TPU host looks like to the gang)."""
+    global _schedule, _schedule_spec
+    spec = os.environ.get(KILL_SCHEDULE_ENV)
+    if not spec:
+        return
+    if _schedule is None or spec != _schedule_spec:
+        _schedule = ChaosSchedule.from_spec(spec)
+        _schedule_spec = spec
+    if _schedule.should_die(op, rank):
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _kill_actor_process(actor, head=None) -> bool:
+    """Kill the worker PROCESS hosting `actor` (crash injection, not the
+    graceful ray_tpu.kill path).  Returns True if a process was killed."""
+    import ray_tpu
+
+    head = head or ray_tpu._global_head()
+    if head is None:
+        return False
+    with head._lock:
+        info = head.gcs.get_actor_info(actor._actor_id)
+        wid = info.worker_id if info is not None else None
+        handle = None
+        if wid is not None:
+            _, handle = head._find_worker(wid)
+    if handle is None or handle.proc is None:
+        return False
+    try:
+        handle.proc.kill()
+        return True
+    except Exception:
+        return False
+
+
+def kill_mesh_rank(group, rank: Optional[int] = None,
+                   rng: Optional[random.Random] = None,
+                   head=None) -> Optional[int]:
+    """Kill one rank of a MeshGroup / Train WorkerGroup by murdering its
+    hosting worker process.  `rank=None` picks one with the seeded `rng`
+    (deterministic chaos).  Returns the killed rank, or None if nothing
+    could be killed."""
+    workers = getattr(group, "workers", group)
+    if not workers:
+        return None
+    if rank is None:
+        rng = rng or random.Random()
+        rank = rng.randrange(len(workers))
+    return rank if _kill_actor_process(workers[rank], head=head) else None
 
 
 def kill_random_worker(head=None, rng: Optional[random.Random] = None) -> bool:

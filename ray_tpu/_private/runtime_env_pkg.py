@@ -202,8 +202,15 @@ def ensure_local(uri: str, transport) -> str:
         raise FileNotFoundError(
             f"py_modules package {uri} not found in the cluster KV (was "
             "the uploading driver's head wiped without persistence?)")
-    tmp = target + f".tmp.{os.getpid()}"
-    os.makedirs(tmp, exist_ok=True)
+    # Per-call scratch dir: two threads of one worker share a pid, so a
+    # pid-suffixed path could be extracted into by one thread while the
+    # other renames (or rmtree's) it — mkdtemp gives each materialization
+    # its own publish candidate, and the atomic rename stays the only
+    # cross-writer coordination point.
+    import tempfile
+
+    os.makedirs(_cache_root(), exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=_cache_root(), prefix=digest + ".tmp.")
     with zipfile.ZipFile(io.BytesIO(blob)) as zf:
         zf.extractall(tmp)
     try:

@@ -76,6 +76,39 @@ class CrossMeshTransferError(RayTpuError):
     """Device-array transfer between meshes failed (ray_tpu.parallel)."""
 
 
+class MeshGroupError(RayTpuError):
+    """The SPMD gang is poisoned: one or more mesh ranks died (or timed
+    out) while a collective fan-out was in flight.  Because every rank of
+    a ``MeshGroup`` participates in one ``jax.distributed`` world, a single
+    dead rank invalidates the *whole group* — surviving ranks may be
+    blocked forever inside a collective — so the supervisor raises this
+    eagerly instead of letting ``get()`` hang on the poisoned peers.
+
+    ``failed_ranks`` maps rank -> the underlying per-rank exception (an
+    ``ActorDiedError``/``WorkerCrashedError``/``TaskError``...).
+    ``restarts`` records how many gang restarts had been consumed when the
+    error was raised (useful when the restart budget is exhausted)."""
+
+    def __init__(self, msg: str = "mesh group failed",
+                 failed_ranks: Optional[dict] = None, restarts: int = 0):
+        self.failed_ranks = dict(failed_ranks or {})
+        self.restarts = restarts
+        self._base_msg = msg
+        if self.failed_ranks:
+            detail = ", ".join(
+                f"rank {r}: {type(e).__name__}" if isinstance(e, BaseException)
+                else f"rank {r}: {e}"
+                for r, e in sorted(self.failed_ranks.items()))
+            msg = f"{msg} (failed ranks: {detail})"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        # Per-rank causes may not be picklable; ship their string forms.
+        flat = {r: (str(e) if isinstance(e, BaseException) else e)
+                for r, e in self.failed_ranks.items()}
+        return (MeshGroupError, (self._base_msg, flat, self.restarts))
+
+
 # Aliases matching the reference's names so ported user code reads naturally.
 RayError = RayTpuError
 RayTaskError = TaskError

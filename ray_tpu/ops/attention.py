@@ -172,8 +172,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, causal,
     if causal:
         # Interior blocks (strictly below the diagonal band) skip the mask
         # entirely — the iota/select pair is pure VPU overhead there; only
-        # the diagonal-crossing tail blocks mask.
-        num_full = q_off // block_k
+        # the diagonal-crossing tail blocks mask.  Clamp to num_k_blocks:
+        # with lq > lk the tail query rows sit entirely past the last K
+        # block and an unclamped bound would read past K/V.
+        num_full = jnp.minimum(q_off // block_k, num_k_blocks)
         last = (q_off + block_q + block_k - 1) // block_k
         num_iter = jnp.minimum(last, num_k_blocks)
         m, l, o = jax.lax.fori_loop(0, num_full, make_body(False), (m, l, o))
@@ -227,7 +229,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     if causal:
-        num_full = q_off // block_k
+        # Same lq > lk clamp as the forward (see _flash_fwd_kernel).
+        num_full = jnp.minimum(q_off // block_k, num_k_blocks)
         last = (q_off + block_q + block_k - 1) // block_k
         num_iter = jnp.minimum(last, num_k_blocks)
         dq = jax.lax.fori_loop(0, num_full, make_body(False), dq)

@@ -32,6 +32,7 @@ from ray_tpu.parallel.ring_attention import (  # noqa: F401
 from ray_tpu.parallel.mesh_group import (  # noqa: F401
     MeshGroup,
     bootstrap_jax_distributed,
+    gang_get,
     rendezvous,
 )
 

@@ -16,6 +16,46 @@ named-actor rendezvous (python/ray/util/collective/util.py:9,
 collective_group/nccl_collective_group.py:28-100).  Both Train's JaxBackend
 and RLlib's learner group bootstrap through the same helpers here.
 
+Fault tolerance
+===============
+SPMD gangs fail as a unit: every rank participates in one
+``jax.distributed`` world, so a single dead host leaves the survivors
+blocked inside a collective that can never complete.  The supervisor layer
+here (the Podracer gang-failure model; reference analogue: Train's
+BackendExecutor failure handling + RLlib's fault-tolerant actor manager):
+
+- **Eager rank-death detection** — ``run()`` resolves its per-rank futures
+  through :func:`gang_get`, which polls with ``ray_tpu.wait`` instead of a
+  blocking ``get``: the moment any rank's future resolves to an
+  actor/worker-death error, the peers are abandoned (they are poisoned
+  anyway) and a typed :class:`ray_tpu.exceptions.MeshGroupError` carrying
+  ``failed_ranks`` is raised — no indefinite hang on a dead collective.
+- **Health probing** — ``health_check(deadline)`` pings every rank with a
+  deadline (``MeshWorker.ping`` runs on the actor's second concurrency
+  slot, so it answers even while a training step is in flight) and raises
+  ``MeshGroupError`` naming the unresponsive ranks.
+- **Gang restart** — one dead rank invalidates the whole world, so
+  recovery is all-or-nothing: ``_restart()`` tears down every worker and
+  the placement group, re-spawns fresh processes (a stale jax backend
+  cannot re-rendezvous), and re-runs the rendezvous.  ``run()`` drives
+  this automatically under a ``max_group_restarts`` budget with
+  exponential backoff; restart counts are exported through
+  ``ray_tpu.util.metrics`` (``mesh_group_restarts_total``,
+  ``mesh_group_restart_failures_total``).
+- **Recovery hooks** — ``run(fn, on_restart=...)`` calls
+  ``on_restart(group)`` after each successful gang rebuild, before ``fn``
+  is retried, so stateful users (e.g. RLlib's DistributedLearnerGroup)
+  re-materialize host-pinned state and re-broadcast weights.
+- **Deterministic chaos** — ``ray_tpu._private.chaos`` provides
+  ``kill_mesh_rank`` (driver-side, seeded) and a schedule-driven in-worker
+  killer (env ``RAY_TPU_TESTING_KILL_SCHEDULE`` =
+  ``"<op>:<rank>:<nth>[:<generation>]"``; the ``mesh_run`` op fires at
+  ``MeshWorker.run`` entry).  Each gang incarnation exports its
+  generation via ``RTPU_MESH_GENERATION`` so a schedule can kill exactly
+  one incarnation and let the restarted gang survive — the whole
+  kill/detect/restart/resume loop is testable on CPU with virtual
+  devices (tests/test_mesh_fault_tolerance.py).
+
 Test strategy: on CPU, a group of N single-process actors each exposing K
 virtual devices (``--xla_force_host_platform_device_count``) forms an
 N*K-device global mesh with gloo cross-process collectives — the JAX
@@ -24,9 +64,16 @@ tests/test_mesh_group.py.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import ray_tpu
+from ray_tpu import exceptions as exc
+
+# Errors that poison the gang (vs. a user exception raised by fn, which is
+# re-raised as-is: the worker is alive and a restart would not help).
+_GANG_ERRORS = (exc.ActorDiedError, exc.ActorUnavailableError,
+                exc.WorkerCrashedError, exc.ObjectLostError)
 
 
 def _free_port() -> int:
@@ -120,10 +167,16 @@ class MeshWorker:
     """One host process of a mesh group.  Carries a state dict so stateful
     users (learners, inference replicas) can pin objects host-side."""
 
-    def __init__(self, rank: int, world_size: int):
+    def __init__(self, rank: int, world_size: int, generation: int = 0):
+        import os
+
+        from ray_tpu._private import chaos
+
         self.rank = rank
         self.world_size = world_size
+        self.generation = generation
         self.state: Dict[str, Any] = {}
+        os.environ[chaos.GENERATION_ENV] = str(generation)
 
     def node_info(self) -> dict:
         import os
@@ -131,6 +184,11 @@ class MeshWorker:
 
         return {"rank": self.rank, "pid": os.getpid(),
                 "host": socket.gethostname()}
+
+    def ping(self) -> int:
+        """Cheap liveness probe; runs on the actor's spare concurrency
+        slot, so it answers even mid-run()."""
+        return self.rank
 
     def setup_env(self, env: Dict[str, str]):
         import os
@@ -145,11 +203,76 @@ class MeshWorker:
             local_device_count)
 
     def run(self, fn: Callable, *args, **kwargs):
+        from ray_tpu._private import chaos
+
+        chaos.maybe_die("mesh_run", self.rank)
         return fn(*args, **kwargs)
 
     def run_stateful(self, fn: Callable, *args, **kwargs):
         """fn(state_dict, *args) — for building/using host-pinned state."""
+        from ray_tpu._private import chaos
+
+        chaos.maybe_die("mesh_run", self.rank)
         return fn(self.state, *args, **kwargs)
+
+
+def gang_get(futures: Sequence, timeout: Optional[float] = None,
+             poll_interval: float = 0.25) -> List[Any]:
+    """Resolve a gang's per-rank futures with eager failure detection.
+
+    A plain ``ray_tpu.get(list)`` resolves rank 0 first: if rank 0 is a
+    survivor stuck in a collective poisoned by a dead peer, the driver
+    blocks forever.  This polls ALL futures via ``wait``; as soon as any
+    rank resolves to a gang-poisoning error (actor/worker death), a
+    ``MeshGroupError(failed_ranks=...)`` is raised immediately and the
+    remaining futures are abandoned.  A user exception (``TaskError``) is
+    re-raised as-is — the gang is healthy, restart would not help.
+    ``timeout`` bounds the whole fan-out; unresolved ranks at the deadline
+    are reported in ``failed_ranks`` as ``GetTimeoutError``."""
+    remaining: List[tuple] = list(enumerate(futures))  # (rank, ref)
+    results: Dict[int, Any] = {}
+    failed: Dict[int, BaseException] = {}
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while remaining:
+        refs = [r for _, r in remaining]
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                timeout=poll_interval)
+        ready_ids = {id(r) for r in ready}
+        still: List[tuple] = []
+        for rank, ref in remaining:
+            if id(ref) not in ready_ids:
+                still.append((rank, ref))
+                continue
+            try:
+                results[rank] = ray_tpu.get(ref)
+            except _GANG_ERRORS as e:
+                failed[rank] = e
+            except exc.RayTpuError:
+                raise  # user exception / task error: gang is not poisoned
+        remaining = still
+        if failed:
+            _abandon(remaining)
+            raise exc.MeshGroupError("mesh rank(s) died mid-run",
+                                     failed_ranks=failed)
+        if deadline is not None and remaining and time.monotonic() > deadline:
+            late = {rank: exc.GetTimeoutError(
+                f"rank {rank} produced no result within {timeout}s")
+                for rank, _ in remaining}
+            _abandon(remaining)
+            raise exc.MeshGroupError("mesh rank(s) missed the deadline",
+                                     failed_ranks=late)
+    return [results[rank] for rank in range(len(futures))]
+
+
+def _abandon(remaining) -> None:
+    """Best-effort cancel of the poisoned peers' futures: queued-but-not-
+    started calls are dropped; in-flight collective work is unrecoverable
+    anyway and dies with the gang teardown."""
+    for _, ref in remaining:
+        try:
+            ray_tpu.cancel(ref)
+        except Exception:
+            pass
 
 
 def rendezvous(workers: Sequence, platform: Optional[str] = None,
@@ -183,7 +306,20 @@ def rendezvous(workers: Sequence, platform: Optional[str] = None,
             calls.append(w.execute.remote(
                 bootstrap_jax_distributed, coordinator, world, rank,
                 platform, local_device_count))
-    return ray_tpu.get(calls, timeout=timeout)
+    # The rendezvous itself is a collective: a rank dying inside
+    # jax.distributed.initialize would otherwise hang the peers (and the
+    # driver) forever.
+    return gang_get(calls, timeout=timeout)
+
+
+def _restart_metrics():
+    """Lazy metric handles (internal_kv needs a connected driver)."""
+    from ray_tpu.util.metrics import Counter
+
+    return (Counter("mesh_group_restarts_total",
+                    "successful MeshGroup gang restarts"),
+            Counter("mesh_group_restart_failures_total",
+                    "failed MeshGroup gang-restart attempts"))
 
 
 class MeshGroup:
@@ -192,6 +328,13 @@ class MeshGroup:
     ``MeshGroup(2, platform="cpu", local_device_count=2)`` on one machine
     builds a 4-device virtual mesh across 2 processes; on real hardware,
     ``MeshGroup(num_hosts, resources_per_host={"TPU": 4})`` gangs the pod.
+
+    With ``max_group_restarts > 0`` the group self-heals: a rank death
+    detected during ``run()`` tears the whole gang down (SPMD worlds die as
+    a unit), re-spawns fresh worker processes, re-runs the rendezvous and
+    retries the function — see the module docstring's *Fault tolerance*
+    section.  ``restart_count`` and the ``mesh_group_restarts_total``
+    metric record consumed budget.
     """
 
     def __init__(self, num_hosts: int,
@@ -199,12 +342,27 @@ class MeshGroup:
                  platform: Optional[str] = None,
                  local_device_count: Optional[int] = None,
                  strategy: str = "PACK",
-                 bootstrap_timeout: float = 120.0):
+                 bootstrap_timeout: float = 120.0,
+                 max_group_restarts: int = 0,
+                 restart_backoff_s: float = 0.5,
+                 restart_backoff_max_s: float = 30.0):
         self.num_hosts = num_hosts
         self.platform = platform
         self.local_device_count = local_device_count
-        res = dict(resources_per_host or {"CPU": 1.0})
+        self.strategy = strategy
+        self.bootstrap_timeout = bootstrap_timeout
+        self.max_group_restarts = max_group_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_max_s = restart_backoff_max_s
+        self.restart_count = 0
+        self._resources = dict(resources_per_host or {"CPU": 1.0})
         self.pg = None
+        self.workers: List[Any] = []
+        self._spawn(generation=0)
+
+    # ---- gang lifecycle ----
+    def _actor_opts(self) -> Dict[str, Any]:
+        res = self._resources
         opts: Dict[str, Any] = {"max_concurrency": 2}
         if res.get("CPU"):
             opts["num_cpus"] = res["CPU"]
@@ -213,45 +371,31 @@ class MeshGroup:
         extra = {k: v for k, v in res.items() if k not in ("CPU", "TPU")}
         if extra:
             opts["resources"] = extra
-        if num_hosts > 1:
+        return opts
+
+    def _spawn(self, generation: int):
+        """Reserve the placement group, spawn one fresh worker per host and
+        run the jax.distributed rendezvous."""
+        opts = self._actor_opts()
+        if self.num_hosts > 1:
             from ray_tpu.util import PlacementGroupSchedulingStrategy
             from ray_tpu.util.placement_group import placement_group
 
-            self.pg = placement_group([dict(res) for _ in range(num_hosts)],
-                                      strategy=strategy)
-            self.pg.ready(timeout=bootstrap_timeout)
+            self.pg = placement_group(
+                [dict(self._resources) for _ in range(self.num_hosts)],
+                strategy=self.strategy)
+            self.pg.ready(timeout=self.bootstrap_timeout)
             opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                 self.pg)
-        self.workers = [MeshWorker.options(**opts).remote(rank, num_hosts)
-                        for rank in range(num_hosts)]
-        self.device_info = rendezvous(self.workers, platform,
-                                      local_device_count,
-                                      timeout=bootstrap_timeout)
+        self.workers = [
+            MeshWorker.options(**opts).remote(rank, self.num_hosts, generation)
+            for rank in range(self.num_hosts)
+        ]
+        self.device_info = rendezvous(self.workers, self.platform,
+                                      self.local_device_count,
+                                      timeout=self.bootstrap_timeout)
 
-    @property
-    def global_device_count(self) -> int:
-        return self.device_info[0]["global_devices"]
-
-    def run(self, fn: Callable, *args, **kwargs) -> List[Any]:
-        """Fan fn out to every host process; returns per-rank results."""
-        return ray_tpu.get([w.run.remote(fn, *args, **kwargs)
-                            for w in self.workers])
-
-    def run_async(self, fn: Callable, *args, **kwargs):
-        return [w.run.remote(fn, *args, **kwargs) for w in self.workers]
-
-    def run_stateful(self, fn: Callable, *args, **kwargs) -> List[Any]:
-        return ray_tpu.get([w.run_stateful.remote(fn, *args, **kwargs)
-                            for w in self.workers])
-
-    def run_rank(self, rank: int, fn: Callable, *args, **kwargs):
-        return ray_tpu.get(self.workers[rank].run.remote(fn, *args, **kwargs))
-
-    def run_rank_stateful(self, rank: int, fn: Callable, *args, **kwargs):
-        return ray_tpu.get(
-            self.workers[rank].run_stateful.remote(fn, *args, **kwargs))
-
-    def shutdown(self):
+    def _teardown_workers(self):
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
@@ -266,3 +410,101 @@ class MeshGroup:
             except Exception:
                 pass
             self.pg = None
+
+    def _restart(self, cause: exc.MeshGroupError) -> None:
+        """One gang restart attempt: teardown + backoff + respawn.
+
+        Raises ``MeshGroupError`` (the original cause, annotated with the
+        consumed restart count) when the budget is exhausted; re-raises a
+        respawn failure wrapped the same way."""
+        restarts_total, restart_failures = None, None
+        try:
+            restarts_total, restart_failures = _restart_metrics()
+        except Exception:
+            pass  # metrics are best-effort (e.g. driver disconnecting)
+        if self.restart_count >= self.max_group_restarts:
+            cause.restarts = self.restart_count
+            raise cause
+        self.restart_count += 1
+        backoff = min(
+            self.restart_backoff_s * (2 ** (self.restart_count - 1)),
+            self.restart_backoff_max_s)
+        self._teardown_workers()
+        time.sleep(backoff)
+        try:
+            self._spawn(generation=self.restart_count)
+        except Exception as e:
+            if restart_failures is not None:
+                try:
+                    restart_failures.inc()
+                except Exception:
+                    pass
+            raise exc.MeshGroupError(
+                f"gang restart {self.restart_count}/"
+                f"{self.max_group_restarts} failed to respawn: {e}",
+                failed_ranks=cause.failed_ranks,
+                restarts=self.restart_count) from e
+        if restarts_total is not None:
+            try:
+                restarts_total.inc()
+            except Exception:
+                pass
+
+    # ---- health ----
+    def health_check(self, deadline: float = 10.0) -> List[int]:
+        """Ping every rank with a deadline.  Returns the rank list on
+        success; raises ``MeshGroupError`` naming dead/unresponsive ranks.
+        Safe to call while a ``run()`` is in flight (pings ride the spare
+        concurrency slot)."""
+        futures = [w.ping.remote() for w in self.workers]
+        return gang_get(futures, timeout=deadline)
+
+    @property
+    def global_device_count(self) -> int:
+        return self.device_info[0]["global_devices"]
+
+    # ---- execution ----
+    def run(self, fn: Callable, *args, on_restart: Optional[Callable] = None,
+            timeout: Optional[float] = None, **kwargs) -> List[Any]:
+        """Fan fn out to every host process; returns per-rank results.
+
+        Supervised: a rank death raises ``MeshGroupError`` eagerly; with
+        ``max_group_restarts > 0`` the gang is rebuilt (fresh processes +
+        rendezvous), ``on_restart(group)`` — if given — re-materializes
+        host-pinned state, and fn is retried.  ``timeout`` is a per-attempt
+        deadline for the whole fan-out."""
+        return self._supervised(
+            lambda: gang_get([w.run.remote(fn, *args, **kwargs)
+                              for w in self.workers], timeout=timeout),
+            on_restart)
+
+    def run_async(self, fn: Callable, *args, **kwargs):
+        return [w.run.remote(fn, *args, **kwargs) for w in self.workers]
+
+    def run_stateful(self, fn: Callable, *args,
+                     on_restart: Optional[Callable] = None,
+                     timeout: Optional[float] = None, **kwargs) -> List[Any]:
+        return self._supervised(
+            lambda: gang_get([w.run_stateful.remote(fn, *args, **kwargs)
+                              for w in self.workers], timeout=timeout),
+            on_restart)
+
+    def _supervised(self, attempt: Callable[[], List[Any]],
+                    on_restart: Optional[Callable]) -> List[Any]:
+        while True:
+            try:
+                return attempt()
+            except exc.MeshGroupError as e:
+                self._restart(e)  # raises when the budget is exhausted
+                if on_restart is not None:
+                    on_restart(self)
+
+    def run_rank(self, rank: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(self.workers[rank].run.remote(fn, *args, **kwargs))
+
+    def run_rank_stateful(self, rank: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(
+            self.workers[rank].run_stateful.remote(fn, *args, **kwargs))
+
+    def shutdown(self):
+        self._teardown_workers()

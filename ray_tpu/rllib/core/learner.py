@@ -134,17 +134,45 @@ class DistributedLearnerGroup:
 
     `learner_factory` must be a picklable zero-arg callable returning a
     JaxLearner; it runs once inside each host process after rendezvous.
+
+    Fault tolerance: with ``max_group_restarts > 0`` a rank death during
+    ``update()`` triggers a gang rebuild (see MeshGroup's fault-tolerance
+    docs); the ``on_restart`` hook re-materializes the learner in every
+    fresh host process and re-broadcasts the last driver-cached weights
+    (``checkpoint_weights()`` refreshes the cache), so training resumes
+    instead of silently restarting from a re-initialized policy.
     """
 
     def __init__(self, learner_factory, num_hosts: int = 1,
                  resources_per_host=None, platform=None,
-                 local_device_count=None):
+                 local_device_count=None, max_group_restarts: int = 0):
         from ray_tpu.parallel.mesh_group import MeshGroup
 
+        self._factory = learner_factory
+        self._last_weights = None
         self.group = MeshGroup(num_hosts, resources_per_host,
                                platform=platform,
-                               local_device_count=local_device_count)
+                               local_device_count=local_device_count,
+                               max_group_restarts=max_group_restarts)
         self.group.run_stateful(_build_learner, learner_factory)
+
+    def _on_restart(self, group):
+        """After a gang rebuild the new host processes hold empty state:
+        re-build the learner on every rank, then re-broadcast the last
+        known weights so the update that triggered the restart retries
+        against the pre-failure policy."""
+        group.run_stateful(_build_learner, self._factory)
+        if self._last_weights is not None:
+            group.run_stateful(_learner_set_weights, self._last_weights)
+
+    def checkpoint_weights(self):
+        """Pull rank-0 weights into the driver-side cache used to restore
+        a rebuilt gang.  Call at whatever cadence bounds acceptable
+        rollback (every N updates, alongside algorithm checkpoints, ...).
+        Returns the fetched weights."""
+        self._last_weights = self.group.run_rank_stateful(
+            0, _learner_get_weights)
+        return self._last_weights
 
     def update(self, batch) -> Dict[str, float]:
         """Every host receives the batch and extracts its addressable
@@ -155,14 +183,17 @@ class DistributedLearnerGroup:
         # One serialization + one store object shared by all hosts (a ref
         # arg resolves zero-copy per host) instead of num_hosts copies.
         batch_ref = ray_tpu.put(batch)
-        results = self.group.run_stateful(_learner_update, batch_ref)
+        results = self.group.run_stateful(_learner_update, batch_ref,
+                                          on_restart=self._on_restart)
         return results[0]
 
     def get_weights(self):
         return self.group.run_rank_stateful(0, _learner_get_weights)
 
     def set_weights(self, weights):
-        self.group.run_stateful(_learner_set_weights, weights)
+        self._last_weights = weights
+        self.group.run_stateful(_learner_set_weights, weights,
+                                on_restart=self._on_restart)
 
     def shutdown(self):
         self.group.shutdown()

@@ -297,13 +297,16 @@ def shutdown():
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
-    for p in _node_proxies + _demoted_proxies:
+    with _proxy_lock:
+        doomed = _node_proxies + _demoted_proxies
+        _node_proxies.clear()
+        _demoted_proxies.clear()
+        _proxy_strikes.clear()
+    for p in doomed:
         try:
             ray_tpu.kill(p)
         except Exception:
             pass
-    _node_proxies.clear()
-    _demoted_proxies.clear()
     from ray_tpu.serve.controller import reset_controller
 
     reset_controller()
@@ -472,12 +475,30 @@ class HTTPProxyActor:
 
 _node_proxies: List[Any] = []
 _demoted_proxies: List[Any] = []
-_proxy_strikes: Dict[int, int] = {}
+_proxy_strikes: Dict[str, int] = {}
+# One lock for the three structures above: the controller loop, a
+# concurrent broadcast_routes() (deploy from another thread) and shutdown()
+# all mutate them; unsynchronized list surgery loses strikes or double-
+# demotes.  Strikes are keyed by the proxy's stable actor id — handle
+# objects for the same actor may differ (deserialized copies), and id() of
+# a dead handle can be recycled by the allocator.
+_proxy_lock = threading.Lock()
 _PROXY_MAX_STRIKES = 3
 
 
+def _proxy_key(p) -> str:
+    aid = getattr(p, "_actor_id", None)
+    if aid is not None:
+        try:
+            return aid.hex()
+        except AttributeError:
+            return str(aid)
+    return f"id:{id(p)}"
+
+
 def _proxy_ok(p):
-    _proxy_strikes.pop(id(p), None)
+    with _proxy_lock:
+        _proxy_strikes.pop(_proxy_key(p), None)
 
 
 def _proxy_failed(p):
@@ -487,16 +508,18 @@ def _proxy_failed(p):
     best-effort route broadcasts (a successful broadcast ack promotes it
     back); killing it would turn three slow polls into a permanent
     ingress outage for that node."""
-    n = _proxy_strikes.get(id(p), 0) + 1
-    _proxy_strikes[id(p)] = n
-    if n >= _PROXY_MAX_STRIKES:
-        try:
-            _node_proxies.remove(p)
-        except ValueError:
-            pass
-        if p not in _demoted_proxies:
-            _demoted_proxies.append(p)
-        _proxy_strikes.pop(id(p), None)
+    key = _proxy_key(p)
+    with _proxy_lock:
+        n = _proxy_strikes.get(key, 0) + 1
+        _proxy_strikes[key] = n
+        if n >= _PROXY_MAX_STRIKES:
+            try:
+                _node_proxies.remove(p)
+            except ValueError:
+                pass
+            if p not in _demoted_proxies:
+                _demoted_proxies.append(p)
+            _proxy_strikes.pop(key, None)
 
 
 def start_http_proxy(port: int = 0) -> int:
@@ -523,7 +546,8 @@ def start_http_proxies(port: int = 0) -> Dict[str, int]:
             scheduling_strategy=NodeAffinitySchedulingStrategy(node_hex),
             max_concurrency=16).remote(port)
         out[node_hex] = ray_tpu.get(actor.ready.remote())
-        _node_proxies.append(actor)
+        with _proxy_lock:
+            _node_proxies.append(actor)
     broadcast_routes()
     return out
 
@@ -540,7 +564,9 @@ def collect_proxy_stats() -> Dict[str, float]:
     watched deployment): {deployment: summed in-flight across proxies}.
     A proxy failing the poll takes exactly one strike per tick."""
     totals: Dict[str, float] = {}
-    for p in list(_node_proxies):
+    with _proxy_lock:
+        healthy = list(_node_proxies)
+    for p in healthy:
         try:
             pstats = ray_tpu.get(p.queue_stats.remote(), timeout=5)
             _proxy_ok(p)
@@ -576,16 +602,19 @@ def broadcast_routes() -> None:
     deploy/delete and by the controller after autoscale events).  Waits
     for the acks: serve.run() returning must mean every ingress routes
     the new deployment."""
-    if not _node_proxies:
+    with _proxy_lock:
+        healthy_snap = list(_node_proxies)
+        demoted_snap = list(_demoted_proxies)
+    if not healthy_snap:
         return
     routes = _current_routes()
     acks = []
-    for p in list(_node_proxies):
+    for p in healthy_snap:
         try:
             acks.append((p, False, p.update_routes.remote(routes)))
         except Exception:
             _proxy_failed(p)
-    for p in list(_demoted_proxies):
+    for p in demoted_snap:
         try:
             acks.append((p, True, p.update_routes.remote(routes)))
         except Exception:
@@ -595,11 +624,13 @@ def broadcast_routes() -> None:
             ray_tpu.get(a, timeout=10)
             if demoted:
                 # The proxy answered again: back into the healthy pool.
-                try:
-                    _demoted_proxies.remove(p)
-                except ValueError:
-                    pass
-                _node_proxies.append(p)
+                with _proxy_lock:
+                    try:
+                        _demoted_proxies.remove(p)
+                    except ValueError:
+                        pass
+                    if p not in _node_proxies:
+                        _node_proxies.append(p)
             _proxy_ok(p)
         except Exception:
             if not demoted:

@@ -1,14 +1,24 @@
 """BackendExecutor: placement group + worker group + rendezvous + training
 loop results (reference: python/ray/train/_internal/backend_executor.py:43 —
 PG creation :138, rank assignment :245, start_training :315; restart :571).
+
+Gang fault tolerance: every fan-out to the worker gang resolves through
+``mesh_group.gang_get`` (eager rank-death detection — see the fault
+tolerance section of ray_tpu/parallel/mesh_group.py), and any
+gang-poisoning failure (``MeshGroupError``, actor/worker death, deadline)
+is converted into ``TrainingWorkerError`` so ``BaseTrainer.fit`` can tear
+the executor down and elastically restart from the latest checkpoint.
 """
 from __future__ import annotations
 
+import traceback
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu import exceptions as exc
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import ScalingConfig
+from ray_tpu.parallel.mesh_group import gang_get
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.util.placement_group import (
@@ -24,14 +34,35 @@ class TrainingWorkerError(Exception):
         super().__init__(f"training worker failed:\n{tb}")
 
 
+# Failures that mean the gang (not the user code) is broken and a fresh
+# worker group + rendezvous can recover.
+_GANG_FAILURES = (exc.MeshGroupError, exc.ActorDiedError,
+                  exc.ActorUnavailableError, exc.WorkerCrashedError,
+                  exc.ObjectLostError)
+
+
 class BackendExecutor:
     def __init__(self, backend_config: BackendConfig,
-                 scaling_config: ScalingConfig):
+                 scaling_config: ScalingConfig, generation: int = 0):
         self.backend_config = backend_config
         self.backend: Backend = backend_config.backend_cls()()
         self.scaling = scaling_config
         self.worker_group: Optional[WorkerGroup] = None
         self.pg = None
+        # Elastic-restart incarnation index (0 on the first attempt);
+        # exported to workers so chaos schedules can target one gang.
+        self.generation = generation
+
+    def _gang_failure(self, e: BaseException) -> TrainingWorkerError:
+        """Wrap a gang-poisoning failure so the trainer's elastic-restart
+        loop (which catches TrainingWorkerError) handles dead ranks the
+        same way it handles in-band worker errors."""
+        try:
+            self.backend.on_training_failure(self.worker_group,
+                                             self.backend_config, e)
+        except Exception:
+            pass
+        return TrainingWorkerError(e, traceback.format_exc())
 
     def start(self):
         res = self.scaling.worker_resources()
@@ -40,29 +71,46 @@ class BackendExecutor:
             self.pg = _create_pg(
                 bundles, strategy=self.scaling.placement_strategy)
             self.pg.ready(timeout=60)
-        self.worker_group = WorkerGroup(self.scaling.num_workers, res, self.pg)
+        self.worker_group = WorkerGroup(self.scaling.num_workers, res,
+                                        self.pg, generation=self.generation)
         # Gang rendezvous (jax.distributed coordinator on worker 0) is the
         # backend's job, shared with MeshGroup: see
-        # ray_tpu/parallel/mesh_group.py:rendezvous.
-        self.backend.on_start(self.worker_group, self.backend_config)
+        # ray_tpu/parallel/mesh_group.py:rendezvous.  A rank dying inside
+        # the rendezvous is a recoverable gang failure, not a user error.
+        try:
+            self.backend.on_start(self.worker_group, self.backend_config)
+        except _GANG_FAILURES as e:
+            raise self._gang_failure(e) from e
 
     def start_training(self, train_fn: Callable, config: dict,
                        checkpoint: Optional[Checkpoint] = None,
                        dataset_shards: Optional[List[dict]] = None):
         self.backend.on_training_start(self.worker_group, self.backend_config)
-        ray_tpu.get([
-            w.start_training.remote(
-                train_fn, config, checkpoint,
-                dataset_shards[i] if dataset_shards else None)
-            for i, w in enumerate(self.worker_group.workers)
-        ])
+        try:
+            gang_get([
+                w.start_training.remote(
+                    train_fn, config, checkpoint,
+                    dataset_shards[i] if dataset_shards else None)
+                for i, w in enumerate(self.worker_group.workers)
+            ])
+        except _GANG_FAILURES as e:
+            raise self._gang_failure(e) from e
 
     def get_next_results(self, timeout: float = 600.0) -> Optional[List[tuple]]:
         """Blocks for one result per worker. Returns None when all done.
-        Raises TrainingWorkerError on any worker error (reference surfaces
-        the first failure the same way)."""
-        results = ray_tpu.get([w.next_result.remote(timeout)
-                               for w in self.worker_group.workers])
+        Raises TrainingWorkerError on any worker error — in-band ("error"
+        results) or out-of-band (a rank's process died: gang_get detects
+        it eagerly instead of blocking on the surviving, possibly
+        collective-stuck, peers)."""
+        try:
+            # Slack past the workers' own queue timeout: a healthy worker
+            # answers ("timeout", ...) in-band at `timeout`; the gang_get
+            # deadline only fires for ranks that can't answer at all.
+            results = gang_get([w.next_result.remote(timeout)
+                                for w in self.worker_group.workers],
+                               timeout=timeout + 30.0)
+        except _GANG_FAILURES as e:
+            raise self._gang_failure(e) from e
         kinds = {r[0] for r in results}
         if "error" in kinds:
             for r in results:
@@ -73,6 +121,13 @@ class BackendExecutor:
         if "timeout" in kinds:
             raise TimeoutError("training workers produced no result in time")
         return results
+
+    def ping_workers(self, deadline: float = 10.0) -> List[int]:
+        """Health-probe the gang (MeshGroup.health_check shape); raises
+        MeshGroupError naming dead/unresponsive ranks."""
+        return gang_get([w.ping.remote()
+                         for w in self.worker_group.workers],
+                        timeout=deadline)
 
     def shutdown(self):
         if self.worker_group is not None:
@@ -85,4 +140,3 @@ class BackendExecutor:
             except Exception:
                 pass
             self.pg = None
-

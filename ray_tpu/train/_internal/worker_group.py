@@ -21,12 +21,25 @@ from ray_tpu.air.checkpoint import Checkpoint
 class TrainWorker:
     """Actor hosting one training process (one TPU host's worth of chips)."""
 
-    def __init__(self, rank: int, world_size: int):
+    def __init__(self, rank: int, world_size: int, generation: int = 0):
+        import os
+
+        from ray_tpu._private import chaos
+
         self.rank = rank
         self.world_size = world_size
+        self.generation = generation
         self._results: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._env: Dict[str, str] = {}
+        # Gang generation: lets the chaos kill schedule target exactly one
+        # incarnation, so an elastically-restarted gang survives.
+        os.environ[chaos.GENERATION_ENV] = str(generation)
+
+    def ping(self) -> int:
+        """Liveness probe; answers on the spare concurrency slot even
+        while the training thread runs."""
+        return self.rank
 
     def setup_env(self, env: Dict[str, str]):
         import os
@@ -53,6 +66,12 @@ class TrainWorker:
         """Launch the user loop in a thread; results flow via next_result."""
 
         def report_fn(metrics, ckpt):
+            from ray_tpu._private import chaos
+
+            # Chaos kill site: a schedule entry "train_report:<rank>:<nth>"
+            # SIGKILLs this host at its nth report — the deterministic
+            # stand-in for a TPU host preemption mid-training.
+            chaos.maybe_die("train_report", self.rank)
             self._results.put(("report", metrics, ckpt))
 
         def run():
@@ -93,7 +112,7 @@ class TrainWorker:
 
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
-                 placement_group=None):
+                 placement_group=None, generation: int = 0):
         opts: Dict[str, Any] = {"max_concurrency": 2}
         cpu = resources_per_worker.get("CPU", 1.0)
         opts["num_cpus"] = cpu
@@ -109,10 +128,11 @@ class WorkerGroup:
             opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                 placement_group)
         self.workers = [
-            TrainWorker.options(**opts).remote(rank, num_workers)
+            TrainWorker.options(**opts).remote(rank, num_workers, generation)
             for rank in range(num_workers)
         ]
         self.num_workers = num_workers
+        self.generation = generation
 
     def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
         return ray_tpu.get([w.execute.remote(fn, *args, **kwargs)

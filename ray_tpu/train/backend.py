@@ -19,6 +19,16 @@ class Backend:
     def on_training_start(self, worker_group, backend_config: BackendConfig):
         pass
 
+    def on_training_failure(self, worker_group, backend_config: BackendConfig,
+                            error: BaseException):
+        """Called when the executor detects a gang-poisoning failure (a
+        rank's process died, or the group missed its deadline) BEFORE the
+        worker group is torn down for an elastic restart.  Backends log /
+        record state here; the group itself is unusable — surviving ranks
+        may be stuck in a dead collective (reference:
+        BackendExecutor._increment_failures + backend failure handling)."""
+        pass
+
     def on_shutdown(self, worker_group, backend_config: BackendConfig):
         pass
 

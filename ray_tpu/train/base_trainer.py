@@ -31,18 +31,38 @@ class BaseTrainer:
         self.resume_from_checkpoint = resume_from_checkpoint
 
     def fit(self) -> Result:
+        import time
+
         failure = self.run_config.failure_config or FailureConfig()
         attempts = max(1, failure.max_failures + 1) \
             if failure.max_failures >= 0 else 10**9
         last_error: Optional[BaseException] = None
         checkpoint = self.resume_from_checkpoint
-        for _ in range(attempts):
+        for attempt in range(attempts):
+            # Incarnation index: the executor exports it to the gang so
+            # chaos kill schedules can target exactly one generation, and
+            # operators can tell restarts apart in worker logs.
+            self._elastic_generation = attempt
+            if attempt:
+                # Exponential backoff between elastic restarts — a
+                # crash-looping gang (bad host, leaked coordinator port)
+                # must not hot-spin placement groups.
+                time.sleep(min(0.2 * 2 ** (attempt - 1), 10.0))
             try:
                 return self._run(checkpoint)
             except TrainingWorkerError as e:
                 last_error = e
-                # Elastic restart resumes from the latest checkpoint.
+                # Elastic restart resumes from the latest checkpoint: the
+                # next _run() builds a FRESH executor + worker gang (new
+                # processes re-run the jax.distributed rendezvous).
                 checkpoint = getattr(self, "_latest_checkpoint", checkpoint)
+                try:
+                    from ray_tpu.util.metrics import Counter
+
+                    Counter("train_elastic_restarts_total",
+                            "Train gang restarts after worker failure").inc()
+                except Exception:
+                    pass
         return Result(metrics={}, checkpoint=checkpoint, error=last_error)
 
     def _run(self, checkpoint: Optional[Checkpoint]) -> Result:
@@ -88,7 +108,9 @@ class DataParallelTrainer(BaseTrainer):
         return t
 
     def _run(self, checkpoint: Optional[Checkpoint]) -> Result:
-        executor = BackendExecutor(self.backend_config, self.scaling_config)
+        executor = BackendExecutor(
+            self.backend_config, self.scaling_config,
+            generation=getattr(self, "_elastic_generation", 0))
         ckpt_mgr = CheckpointManager(self.run_config.checkpoint_config)
         history = []
         final_metrics: Dict[str, Any] = {}

@@ -36,3 +36,23 @@ class _JaxBackend(Backend):
 
         rendezvous(worker_group.workers, backend_config.platform,
                    backend_config.local_device_count)
+
+    def on_training_failure(self, worker_group, backend_config: JaxConfig,
+                            error: BaseException):
+        # A dead rank invalidates the whole jax.distributed world: record
+        # it so operators can alert on gang churn.  The executor tears the
+        # group down right after this; fresh processes re-rendezvous on
+        # the next elastic attempt (a stale jax backend cannot rejoin).
+        import logging
+
+        from ray_tpu.util.metrics import Counter
+
+        logging.getLogger(__name__).warning(
+            "jax.distributed gang failed (%s); the worker group will be "
+            "rebuilt and training resumed from the latest checkpoint",
+            error)
+        try:
+            Counter("train_gang_failures_total",
+                    "jax.distributed gangs lost to rank death").inc()
+        except Exception:
+            pass

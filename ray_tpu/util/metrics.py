@@ -38,6 +38,12 @@ class _Metric:
     def _store(self, tags, value):
         internal_kv.kv_put(self._key(tags), pickle.dumps(value), namespace=_NS)
 
+    def value(self, tags: Optional[Dict[str, str]] = None):
+        """Read the current recorded value for a tag set (0.0 when never
+        recorded; a bucket-count list for Histogram).  Used by supervisors
+        and tests to assert on counters, e.g. mesh_group_restarts_total."""
+        return self._load(tags)
+
 
 class Counter(_Metric):
     kind = "counter"

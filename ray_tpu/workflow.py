@@ -74,15 +74,43 @@ def step(fn: Callable):
     return _Builder()
 
 
+def _canonical(obj):
+    """Reduce a value to a structure whose pickle bytes are stable across
+    processes: dict/set iteration order is normalized by sorting on the
+    pickled canonical keys, containers are rebuilt as tagged tuples, and
+    primitives pass through.  Raw ``pickle.dumps`` is NOT process-stable
+    (memo-dependent layouts, set/dict ordering), which made resumed
+    workflows silently re-execute completed steps under a fresh driver."""
+    if isinstance(obj, dict):
+        items = [(_canonical(k), _canonical(v)) for k, v in obj.items()]
+        items.sort(key=lambda kv: pickle.dumps(kv[0]))
+        return ("dict", tuple(items))
+    if isinstance(obj, (set, frozenset)):
+        members = sorted((_canonical(m) for m in obj), key=pickle.dumps)
+        return ("set", tuple(members))
+    if isinstance(obj, (list, tuple)):
+        return (type(obj).__name__, tuple(_canonical(v) for v in obj))
+    if isinstance(obj, (str, bytes, int, float, bool, type(None))):
+        return obj
+    # Arbitrary objects: hash their (sorted) attribute dict when they have
+    # one — the instance's pickle memo layout and id()-bearing reprs are
+    # both process-dependent.
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict):
+        return ("obj", type(obj).__name__, _canonical(d))
+    return ("repr", type(obj).__name__, repr(obj))
+
+
 def _step_key(workflow_id: str, node: StepNode, resolved_args) -> str:
     h = hashlib.sha256()
     h.update(node.name.encode())
     try:
-        h.update(pickle.dumps(resolved_args))
+        h.update(pickle.dumps(_canonical(resolved_args)))
     except Exception:
-        # Unpicklable args: repr-hash so same-name steps with different
-        # args still get distinct checkpoints (a bare-name fallback would
-        # collide recursive continuations onto one file).
+        # Uncanonicalizable args (unpicklable canonical members): repr-hash
+        # so same-name steps with different args still get distinct
+        # checkpoints (a bare-name fallback would collide recursive
+        # continuations onto one file).
         h.update(repr(resolved_args).encode())
     return f"{workflow_id}/{node.name}_{h.hexdigest()[:12]}"
 

@@ -65,6 +65,38 @@ def test_flash_mixed_block_sizes_stay_correct(blocks):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_flash_causal_lq_gt_lk_kernel_bounds():
+    """lq > lk causal: the fwd/dq interior-block loop bound must clamp to
+    num_k_blocks (matching the dkv kernel) — tail query blocks sit fully
+    past the last K block, and an unclamped bound reads past K/V.  The
+    kernels' mask convention is rows >= cols (top-left aligned), so the
+    reference here builds that mask directly instead of _xla_attention's
+    bottom-right alignment."""
+    from ray_tpu.ops.attention import NEG_INF, _flash
+
+    q, _, _ = _rand_qkv(1, 256, 2, 32, seed=1)
+    _, k, v = _rand_qkv(1, 128, 2, 32, seed=2)
+
+    def ref(q):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (32 ** -0.5)
+        rows = jnp.arange(256)[:, None]
+        cols = jnp.arange(128)[None, :]
+        s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def flash(q):
+        return _flash(q, k, v, True, None, 64, 64, True)
+
+    with jax.default_matmul_precision("float32"):
+        np.testing.assert_allclose(np.asarray(flash(q)), np.asarray(ref(q)),
+                                   atol=2e-5, rtol=1e-4)
+        gf = jax.grad(lambda q: jnp.sum(jnp.sin(flash(q))))(q)
+        gx = jax.grad(lambda q: jnp.sum(jnp.sin(ref(q))))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                               atol=2e-4, rtol=1e-3, err_msg="dq mismatch")
+
+
 def test_flash_unaligned_seq_rejected():
     q, k, v = _rand_qkv(1, 200, 1, 32)
     with pytest.raises(ValueError, match="multiples"):

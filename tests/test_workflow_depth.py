@@ -88,3 +88,41 @@ def test_virtual_actor_state_survives_reload(wf):
     assert c3.value() == 16
     with pytest.raises(KeyError):
         Counter.get_actor("nope")
+
+
+def test_step_key_canonical_across_arg_orderings():
+    """_step_key must not depend on dict insertion order, set iteration
+    order, or pickle memo layout — a resumed workflow under a fresh
+    driver must map identical steps to identical checkpoint keys
+    (raw pickle.dumps was process-dependent and caused silent
+    re-execution on resume)."""
+    from ray_tpu.workflow import StepNode, _step_key
+
+    node = StepNode(lambda x: x, (), {}, name="s")
+    a = {"x": 1, "y": 2, "z": {"q": frozenset({3, 1, 2})}}
+    b_inner = {"q": frozenset({2, 3, 1})}
+    b = {"z": b_inner, "y": 2, "x": 1}  # same mapping, different order
+    args_a = (([a, {1, 2, 3}],), {"k": a})
+    args_b = (([b, {3, 2, 1}],), {"k": b})
+    assert _step_key("wf", node, args_a) == _step_key("wf", node, args_b)
+    # ...while genuinely different args still get distinct keys.
+    assert _step_key("wf", node, args_a) != _step_key(
+        "wf", node, (([a, {1, 2}],), {"k": a}))
+
+
+def test_step_key_object_args_ignore_identity():
+    """Arbitrary objects hash by type + attribute dict, not by repr (which
+    embeds id()) or pickle memo layout."""
+    from ray_tpu.workflow import StepNode, _step_key
+
+    class Cfg:
+        def __init__(self, lr, keys):
+            self.lr = lr
+            self.keys = keys
+
+    node = StepNode(lambda x: x, (), {}, name="s")
+    k1 = _step_key("wf", node, ((Cfg(0.1, {"a", "b"}),), {}))
+    k2 = _step_key("wf", node, ((Cfg(0.1, {"b", "a"}),), {}))
+    k3 = _step_key("wf", node, ((Cfg(0.2, {"a", "b"}),), {}))
+    assert k1 == k2
+    assert k1 != k3
