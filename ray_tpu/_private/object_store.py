@@ -64,13 +64,38 @@ def attach(object_id: ObjectID) -> shared_memory.SharedMemory:
 
 
 class PlasmaObject:
-    __slots__ = ("shm", "metadata", "data_size", "sealed")
+    __slots__ = ("shm", "metadata", "data_size", "sealed", "_view")
 
     def __init__(self, shm: shared_memory.SharedMemory, data_size: int):
         self.shm = shm
         self.metadata: bytes = b""
         self.data_size = data_size
         self.sealed = False
+        # ONE canonical zero-copy view per object, handed to every writer
+        # (create) and reader (get).  Readers slice it for chunked sends —
+        # slices borrow the underlying mmap, not this view, so the store
+        # can release it deterministically at delete time and shm.close()
+        # stops failing with "cannot close exported pointers exist".
+        self._view: Optional[memoryview] = None
+
+    def view(self) -> memoryview:
+        if self._view is None:
+            self._view = (self.shm.buf[:self.data_size] if self.data_size
+                          else memoryview(b""))
+        return self._view
+
+    def release_view(self) -> None:
+        """Deterministic reclaim of the exported view (delete/shutdown
+        path).  Any reader still holding the canonical view sees a
+        released memoryview (ValueError on access) instead of silently
+        leaking the whole segment mapping."""
+        v, self._view = self._view, None
+        if v is not None:
+            try:
+                v.release()
+            except BufferError:
+                pass  # a C-level buffer export is live; close() will leak
+                # this one segment rather than crash the reader
 
 
 class SharedMemoryStore:
@@ -140,9 +165,10 @@ class SharedMemoryStore:
             shm = shared_memory.SharedMemory(
                 name=_segment_name(object_id), create=True, size=max(1, data_size)
             )
-            self._objects[object_id] = PlasmaObject(shm, data_size)
+            obj = PlasmaObject(shm, data_size)
+            self._objects[object_id] = obj
             self.used += data_size
-            return shm.buf[:data_size] if data_size else memoryview(b"")
+            return obj.view()
 
     def seal(self, object_id: ObjectID, metadata: bytes = b""):
         with self._lock:
@@ -164,13 +190,16 @@ class SharedMemoryStore:
             return o is not None and o.sealed
 
     def get(self, object_id: ObjectID) -> Optional[Tuple[bytes, memoryview]]:
-        """Returns (metadata, data) or None. Zero-copy: data is a view over shm."""
+        """Returns (metadata, data) or None. Zero-copy: data is the
+        object's canonical shm view — shared by all readers, reclaimed by
+        the store at delete/shutdown (readers slice it for chunked sends;
+        slices borrow the mmap directly and die with the reader)."""
         with self._lock:
             obj = self._objects.get(object_id)
             if obj is None or not obj.sealed:
                 return None
             self._objects.move_to_end(object_id)  # LRU touch
-            return obj.metadata, obj.shm.buf[: obj.data_size]
+            return obj.metadata, obj.view()
 
     def meta(self, object_id: ObjectID) -> Optional[bytes]:
         with self._lock:
@@ -226,17 +255,28 @@ class SharedMemoryStore:
             if not keep_spilled:
                 self._drop_spill_file(object_id)
             obj = self._objects.pop(object_id, None)
-            self._pinned.pop(object_id, None)
+            was_pinned = self._pinned.pop(object_id, None) is not None
             if obj is not None:
                 self.used -= obj.data_size
+                if not was_pinned:
+                    # Reclaim the canonical exported view BEFORE close():
+                    # without this every object ever read leaves an
+                    # exported pointer and close() fails (the BufferError
+                    # spam in the bench tail).  Pinned objects are being
+                    # actively chunk-read; leave their view to the leak-
+                    # tolerant path below rather than yank it mid-send.
+                    obj.release_view()
                 try:
                     obj.shm.unlink()
                 except Exception:
                     pass
                 try:
                     obj.shm.close()
+                except BufferError:
+                    pass  # a reader's transient chunk slice still borrows
+                    # the mapping; it dies with the reader
                 except Exception:
-                    pass  # exported zero-copy views keep the mapping alive
+                    pass
                 if evicted and self.evict_callback is not None:
                     try:
                         self.evict_callback(object_id)

@@ -1,15 +1,69 @@
 """Chrome-trace timeline export (reference: ray.timeline() →
 chrome_tracing_dump, python/ray/_private/profiling.py:43 over core-worker
-profile events, src/ray/core_worker/profile_event.h)."""
+profile events, src/ray/core_worker/profile_event.h) plus an in-process
+span recorder for driver-side hot-path instrumentation (pipeline dispatch
+and drain spans from ray_tpu.parallel.mesh_group.StepPipeline, device
+prefetch spans from ray_tpu.data.prefetch).
+
+The recorder is deliberately dumb and allocation-cheap: a bounded deque of
+dicts behind one lock, no I/O, no KV round trips — it must be safe to call
+once per training step without perturbing the thing it measures.  Readers
+(tools/perf_smoke.py, tests) pull spans with ``recorded_spans``; the chrome
+trace export merges them as one extra lane so overlap is visible in
+chrome://tracing next to the task timeline.
+"""
 from __future__ import annotations
 
 import json
+import threading
+from collections import deque
 from typing import List, Optional
+
+# Bounded: a forgotten long-running pipeline must not grow driver memory.
+_MAX_RECORDED_SPANS = 8192
+_recorded: "deque" = deque(maxlen=_MAX_RECORDED_SPANS)
+_recorded_lock = threading.Lock()
+
+
+def record_span(name: str, start: float, end: float, **args) -> None:
+    """Record one completed span (timestamps from time.perf_counter()).
+
+    Used by the step pipeline ("pipeline_dispatch"/"pipeline_drain", with
+    step=<idx>) and the device prefetcher ("prefetch_h2d").  Thread-safe;
+    never raises."""
+    try:
+        with _recorded_lock:
+            _recorded.append({"name": name, "start": float(start),
+                              "end": float(end), "args": dict(args)})
+    except Exception:
+        pass
+
+
+def recorded_spans(name: Optional[str] = None,
+                   clear: bool = False) -> List[dict]:
+    """Snapshot recorded spans (optionally filtered by name), oldest first."""
+    with _recorded_lock:
+        spans = list(_recorded)
+        if clear:
+            _recorded.clear()
+    if name is not None:
+        spans = [s for s in spans if s["name"] == name]
+    return spans
+
+
+def clear_recorded_spans() -> None:
+    with _recorded_lock:
+        _recorded.clear()
 
 
 def chrome_tracing_dump(task_events: List[dict],
-                        filename: Optional[str] = None) -> List[dict]:
-    """Convert the state API's task list into chrome://tracing events."""
+                        filename: Optional[str] = None,
+                        include_recorded: bool = False) -> List[dict]:
+    """Convert the state API's task list into chrome://tracing events.
+
+    ``include_recorded=True`` appends the in-process span recorder's
+    entries as a separate thread lane ("spans"), so pipeline dispatch/drain
+    overlap shows up against the task timeline."""
     events = []
     for t in task_events:
         if t.get("start") is None or t.get("end") is None:
@@ -25,6 +79,18 @@ def chrome_tracing_dump(task_events: List[dict],
             "args": {"task_id": t["task_id"], "attempt": t.get("attempt", 0),
                      "status": t.get("status")},
         })
+    if include_recorded:
+        for s in recorded_spans():
+            events.append({
+                "name": s["name"],
+                "cat": "SPAN",
+                "ph": "X",
+                "ts": s["start"] * 1e6,
+                "dur": (s["end"] - s["start"]) * 1e6,
+                "pid": "ray_tpu",
+                "tid": "spans",
+                "args": s["args"],
+            })
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

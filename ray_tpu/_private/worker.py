@@ -388,6 +388,11 @@ class CoreWorker:
         self._job_config_cache: Dict[JobID, dict] = {}
         self.ctx = TaskContext()
         self.driver_task_id = TaskID.for_driver(job_id)
+        # Out-of-task puts (driver threads): itertools.count.__next__ is
+        # atomic at the C level, so no lock on the put hot path.
+        import itertools
+
+        self._put_counter = itertools.count(1)
         # Blocked-in-get depth (process-wide): while a worker blocks
         # waiting for an object it tells the head, which releases the
         # worker's cpu so dependency producers can schedule (reference:
@@ -584,8 +589,17 @@ class CoreWorker:
         return self.ctx.task_id or self.driver_task_id
 
     def put(self, value: Any) -> ObjectRef:
-        self.ctx.put_counter += 1
-        oid = ObjectID.for_put(self.current_task_id(), self.ctx.put_counter)
+        if self.ctx.task_id is None:
+            # Outside task execution the put id hangs off the SHARED
+            # driver task id, but put_counter is thread-local — two driver
+            # threads would both count 1, 2, ... and silently alias each
+            # other's objects (e.g. a StepPipeline submitting from a
+            # worker thread).  Use the process-wide atomic counter.
+            put_index = next(self._put_counter)
+        else:
+            self.ctx.put_counter += 1
+            put_index = self.ctx.put_counter
+        oid = ObjectID.for_put(self.current_task_id(), put_index)
         self.put_object(oid, value)
         return ObjectRef(oid)
 

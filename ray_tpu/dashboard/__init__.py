@@ -259,11 +259,17 @@ class Dashboard:
         buf.write(f"<p>nodes: {len(nodes)} &middot; actors: {len(actors)} "
                   f"&middot; jobs: {len(jobs)} (auto-refreshes)</p>")
 
+        import html as _html
+
+        esc = _html.escape
         buf.write("<h3>resources</h3><table>"
                   "<tr><th>resource</th><th>available</th><th>total</th>"
                   "</tr>")
         for k, v in sorted(total[0].items()):
-            buf.write(f"<tr><td>{k}</td><td>{avail[0].get(k, 0):g}</td>"
+            # Custom resource names are user-controlled strings (e.g.
+            # ray_tpu.init(resources={...})) — escape like actor/job fields.
+            buf.write(f"<tr><td>{esc(str(k))}</td>"
+                      f"<td>{avail[0].get(k, 0):g}</td>"
                       f"<td>{v:g}</td></tr>")
         buf.write("</table>")
 
@@ -272,24 +278,21 @@ class Dashboard:
                   "<th>store used</th></tr>")
         for n in nodes:
             st = n.get("stats") or {}
-            res = " ".join(f"{k}:{v:g}" for k, v in
+            res = " ".join(f"{esc(str(k))}:{v:g}" for k, v in
                            sorted((n.get("resources") or {}).items())
                            if k != "memory")
             used = st.get("store_used_bytes")
             buf.write(
-                f"<tr><td>{n['node_id'][:12]}</td>"
+                f"<tr><td>{esc(str(n['node_id'])[:12])}</td>"
                 f"<td>{'yes' if n.get('alive', True) else 'NO'}</td>"
                 f"<td>{res}</td>"
-                f"<td>{st.get('cpu_percent', '-')}</td>"
-                f"<td>{st.get('mem_percent', '-')}</td>"
+                f"<td>{esc(str(st.get('cpu_percent', '-')))}</td>"
+                f"<td>{esc(str(st.get('mem_percent', '-')))}</td>"
                 f"<td>{_fmt_bytes(used) if used is not None else '-'}</td>"
                 "</tr>")
         buf.write("</table>")
 
         if actors:
-            import html as _html
-
-            esc = _html.escape
             buf.write("<h3>actors</h3><table><tr><th>actor</th>"
                       "<th>class</th><th>name</th><th>state</th>"
                       "<th>node</th><th>restarts</th></tr>")
@@ -306,9 +309,6 @@ class Dashboard:
             buf.write("</table>")
 
         if jobs:
-            import html as _html
-
-            esc = _html.escape
             buf.write("<h3>jobs</h3><table><tr><th>job</th><th>status</th>"
                       "</tr>")
             for j in jobs[:50]:

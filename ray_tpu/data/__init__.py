@@ -11,6 +11,7 @@ from ray_tpu.data.preprocessors import (  # noqa: F401
 )
 from ray_tpu.data.datasource import register_datasource  # noqa: F401
 from ray_tpu.data.grouped import GroupedData  # noqa: F401
+from ray_tpu.data.prefetch import DevicePrefetcher  # noqa: F401
 from ray_tpu.data.streaming import StreamingDataset  # noqa: F401
 
 

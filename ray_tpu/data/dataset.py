@@ -286,22 +286,15 @@ class Dataset:
     def iter_device_batches(self, batch_size: int = 256, sharding=None,
                             prefetch: int = 2) -> Iterator[Any]:
         """ML-ingest hot path: host batches → jax.device_put (optionally
-        sharded over a mesh) with double buffering, so HBM transfer overlaps
-        the consumer's step (reference analogue: iter_torch_batches +
-        pin_memory/prefetch, data/dataset_iterator.py)."""
-        import collections
+        sharded over a mesh) on a BACKGROUND thread feeding a bounded
+        queue, so the store fetch + H2D transfer overlap the consumer's
+        step (reference analogue: iter_torch_batches + pin_memory/
+        prefetch worker, data/dataset_iterator.py).  prefetch=0 keeps the
+        old inline path; see ray_tpu.data.prefetch.DevicePrefetcher."""
+        from ray_tpu.data.prefetch import DevicePrefetcher
 
-        import jax
-
-        q: "collections.deque" = collections.deque()
-        for host_batch in self.iter_batches(batch_size, "numpy"):
-            dev = (jax.device_put(host_batch, sharding) if sharding is not None
-                   else jax.device_put(host_batch))
-            q.append(dev)
-            if len(q) > prefetch:
-                yield q.popleft()
-        while q:
-            yield q.popleft()
+        return DevicePrefetcher(self.iter_batches(batch_size, "numpy"),
+                                sharding=sharding, prefetch=prefetch)
 
     def materialize(self) -> "Dataset":
         ray_tpu.wait(self._blocks, num_returns=len(self._blocks))

@@ -1,0 +1,237 @@
+"""Background host→device prefetch: the Data→Train ingest hot path.
+
+``iter_device_batches`` used to run ``jax.device_put`` inline on the
+consuming thread, so the object-store fetch + numpy assembly + H2D enqueue
+all serialized with the training step.  :class:`DevicePrefetcher` moves
+the whole producer side — block fetch, batch slicing, ``device_put`` —
+onto a background thread feeding a bounded queue of device-resident
+(optionally sharded) batches, double-buffered by default so the transfer
+of batch N+1..N+prefetch overlaps the consumer's compute on batch N
+(reference analogue: iter_torch_batches' pin_memory+prefetch worker,
+python/ray/data/dataset_iterator.py; the Podracer "keep the device fed"
+rule, arXiv:2104.06272).
+
+Contract:
+
+- ``prefetch=0`` degrades to the old inline behavior — no thread, the
+  consumer pays the device_put (useful for debugging and as the
+  comparison baseline in tools/perf_smoke.py).
+- Producer-thread exceptions propagate to the consumer at the point of
+  ``next()`` (original traceback preserved), never silently truncate the
+  stream.
+- ``close()`` (also called by ``__del__`` and generator-style GC) stops
+  and joins the producer thread deterministically — no leaked threads,
+  even when the producer is blocked on a full queue.
+- Queue occupancy and batch counts export through ray_tpu.util.metrics
+  (best-effort; skipped where no driver is connected) and per-batch H2D
+  spans land in the ray_tpu._private.profiling span recorder.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+class _EndOfStream:
+    """Producer→consumer sentinel; carries the producer's exception (or
+    None for a clean end of stream)."""
+    __slots__ = ("error",)
+
+    def __init__(self, error: Optional[BaseException] = None):
+        self.error = error
+
+
+def _make_place_fn(sharding, place_fn):
+    if place_fn is not None:
+        return place_fn
+
+    def place(batch):
+        import jax
+
+        if sharding is not None:
+            return jax.device_put(batch, sharding)
+        return jax.device_put(batch)
+
+    return place
+
+
+def _bounded_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
+    """Bounded-queue put that aborts promptly on close() — the producer
+    must never be stranded on a full queue the consumer abandoned."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _produce(src, q: "queue.Queue", stop: threading.Event, place):
+    """Producer thread body.  Deliberately a MODULE-LEVEL function taking
+    its state as arguments: a bound-method target would make the running
+    thread keep the DevicePrefetcher alive, so consumer-side GC could
+    never trigger __del__/close and the thread would leak."""
+    from ray_tpu._private import profiling
+
+    error: Optional[BaseException] = None
+    try:
+        for batch in src:
+            if stop.is_set():
+                return
+            t0 = time.perf_counter()
+            dev = place(batch)
+            profiling.record_span("prefetch_h2d", t0, time.perf_counter())
+            if not _bounded_put(q, stop, dev):
+                return
+    except BaseException as e:  # noqa: BLE001 — shipped to consumer
+        error = e
+    finally:
+        # The producer thread owns the source iterator: release its
+        # upstream resources (object-store refs held by the block
+        # iterator) here, where the generator is not mid-execution.
+        close = getattr(src, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
+        _bounded_put(q, stop, _EndOfStream(error))
+
+
+class DevicePrefetcher(Iterator[Any]):
+    """Iterator of device-resident batches with background H2D transfer.
+
+    ``host_batches``: any iterable of host batches (dict-of-numpy or
+    pytree).  ``sharding``: placement for ``jax.device_put`` (None =
+    default device).  ``place_fn``: overrides placement entirely (takes a
+    host batch, returns the device batch).  ``prefetch``: bounded queue
+    size (device batches materialized ahead of the consumer); 0 = inline.
+    """
+
+    def __init__(self, host_batches: Iterable[Any], sharding=None,
+                 prefetch: int = 2,
+                 place_fn: Optional[Callable[[Any], Any]] = None,
+                 name: str = "device-prefetch"):
+        self._src = iter(host_batches)
+        self._place = _make_place_fn(sharding, place_fn)
+        self.prefetch = int(prefetch)
+        self._count = 0
+        self._peak_occupancy = 0
+        self._end: Optional[_EndOfStream] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._q: Optional["queue.Queue"] = None
+        if self.prefetch > 0:
+            self._q = queue.Queue(maxsize=self.prefetch)
+            self._thread = threading.Thread(
+                target=_produce, args=(self._src, self._q, self._stop,
+                                       self._place),
+                daemon=True, name=f"rtpu-{name}")
+            self._thread.start()
+
+    # ---- consumer side ----
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self):
+        if self._end is not None:
+            self._raise_end()
+        if self.prefetch <= 0:
+            try:
+                batch = next(self._src)
+            except StopIteration:
+                self._end = _EndOfStream()
+                self._export_metrics()
+                raise
+            dev = self._place(batch)
+            self._count += 1
+            return dev
+        while True:
+            self._peak_occupancy = max(self._peak_occupancy,
+                                       self._q.qsize())
+            try:
+                item = self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self._thread is not None and not self._thread.is_alive():
+                    # Defensive: the producer always enqueues a sentinel in
+                    # its finally, so this means the thread was killed hard.
+                    self._end = _EndOfStream(
+                        RuntimeError("prefetch producer thread died"))
+                    self._raise_end()
+                continue
+            if isinstance(item, _EndOfStream):
+                self._end = item
+                self._export_metrics()
+                self._raise_end()
+            self._count += 1
+            return item
+
+    def _raise_end(self):
+        if self._end.error is not None:
+            raise self._end.error
+        raise StopIteration
+
+    # ---- lifecycle ----
+    def close(self):
+        """Stop the producer and join its thread.  Idempotent; safe to
+        call mid-stream (pending device batches are dropped)."""
+        self._stop.set()
+        if self._q is not None:
+            # Unblock a producer waiting on a full queue.
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self._end is None:
+            self._end = _EndOfStream()
+            self._export_metrics()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, exc_type, exc_val, tb):
+        self.close()
+
+    @property
+    def peak_occupancy(self) -> int:
+        return self._peak_occupancy
+
+    @property
+    def batches_delivered(self) -> int:
+        return self._count
+
+    def _export_metrics(self):
+        try:
+            from ray_tpu.util.metrics import Counter, Gauge
+
+            Counter("data_prefetch_batches_total",
+                    "device batches delivered by the prefetch queue"
+                    ).inc(self._count)
+            Gauge("data_prefetch_queue_peak",
+                  "peak occupancy of the device prefetch queue"
+                  ).set(float(self._peak_occupancy))
+        except Exception:
+            pass  # no connected driver (e.g. bare worker process)
+
+
+def iter_device_batches(host_batches: Iterable[Any], sharding=None,
+                        prefetch: int = 2,
+                        place_fn: Optional[Callable[[Any], Any]] = None
+                        ) -> DevicePrefetcher:
+    """Functional form: wrap any host-batch iterable in a background
+    device prefetcher (see :class:`DevicePrefetcher`)."""
+    return DevicePrefetcher(host_batches, sharding=sharding,
+                            prefetch=prefetch, place_fn=place_fn)

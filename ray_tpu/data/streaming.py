@@ -402,19 +402,16 @@ class StreamingDataset:
 
     def iter_device_batches(self, batch_size: int = 256, sharding=None,
                             prefetch: int = 2) -> Iterator[Any]:
-        import collections
+        """Device-resident batches with background H2D prefetch: the
+        object-store block fetch, batch assembly AND jax.device_put all
+        run on a producer thread feeding a bounded queue, so transfer
+        overlaps the consumer's step (prefetch=0: old inline behavior).
+        The returned iterator supports close() and joins its thread on
+        GC — see ray_tpu.data.prefetch."""
+        from ray_tpu.data.prefetch import DevicePrefetcher
 
-        import jax
-
-        q: "collections.deque" = collections.deque()
-        for host_batch in self.iter_batches(batch_size, "numpy"):
-            dev = (jax.device_put(host_batch, sharding)
-                   if sharding is not None else jax.device_put(host_batch))
-            q.append(dev)
-            if len(q) > prefetch:
-                yield q.popleft()
-        while q:
-            yield q.popleft()
+        return DevicePrefetcher(self.iter_batches(batch_size, "numpy"),
+                                sharding=sharding, prefetch=prefetch)
 
     def count(self) -> int:
         from ray_tpu.data.dataset import _count_block

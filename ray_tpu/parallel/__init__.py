@@ -31,7 +31,9 @@ from ray_tpu.parallel.ring_attention import (  # noqa: F401
 )
 from ray_tpu.parallel.mesh_group import (  # noqa: F401
     MeshGroup,
+    StepPipeline,
     bootstrap_jax_distributed,
+    driver_sync_count,
     gang_get,
     rendezvous,
 )

@@ -56,14 +56,55 @@ BackendExecutor failure handling + RLlib's fault-tolerant actor manager):
   kill/detect/restart/resume loop is testable on CPU with virtual
   devices (tests/test_mesh_fault_tolerance.py).
 
+Pipelined dispatch (the zero-sync hot path)
+===========================================
+``run()`` is lockstep: dispatch → block on gang_get → dispatch.  Every
+step therefore pays a full driver→worker RPC round trip during which the
+accelerators idle — the dominant stall once the step itself is fast.
+:class:`StepPipeline` (``group.pipeline()`` / ``group.run_pipelined()``)
+removes the driver from the per-step critical path, the Podracer/Sebulba
+"keep work enqueued ahead of completion" model (arXiv:2104.06272):
+
+- **Bounded in-flight window** — ``submit(fn, *args)`` dispatches step N
+  to every rank immediately and only then drains the oldest step once
+  more than ``depth`` are in flight, so the workers always hold the next
+  step(s) queued before the driver touches a result (at most ``depth``
+  steps remain in flight after submit returns; ``depth + 1`` transiently
+  during the backpressure drain).  Results are drained strictly in step
+  order through :func:`gang_get`, so PR 1's eager rank-death detection
+  fires mid-window exactly as it does in lockstep mode.
+- **Device-resident carry** — step functions run in the ``run_stateful``
+  shape (``fn(state, *args)``): weights/optimizer state live in the
+  worker's state dict as device arrays and never round-trip through the
+  driver.  Workers execute pipeline steps strictly in submission order
+  (a per-actor sequence gate), so carry mutation is race-free even though
+  the actor pool is concurrent.
+- **Sparse metrics fetch** — only every ``metrics_interval``-th step
+  returns its metrics (host-converted worker-side); the rest reply
+  ``None``, so no device→host fetch and no payload serialization gates
+  the in-between steps.
+- **Restart + replay** — a rank death mid-window raises
+  ``MeshGroupError`` eagerly; with ``max_group_restarts > 0`` the gang is
+  rebuilt, ``on_restart(group)`` re-materializes carry state, and the
+  (bounded, still-held) in-flight window is resubmitted from the oldest
+  undrained step — exactly-once carry semantics when the caller
+  checkpoints at drain cadence (see docs/PERFORMANCE.md).
+- **Observability** — ``driver_sync_count()`` counts blocking per-step
+  driver↔worker syncs (the lockstep ``run*`` paths); the pipelined path
+  performs zero and tests assert that.  Pipeline depth / in-flight
+  occupancy / dispatch+drain latency export through
+  ``ray_tpu.util.metrics`` and the span recorder in
+  ``ray_tpu._private.profiling``.
+
 Test strategy: on CPU, a group of N single-process actors each exposing K
 virtual devices (``--xla_force_host_platform_device_count``) forms an
 N*K-device global mesh with gloo cross-process collectives — the JAX
 equivalent of the reference's _fake_gpus mode, exercised in
-tests/test_mesh_group.py.
+tests/test_mesh_group.py (pipeline semantics: tests/test_step_pipeline.py).
 """
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -74,6 +115,22 @@ from ray_tpu import exceptions as exc
 # re-raised as-is: the worker is alive and a restart would not help).
 _GANG_ERRORS = (exc.ActorDiedError, exc.ActorUnavailableError,
                 exc.WorkerCrashedError, exc.ObjectLostError)
+
+# Driver-side sync counter: every blocking per-step driver↔worker round
+# trip on a dispatch path (the lockstep run*/health_check calls) bumps it.
+# The pipelined path must leave it untouched — tests assert the delta is
+# zero across a pipelined run (the "zero-sync hot path" invariant).
+_DRIVER_SYNCS = {"count": 0}
+
+
+def driver_sync_count() -> int:
+    """Blocking driver↔worker syncs performed by lockstep dispatch paths
+    since process start.  A pipelined step stream adds zero."""
+    return _DRIVER_SYNCS["count"]
+
+
+def _note_driver_sync() -> None:
+    _DRIVER_SYNCS["count"] += 1
 
 
 def _free_port() -> int:
@@ -162,6 +219,21 @@ def bootstrap_jax_distributed(coordinator: str, world_size: int, rank: int,
             "global_devices": jax.device_count()}
 
 
+def _metrics_to_host(out):
+    """Host-convert a step's metrics payload in ONE batched device fetch
+    (jax arrays → numpy scalars/arrays); non-jax payloads pass through.
+    Runs worker-side only on fetch steps, so the in-between steps never
+    pay a device→host transfer or a payload pickle."""
+    try:
+        import jax
+    except ImportError:
+        return out
+    try:
+        return jax.device_get(out)
+    except Exception:
+        return out
+
+
 @ray_tpu.remote
 class MeshWorker:
     """One host process of a mesh group.  Carries a state dict so stateful
@@ -169,6 +241,7 @@ class MeshWorker:
 
     def __init__(self, rank: int, world_size: int, generation: int = 0):
         import os
+        import threading
 
         from ray_tpu._private import chaos
 
@@ -176,6 +249,12 @@ class MeshWorker:
         self.world_size = world_size
         self.generation = generation
         self.state: Dict[str, Any] = {}
+        # Pipeline sequence gate: the actor pool runs methods on N threads,
+        # so queued pipeline_step calls could otherwise race on the carry
+        # state or execute out of order.  Steps wait here for their index.
+        self._pipe_cv = threading.Condition()
+        self._pipe_next = 0
+        self._pipe_err: Optional[str] = None
         os.environ[chaos.GENERATION_ENV] = str(generation)
 
     def node_info(self) -> dict:
@@ -214,6 +293,61 @@ class MeshWorker:
 
         chaos.maybe_die("mesh_run", self.rank)
         return fn(self.state, *args, **kwargs)
+
+    # ---- pipelined step stream (driven by StepPipeline) ----
+    def pipeline_seek(self, next_step: int) -> int:
+        """(Re)arm the sequence gate: the next pipeline_step this worker
+        executes is ``next_step``.  Called at pipeline creation and after
+        a gang restart (fresh processes start at 0, but the replay resumes
+        from the oldest undrained step)."""
+        with self._pipe_cv:
+            self._pipe_next = int(next_step)
+            self._pipe_err = None
+            self._pipe_cv.notify_all()
+        return self.rank
+
+    def pipeline_step(self, step: int, fetch: bool, fn: Callable,
+                      *args, **kwargs):
+        """Execute one pipelined step in strict submission order.
+
+        ``fn(state, *args)`` — the run_stateful shape: carry lives in the
+        state dict as device arrays.  Steps queued ahead of their turn
+        park on the sequence gate (they occupy actor-pool threads, which
+        is why MeshGroup sizes max_concurrency to pipeline_depth + 2 —
+        ping keeps a free slot).  Only ``fetch`` steps return metrics
+        (host-converted here, one batched device_get); the rest reply
+        None so nothing crosses the wire."""
+        from ray_tpu._private import chaos
+
+        deadline = time.monotonic() + 3600.0
+        with self._pipe_cv:
+            while self._pipe_err is None and step != self._pipe_next:
+                if step < self._pipe_next:
+                    raise RuntimeError(
+                        f"stale pipeline step {step} (worker already at "
+                        f"{self._pipe_next}); was the pipeline re-seeked?")
+                if not self._pipe_cv.wait(timeout=5.0) and \
+                        time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"pipeline step {step} stalled waiting for step "
+                        f"{self._pipe_next} to complete")
+            if self._pipe_err is not None:
+                raise RuntimeError(
+                    f"pipeline aborted by earlier failure: {self._pipe_err}")
+        chaos.maybe_die("pipeline_step", self.rank)
+        try:
+            out = fn(self.state, *args, **kwargs)
+        except BaseException as e:
+            # Poison the gate: later queued steps fail fast instead of
+            # running against a carry the failed step left half-updated.
+            with self._pipe_cv:
+                self._pipe_err = f"step {step}: {type(e).__name__}: {e}"
+                self._pipe_cv.notify_all()
+            raise
+        with self._pipe_cv:
+            self._pipe_next = step + 1
+            self._pipe_cv.notify_all()
+        return _metrics_to_host(out) if fetch else None
 
 
 def gang_get(futures: Sequence, timeout: Optional[float] = None,
@@ -322,6 +456,257 @@ def _restart_metrics():
                     "failed MeshGroup gang-restart attempts"))
 
 
+class _InflightStep:
+    """One dispatched-but-undrained step: the per-rank futures plus the
+    spec needed to resubmit it after a gang restart (the window is bounded
+    by depth, so holding specs is bounded memory)."""
+    __slots__ = ("idx", "refs", "fetch", "fn", "args", "kwargs",
+                 "dispatched_at")
+
+    def __init__(self, idx, refs, fetch, fn, args, kwargs, dispatched_at):
+        self.idx = idx
+        self.refs = refs
+        self.fetch = fetch
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.dispatched_at = dispatched_at
+
+
+def _pipeline_metrics():
+    """Lazy metric handles (internal_kv needs a connected driver)."""
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    return {
+        "depth": Gauge("mesh_pipeline_depth",
+                       "configured in-flight window of the step pipeline"),
+        "inflight": Gauge("mesh_pipeline_inflight",
+                          "steps currently in flight in the step pipeline"),
+        "steps": Counter("mesh_pipeline_steps_total",
+                         "pipeline steps drained"),
+        "restarts": Counter("mesh_pipeline_replays_total",
+                            "gang restarts absorbed by pipeline replay"),
+        "dispatch": Histogram(
+            "mesh_pipeline_dispatch_latency_s",
+            "driver time to dispatch one step to every rank",
+            boundaries=(0.0005, 0.002, 0.01, 0.05, 0.25, 1.0)),
+        "drain": Histogram(
+            "mesh_pipeline_drain_wait_s",
+            "driver wait for the oldest in-flight step at backpressure",
+            boundaries=(0.0005, 0.002, 0.01, 0.05, 0.25, 1.0, 5.0)),
+    }
+
+
+class StepPipeline:
+    """Bounded-window asynchronous step stream over a MeshGroup.
+
+    ``submit(fn, *args)`` dispatches ``fn(state, *args)`` to every rank
+    and returns as soon as at most ``depth`` steps remain in flight — the
+    workers always hold the next step(s) queued before the driver waits
+    on any result, so driver RPC latency never serializes with device
+    compute (zero per-step driver syncs; see driver_sync_count()).
+
+    Results drain strictly in step order via the gang_get supervisor:
+    rank death mid-window raises :class:`MeshGroupError` eagerly, and —
+    when the group has restart budget — the gang is rebuilt,
+    ``on_restart(group)`` re-materializes carry state, and the held
+    in-flight window replays from the oldest undrained step.
+
+    ``metrics_interval=N``: only every Nth step returns metrics (host-
+    converted worker-side); others reply None.  ``on_result(idx, res)``
+    fires for every drained step (res is None for non-fetch steps) — use
+    it to checkpoint at drain cadence for exactly-once replay.
+
+    Not thread-safe: one driver thread owns a pipeline.
+    """
+
+    def __init__(self, group: "MeshGroup", depth: int = 2,
+                 metrics_interval: int = 1,
+                 on_restart: Optional[Callable] = None,
+                 on_result: Optional[Callable] = None,
+                 drain_timeout: Optional[float] = None,
+                 export_metrics: bool = True):
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self.group = group
+        self.depth = depth
+        self.metrics_interval = max(1, int(metrics_interval))
+        self.on_restart = on_restart
+        self.on_result = on_result
+        self.drain_timeout = drain_timeout
+        self._inflight: "collections.deque[_InflightStep]" = \
+            collections.deque()
+        self._results: List[Any] = []
+        self._next_idx = 0
+        self._drained = 0
+        self.replay_count = 0
+        self._closed = False
+        self._broken = False
+        # fn -> store ref cache: serialize each distinct step fn once, not
+        # once per step (workers resolve the ref from their local cache).
+        self._fn_refs: Dict[int, tuple] = {}
+        self._metrics = None
+        if export_metrics:
+            try:
+                self._metrics = _pipeline_metrics()
+                self._metrics["depth"].set(float(depth))
+            except Exception:
+                self._metrics = None
+        self._seek(0)
+
+    # ---- internals ----
+    def _seek(self, idx: int) -> None:
+        """Arm every rank's sequence gate (setup/restart path — the only
+        blocking fan-outs a pipeline ever does outside its drains)."""
+        gang_get([w.pipeline_seek.remote(idx) for w in self.group.workers],
+                 timeout=self.group.bootstrap_timeout)
+
+    def _fn_ref(self, fn: Callable):
+        cached = self._fn_refs.get(id(fn))
+        if cached is not None and cached[0] is fn:
+            return cached[1]
+        ref = ray_tpu.put(fn)
+        self._fn_refs[id(fn)] = (fn, ref)
+        return ref
+
+    def _dispatch(self, step: _InflightStep) -> None:
+        t0 = time.perf_counter()
+        fn_ref = self._fn_ref(step.fn)
+        step.refs = [
+            w.pipeline_step.remote(step.idx, step.fetch, fn_ref,
+                                   *step.args, **step.kwargs)
+            for w in self.group.workers
+        ]
+        step.dispatched_at = time.perf_counter()
+        from ray_tpu._private import profiling
+
+        profiling.record_span("pipeline_dispatch", t0, step.dispatched_at,
+                              step=step.idx)
+        if self._metrics is not None and \
+                step.idx % self.metrics_interval == 0:
+            try:
+                self._metrics["dispatch"].observe(step.dispatched_at - t0)
+            except Exception:
+                pass
+
+    def _recover(self, cause: exc.MeshGroupError) -> None:
+        """Gang restart + window replay.  Raises (budget exhausted /
+        respawn failure) with the pipeline marked broken."""
+        try:
+            self.group._restart(cause)  # raises when out of budget
+        except BaseException:
+            self._broken = True
+            raise
+        if self.on_restart is not None:
+            self.on_restart(self.group)
+        base = self._inflight[0].idx if self._inflight else self._next_idx
+        self._seek(base)
+        for step in self._inflight:
+            self._dispatch(step)
+        self.replay_count += 1
+        if self._metrics is not None:
+            try:
+                self._metrics["restarts"].inc()
+            except Exception:
+                pass
+
+    def _drain_one(self) -> None:
+        step = self._inflight[0]
+        t0 = time.perf_counter()
+        while True:
+            try:
+                res = gang_get(step.refs, timeout=self.drain_timeout)
+                break
+            except exc.MeshGroupError as e:
+                self._recover(e)
+                step = self._inflight[0]
+            except BaseException:
+                self._broken = True
+                raise
+        t1 = time.perf_counter()
+        from ray_tpu._private import profiling
+
+        profiling.record_span("pipeline_drain", t0, t1, step=step.idx)
+        self._inflight.popleft()
+        self._drained += 1
+        if step.fetch:
+            self._results.append((step.idx, res))
+        if self.on_result is not None:
+            self.on_result(step.idx, res if step.fetch else None)
+        if self._metrics is not None and \
+                self._drained % self.metrics_interval == 0:
+            try:
+                self._metrics["steps"].inc(self.metrics_interval)
+                self._metrics["inflight"].set(float(len(self._inflight)))
+                self._metrics["drain"].observe(t1 - t0)
+            except Exception:
+                pass
+
+    # ---- public API ----
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    @property
+    def steps_submitted(self) -> int:
+        return self._next_idx
+
+    @property
+    def steps_drained(self) -> int:
+        return self._drained
+
+    def submit(self, fn: Callable, *args,
+               fetch: Optional[bool] = None, **kwargs) -> int:
+        """Dispatch one step to every rank; blocks (draining the oldest
+        step) only once more than ``depth`` are in flight — so step N+1
+        is always dispatched before step N-depth's result is awaited.
+        Returns the step index."""
+        if self._closed or self._broken:
+            raise RuntimeError("pipeline is closed")
+        idx = self._next_idx
+        self._next_idx += 1
+        if fetch is None:
+            fetch = idx % self.metrics_interval == 0
+        step = _InflightStep(idx, None, bool(fetch), fn, args, kwargs, 0.0)
+        self._dispatch(step)
+        self._inflight.append(step)
+        while len(self._inflight) > self.depth:
+            self._drain_one()
+        return idx
+
+    def take_results(self) -> List[Any]:
+        """Pop drained (idx, per-rank results) pairs accumulated so far —
+        fetch steps only, in step order.  Non-blocking."""
+        out, self._results = self._results, []
+        return out
+
+    def flush(self) -> List[Any]:
+        """Drain every in-flight step, then return ALL fetched results
+        accumulated since creation (non-destructive)."""
+        while self._inflight:
+            self._drain_one()
+        return list(self._results)
+
+    def close(self, flush: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if flush and not self._broken:
+            while self._inflight:
+                self._drain_one()
+        else:
+            _abandon([(s.idx, r) for s in self._inflight
+                      for r in (s.refs or [])])
+            self._inflight.clear()
+
+    def __enter__(self) -> "StepPipeline":
+        return self
+
+    def __exit__(self, exc_type, exc_val, tb) -> None:
+        # On an exception unwind, don't block on (possibly poisoned) work.
+        self.close(flush=exc_type is None)
+
+
 class MeshGroup:
     """A gang of one actor per TPU host forming one global jax mesh.
 
@@ -345,7 +730,8 @@ class MeshGroup:
                  bootstrap_timeout: float = 120.0,
                  max_group_restarts: int = 0,
                  restart_backoff_s: float = 0.5,
-                 restart_backoff_max_s: float = 30.0):
+                 restart_backoff_max_s: float = 30.0,
+                 pipeline_depth: int = 2):
         self.num_hosts = num_hosts
         self.platform = platform
         self.local_device_count = local_device_count
@@ -355,6 +741,10 @@ class MeshGroup:
         self.restart_backoff_s = restart_backoff_s
         self.restart_backoff_max_s = restart_backoff_max_s
         self.restart_count = 0
+        # Default StepPipeline window; also sizes the actor pool so up to
+        # depth+1 queued pipeline steps can park on the sequence gate with
+        # ping still answered on a free slot.
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self._resources = dict(resources_per_host or {"CPU": 1.0})
         self.pg = None
         self.workers: List[Any] = []
@@ -363,7 +753,7 @@ class MeshGroup:
     # ---- gang lifecycle ----
     def _actor_opts(self) -> Dict[str, Any]:
         res = self._resources
-        opts: Dict[str, Any] = {"max_concurrency": 2}
+        opts: Dict[str, Any] = {"max_concurrency": self.pipeline_depth + 2}
         if res.get("CPU"):
             opts["num_cpus"] = res["CPU"]
         if res.get("TPU"):
@@ -456,6 +846,7 @@ class MeshGroup:
         success; raises ``MeshGroupError`` naming dead/unresponsive ranks.
         Safe to call while a ``run()`` is in flight (pings ride the spare
         concurrency slot)."""
+        _note_driver_sync()
         futures = [w.ping.remote() for w in self.workers]
         return gang_get(futures, timeout=deadline)
 
@@ -473,6 +864,7 @@ class MeshGroup:
         rendezvous), ``on_restart(group)`` — if given — re-materializes
         host-pinned state, and fn is retried.  ``timeout`` is a per-attempt
         deadline for the whole fan-out."""
+        _note_driver_sync()
         return self._supervised(
             lambda: gang_get([w.run.remote(fn, *args, **kwargs)
                               for w in self.workers], timeout=timeout),
@@ -484,10 +876,49 @@ class MeshGroup:
     def run_stateful(self, fn: Callable, *args,
                      on_restart: Optional[Callable] = None,
                      timeout: Optional[float] = None, **kwargs) -> List[Any]:
+        _note_driver_sync()
         return self._supervised(
             lambda: gang_get([w.run_stateful.remote(fn, *args, **kwargs)
                               for w in self.workers], timeout=timeout),
             on_restart)
+
+    # ---- pipelined execution (the zero-sync hot path) ----
+    def pipeline(self, depth: Optional[int] = None,
+                 metrics_interval: int = 1,
+                 on_restart: Optional[Callable] = None,
+                 on_result: Optional[Callable] = None,
+                 drain_timeout: Optional[float] = None,
+                 export_metrics: bool = True) -> StepPipeline:
+        """Open a :class:`StepPipeline` over this gang (see its docs).
+        ``depth`` defaults to the group's ``pipeline_depth``."""
+        return StepPipeline(self, depth=depth or self.pipeline_depth,
+                            metrics_interval=metrics_interval,
+                            on_restart=on_restart, on_result=on_result,
+                            drain_timeout=drain_timeout,
+                            export_metrics=export_metrics)
+
+    def run_pipelined(self, fn: Callable, num_steps: int, *args,
+                      depth: Optional[int] = None,
+                      metrics_interval: int = 1,
+                      args_fn: Optional[Callable] = None,
+                      on_restart: Optional[Callable] = None,
+                      on_result: Optional[Callable] = None,
+                      timeout: Optional[float] = None,
+                      **kwargs) -> List[Any]:
+        """Drive ``num_steps`` pipelined ``fn(state, *args)`` steps and
+        return the fetched ``(step_idx, per-rank results)`` pairs (every
+        ``metrics_interval``-th step).  ``args_fn(i)`` — when given —
+        produces per-step positional args (e.g. a batch ref); otherwise
+        every step receives ``*args``.  Supervision matches ``run()``:
+        rank death restarts the gang under the restart budget and replays
+        the in-flight window after ``on_restart``."""
+        with self.pipeline(depth=depth, metrics_interval=metrics_interval,
+                           on_restart=on_restart, on_result=on_result,
+                           drain_timeout=timeout) as pipe:
+            for i in range(num_steps):
+                step_args = args_fn(i) if args_fn is not None else args
+                pipe.submit(fn, *step_args, **kwargs)
+            return pipe.flush()
 
     def _supervised(self, attempt: Callable[[], List[Any]],
                     on_restart: Optional[Callable]) -> List[Any]:
@@ -500,9 +931,11 @@ class MeshGroup:
                     on_restart(self)
 
     def run_rank(self, rank: int, fn: Callable, *args, **kwargs):
+        _note_driver_sync()
         return ray_tpu.get(self.workers[rank].run.remote(fn, *args, **kwargs))
 
     def run_rank_stateful(self, rank: int, fn: Callable, *args, **kwargs):
+        _note_driver_sync()
         return ray_tpu.get(
             self.workers[rank].run_stateful.remote(fn, *args, **kwargs))
 

@@ -112,6 +112,13 @@ def _learner_update(state, batch):
     return metrics_to_host(state["learner"].update(batch))
 
 
+def _learner_update_device(state, batch):
+    # Pipelined form: metrics stay device-resident (lazy jax scalars);
+    # the MeshWorker host-converts them only on fetch steps, so the
+    # in-between steps never pay a device_get or a payload pickle.
+    return state["learner"].update(batch)
+
+
 def _learner_get_weights(state):
     return state["learner"].get_weights()
 
@@ -145,16 +152,28 @@ class DistributedLearnerGroup:
 
     def __init__(self, learner_factory, num_hosts: int = 1,
                  resources_per_host=None, platform=None,
-                 local_device_count=None, max_group_restarts: int = 0):
+                 local_device_count=None, max_group_restarts: int = 0,
+                 pipeline_depth: int = 0, metrics_interval: int = 1):
         from ray_tpu.parallel.mesh_group import MeshGroup
 
         self._factory = learner_factory
         self._last_weights = None
+        self._last_metrics: Optional[Dict[str, float]] = None
+        self._weight_steps: set = set()
+        self._pipeline = None
         self.group = MeshGroup(num_hosts, resources_per_host,
                                platform=platform,
                                local_device_count=local_device_count,
-                               max_group_restarts=max_group_restarts)
+                               max_group_restarts=max_group_restarts,
+                               pipeline_depth=max(1, pipeline_depth))
         self.group.run_stateful(_build_learner, learner_factory)
+        if pipeline_depth > 0:
+            # Zero-sync hot path: updates stream through a bounded window,
+            # the driver never blocks per step, and metrics arrive every
+            # metrics_interval-th step (see mesh_group.StepPipeline).
+            self._pipeline = self.group.pipeline(
+                depth=pipeline_depth, metrics_interval=metrics_interval,
+                on_restart=self._on_restart, on_result=self._on_pipe_result)
 
     def _on_restart(self, group):
         """After a gang rebuild the new host processes hold empty state:
@@ -187,13 +206,71 @@ class DistributedLearnerGroup:
                                           on_restart=self._on_restart)
         return results[0]
 
+    # ---- pipelined update stream (pipeline_depth > 0) ----
+    def _on_pipe_result(self, idx: int, res) -> None:
+        if res is None:
+            return  # non-fetch step: metrics stayed on device
+        if idx in self._weight_steps:
+            self._weight_steps.discard(idx)
+            self._last_weights = res[0]
+        else:
+            self._last_metrics = res[0]
+
+    def update_async(self, batch) -> Optional[Dict[str, float]]:
+        """Pipelined update: dispatches the step and returns immediately
+        (blocking only when the in-flight window is full), so the driver
+        never gates device compute.  Returns the LATEST drained metrics —
+        which lag the submitted step by up to pipeline_depth steps — or
+        None before the first fetch step drains."""
+        import ray_tpu
+
+        if self._pipeline is None:
+            raise RuntimeError(
+                "pipelined updates need pipeline_depth > 0 at construction")
+        batch_ref = ray_tpu.put(batch)
+        self._pipeline.submit(_learner_update_device, batch_ref)
+        return self._last_metrics
+
+    def checkpoint_weights_async(self) -> None:
+        """Non-blocking weight-sync snapshot: rides the step pipeline, so
+        it serializes with the (donating) update steps instead of racing
+        them, and the driver never blocks.  The snapshot lands in the
+        driver-side restore cache when its pipeline slot drains (at most
+        pipeline_depth steps later); it is also what a gang rebuild
+        re-broadcasts."""
+        if self._pipeline is None:
+            raise RuntimeError(
+                "pipelined snapshots need pipeline_depth > 0")
+        idx = self._pipeline.submit(_learner_get_weights, fetch=True)
+        self._weight_steps.add(idx)
+
+    def flush_updates(self) -> Optional[Dict[str, float]]:
+        """Drain every in-flight pipelined step; returns the final
+        metrics (the barrier to call at iteration end)."""
+        if self._pipeline is not None:
+            self._pipeline.flush()
+        return self._last_metrics
+
     def get_weights(self):
+        if self._pipeline is not None:
+            # Order the read after every in-flight donated update.
+            self._pipeline.flush()
         return self.group.run_rank_stateful(0, _learner_get_weights)
 
     def set_weights(self, weights):
+        if self._pipeline is not None:
+            # run_stateful bypasses the pipeline's sequence gate: drain
+            # first so the broadcast can't interleave with queued updates.
+            self._pipeline.flush()
         self._last_weights = weights
         self.group.run_stateful(_learner_set_weights, weights,
                                 on_restart=self._on_restart)
 
     def shutdown(self):
+        if self._pipeline is not None:
+            try:
+                self._pipeline.close(flush=False)
+            except Exception:
+                pass
+            self._pipeline = None
         self.group.shutdown()

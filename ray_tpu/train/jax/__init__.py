@@ -2,8 +2,11 @@
 python/ray/train/torch/torch_trainer.py)."""
 from ray_tpu.train.jax.config import JaxConfig  # noqa: F401
 from ray_tpu.train.jax.train_loop_utils import (  # noqa: F401
+    AsyncMetrics,
+    compile_donated_step,
     get_mesh,
     prepare_batch,
+    prepare_device_iterator,
     prepare_train_state,
 )
 from ray_tpu.train.base_trainer import DataParallelTrainer

@@ -33,3 +33,75 @@ def prepare_batch(batch: Any, mesh):
         return jax.device_put(x, batch_sharding(mesh, getattr(x, "ndim", 1)))
 
     return jax.tree_util.tree_map(place, batch)
+
+
+def compile_donated_step(step_fn, carry_argnums=(0,), batch_argnums=(),
+                         donate_batch: bool = False, **jit_kwargs):
+    """jit a training step with the carry (params/opt state) — and
+    optionally the batch buffers — donated, so XLA updates weights
+    in-place instead of allocating a second copy per step (the hot-path
+    half of the zero-sync pipeline; see docs/PERFORMANCE.md).
+
+    ``step_fn(carry..., batch...) -> (carry..., metrics)``: the caller
+    must not reuse donated arguments after the call (donation invalidates
+    their buffers) — keep ``donate_batch=False`` when the same host batch
+    is fed to several steps (e.g. synthetic-data benches)."""
+    import jax
+
+    donate = tuple(carry_argnums)
+    if donate_batch:
+        donate = donate + tuple(batch_argnums)
+    return jax.jit(step_fn, donate_argnums=donate, **jit_kwargs)
+
+
+class AsyncMetrics:
+    """Every-N async metrics fetch for step loops.
+
+    ``push(step, metrics)`` keeps the (lazy, device-resident) metrics of
+    the latest step and only converts them to host floats every
+    ``interval`` steps — so the loop never blocks on a per-step
+    device_get round trip (~0.1s on tunneled backends).  ``last`` holds
+    the most recent host copy; ``flush()`` forces a final fetch (and is
+    the loop-end barrier the bench pattern needs)."""
+
+    def __init__(self, interval: int = 10):
+        self.interval = max(1, int(interval))
+        self._pending = None
+        self._pending_step = None
+        self.last: Optional[dict] = None
+        self.last_step: Optional[int] = None
+
+    def push(self, step: int, metrics: Any) -> Optional[dict]:
+        self._pending = metrics
+        self._pending_step = step
+        if step % self.interval == 0:
+            return self.flush()
+        return None
+
+    def flush(self) -> Optional[dict]:
+        if self._pending is None:
+            return self.last
+        import jax
+
+        host = jax.device_get(self._pending)
+        self.last = {k: (float(v) if hasattr(v, "__float__") else v)
+                     for k, v in host.items()} \
+            if isinstance(host, dict) else host
+        self.last_step = self._pending_step
+        self._pending = None
+        return self.last
+
+
+def prepare_device_iterator(host_batches, mesh=None, sharding=None,
+                            prefetch: int = 2):
+    """Wrap any host-batch iterable in the background device prefetcher,
+    sharded over the mesh's data axes when ``mesh`` is given — the Train
+    JAX loop's ingest hot path (same machinery as
+    Dataset.iter_device_batches; see ray_tpu.data.prefetch)."""
+    from ray_tpu.data.prefetch import DevicePrefetcher
+
+    place_fn = None
+    if mesh is not None and sharding is None:
+        place_fn = lambda b: prepare_batch(b, mesh)  # noqa: E731
+    return DevicePrefetcher(host_batches, sharding=sharding,
+                            prefetch=prefetch, place_fn=place_fn)

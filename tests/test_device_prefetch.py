@@ -1,0 +1,107 @@
+"""Background device prefetcher (ray_tpu.data.prefetch): the Data→Train
+ingest hot path.  Producer-thread exception propagation, deterministic
+thread lifecycle (close + GC), prefetch=0 inline degradation, and the
+StreamingDataset/Dataset wiring."""
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data.prefetch import DevicePrefetcher
+
+MB = 1024 * 1024
+
+
+def _host_batches(n):
+    return [{"x": np.full((8,), i, np.int64)} for i in range(n)]
+
+
+def _wait_dead(thread, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    return not thread.is_alive()
+
+
+def test_prefetch_order_values_and_occupancy():
+    pf = DevicePrefetcher(iter(_host_batches(6)), prefetch=2)
+    out = [int(b["x"][0]) for b in pf]
+    assert out == [0, 1, 2, 3, 4, 5]
+    assert pf.batches_delivered == 6
+    assert pf.peak_occupancy <= 2  # the queue bound held
+
+
+def test_producer_exception_propagates_to_consumer():
+    def bad_source():
+        yield {"x": np.zeros(2)}
+        raise ValueError("reader exploded")
+
+    pf = DevicePrefetcher(bad_source(), prefetch=2)
+    next(pf)
+    with pytest.raises(ValueError, match="reader exploded"):
+        next(pf)
+    # The error is sticky, not swallowed into StopIteration.
+    with pytest.raises(ValueError):
+        next(pf)
+
+
+def test_close_joins_blocked_producer_thread():
+    # An unbounded source against a size-1 queue: the producer is parked
+    # on a full queue when close() arrives — it must still join.
+    pf = DevicePrefetcher(({"x": np.zeros(2)} for _ in range(10**6)),
+                          prefetch=1)
+    time.sleep(0.2)
+    thread = pf._thread
+    assert thread is not None and thread.is_alive()
+    pf.close()
+    assert not thread.is_alive(), "close() leaked the producer thread"
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_gc_joins_producer_thread():
+    before = threading.active_count()
+    pf = DevicePrefetcher(({"x": np.zeros(2)} for _ in range(10**6)),
+                          prefetch=1)
+    thread = pf._thread
+    del pf
+    gc.collect()
+    assert _wait_dead(thread), "dropping the iterator leaked its thread"
+    assert threading.active_count() <= before
+
+
+def test_prefetch_zero_is_inline():
+    pf = DevicePrefetcher(iter(_host_batches(4)), prefetch=0)
+    assert pf._thread is None  # no producer thread at all
+    assert [int(b["x"][0]) for b in pf] == [0, 1, 2, 3]
+
+
+def test_streaming_iter_device_batches_end_to_end(shutdown_only):
+    """The wired path: object-store blocks → iter_batches → background
+    device_put → consumer, with row fidelity and clean iterator close."""
+    from ray_tpu.data import StreamingDataset
+    from ray_tpu.data.block import block_from_numpy
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * MB)
+
+    @ray_tpu.remote
+    def gen(i):
+        base = i * 100
+        return block_from_numpy(
+            {"id": np.arange(base, base + 100, dtype=np.int64)})
+
+    sd = StreamingDataset([(lambda i=i: gen.remote(i)) for i in range(4)],
+                          max_inflight_blocks=2)
+    it = sd.iter_device_batches(batch_size=50, prefetch=2)
+    got = np.sort(np.concatenate([np.asarray(b["id"]) for b in it]))
+    np.testing.assert_array_equal(got, np.arange(400))
+
+    # Early close mid-stream: no leaked thread, iteration ends cleanly.
+    it2 = sd.iter_device_batches(batch_size=50, prefetch=2)
+    next(it2)
+    thread = it2._thread
+    it2.close()
+    assert thread is None or not thread.is_alive()

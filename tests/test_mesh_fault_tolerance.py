@@ -164,6 +164,58 @@ def test_user_exception_is_not_a_gang_failure(shutdown_only):
         mg.shutdown()
 
 
+def test_pipeline_gang_restart_replays_window_and_resumes(shutdown_only,
+                                                          monkeypatch):
+    """PR 1 fault tolerance under PR 2 pipelining: rank 1 SIGKILLs at its
+    3rd pipelined step (generation 0 only).  The drain supervisor detects
+    the death mid-window, the gang restarts (fresh processes + rendezvous),
+    on_restart restores the carry from the drain-cadence checkpoint, and
+    the still-held in-flight window replays — the stream completes with
+    exactly-once carry semantics (acc == 1..8, no double-counted step)."""
+    from ray_tpu.parallel import MeshGroup
+
+    def counting_step(state, inc):
+        state["acc"] = state.get("acc", 0) + inc
+        return {"acc": state["acc"]}
+
+    def restore(state, acc):
+        state["acc"] = acc
+        return True
+
+    monkeypatch.setenv("RAY_TPU_TESTING_KILL_SCHEDULE", "pipeline_step:1:3:0")
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    mg = MeshGroup(num_hosts=2, platform="cpu", local_device_count=2,
+                   max_group_restarts=2, restart_backoff_s=0.05,
+                   pipeline_depth=2)
+    checkpoint = {"acc": 0}
+
+    def on_result(idx, res):
+        # Drain-cadence checkpoint: the restore point for exact replay.
+        if res is not None:
+            checkpoint["acc"] = res[0]["acc"]
+
+    def on_restart(group):
+        group.run_stateful(restore, checkpoint["acc"])
+
+    try:
+        pipe = mg.pipeline(depth=2, metrics_interval=1,
+                           on_restart=on_restart, on_result=on_result)
+        for _ in range(8):
+            pipe.submit(counting_step, 1)
+        results = pipe.flush()
+        pipe.close()
+        assert [idx for idx, _ in results] == list(range(8))
+        # Exactly-once: every step applied once on BOTH ranks despite the
+        # mid-window kill + replay.
+        for _, per_rank in results:
+            assert per_rank[0]["acc"] == per_rank[1]["acc"]
+        assert [r[0]["acc"] for _, r in results] == list(range(1, 9))
+        assert mg.restart_count == 1
+        assert pipe.replay_count == 1
+    finally:
+        mg.shutdown()
+
+
 def test_train_elastic_resume_from_checkpoint(shutdown_only, monkeypatch):
     """Chaos kills rank 1 at its 2nd report (generation 0 only).  The
     executor converts the out-of-band rank death into TrainingWorkerError,

@@ -1,0 +1,75 @@
+"""SharedMemoryStore view lifecycle: the canonical zero-copy view is
+shared by all readers and reclaimed deterministically at delete/shutdown,
+so shm.close() succeeds instead of spamming "BufferError: cannot close
+exported pointers exist" in the bench tail (ISSUE 2 satellite)."""
+import os
+import warnings
+
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import SharedMemoryStore
+
+
+def _oid():
+    return ObjectID(os.urandom(20))
+
+
+@pytest.fixture
+def store():
+    s = SharedMemoryStore(capacity_bytes=64 * 1024 * 1024,
+                          use_native_arena=False)
+    yield s
+    s.shutdown()
+
+
+def test_get_hands_out_one_canonical_view(store):
+    oid = _oid()
+    store.put(oid, b"meta", b"abcd" * 256)
+    _, v1 = store.get(oid)
+    _, v2 = store.get(oid)
+    assert v1 is v2  # repeated reads don't accumulate exported pointers
+    assert bytes(v1[:4]) == b"abcd"
+
+
+def test_delete_reclaims_view_and_closes_segment(store):
+    oid = _oid()
+    buf = store.create(oid, 1024)
+    buf[:4] = b"wxyz"
+    store.seal(oid)
+    _, view = store.get(oid)
+    store.delete(oid)
+    # Deterministic reclaim: the handed-out view is dead, not leaked.
+    with pytest.raises(ValueError):
+        view[:1]
+    with pytest.raises(ValueError):
+        buf[:1]
+    assert store.stats()["num_objects"] == 0
+
+
+def test_shutdown_with_exported_views_is_silent(store):
+    views = []
+    for _ in range(8):
+        oid = _oid()
+        store.put(oid, b"", b"x" * 4096)
+        views.append(store.get(oid)[1])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any BufferError noise -> failure
+        store.shutdown()
+    assert store.stats()["num_objects"] == 0
+    for v in views:  # every handed-out view was reclaimed
+        with pytest.raises(ValueError):
+            v[:1]
+
+
+def test_reader_chunk_slices_survive_parent_reclaim(store):
+    """Chunked senders slice the canonical view; those slices borrow the
+    mmap directly, so reclaiming the parent mid-send must not invalidate
+    an in-flight chunk (it just defers the segment close)."""
+    oid = _oid()
+    store.put(oid, b"", b"ab" * 512)
+    _, view = store.get(oid)
+    chunk = view[0:4]
+    store.delete(oid)
+    assert bytes(chunk) == b"abab"  # still valid until the reader drops it
+    del chunk

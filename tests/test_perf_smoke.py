@@ -1,0 +1,15 @@
+"""Tier-1 wrapper for tools/perf_smoke.py: the pipelined hot path must
+dispatch step N+1 before step N's result is fetched (overlap), with zero
+blocking driver↔worker syncs — so an overlap regression fails the normal
+test pass instead of only surfacing in the full bench."""
+import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
+
+from tools.perf_smoke import run_smoke
+
+
+def test_pipeline_overlap_smoke(shutdown_only):
+    out = run_smoke(steps=8, depth=2)
+    assert out["results_ok"], out
+    assert out["driver_syncs"] == 0, out
+    assert out["overlap_ok"], f"lockstep regression: {out}"
+    assert out["ok"]

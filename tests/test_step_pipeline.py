@@ -1,0 +1,207 @@
+"""StepPipeline: the zero-sync pipelined gang-dispatch hot path.
+
+Semantics under test (ISSUE 2 tentpole):
+- bounded in-flight window — backpressure actually blocks at depth,
+- strict in-order execution + in-order result delivery,
+- device-resident carry (state survives across pipelined steps),
+- sparse metrics fetch (only every Nth step returns a payload),
+- ZERO blocking driver↔worker syncs on the pipelined path
+  (mesh_group.driver_sync_count stays flat; the lockstep run() bumps it),
+- user exceptions poison the stream (no half-updated carry) without
+  consuming restart budget,
+- rank death mid-window raises MeshGroupError promptly (PR 1's gang_get
+  supervisor still fires eagerly under pipelining).
+"""
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import MeshGroupError, TaskError
+from ray_tpu.parallel import mesh_group
+
+
+def _make_counting_step():
+    def step(state, inc):
+        state["acc"] = state.get("acc", 0) + inc
+        return {"acc": state["acc"]}
+
+    return step
+
+
+def _make_gated_step():
+    def step(state, gate_path):
+        import os
+        import time as _t
+
+        deadline = _t.monotonic() + 30.0
+        while not os.path.exists(gate_path):
+            if _t.monotonic() > deadline:
+                raise TimeoutError("gate never opened")
+            _t.sleep(0.02)
+        state["n"] = state.get("n", 0) + 1
+        return state["n"]
+
+    return step
+
+
+def test_pipeline_semantics_single_host(shutdown_only, tmp_path):
+    """One spawn, many assertions (MeshGroup spawns are the slow part)."""
+    from ray_tpu.parallel import MeshGroup, driver_sync_count
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    mg = MeshGroup(num_hosts=1, platform="cpu", local_device_count=2,
+                   pipeline_depth=2)
+    try:
+        # ---- in-order execution, carry state, in-order results ----
+        base_syncs = driver_sync_count()
+        with mg.pipeline(depth=2, metrics_interval=1) as pipe:
+            for _ in range(6):
+                pipe.submit(_make_counting_step(), 1)
+            results = pipe.flush()
+        assert [idx for idx, _ in results] == list(range(6))
+        # Carry lives worker-side: acc counts every step exactly once, in
+        # submission order.
+        assert [r[0]["acc"] for _, r in results] == [1, 2, 3, 4, 5, 6]
+        # ---- the zero-sync invariant ----
+        assert driver_sync_count() == base_syncs, \
+            "pipelined path performed a blocking driver sync"
+        mg.run(lambda: None)
+        assert driver_sync_count() == base_syncs + 1  # lockstep DOES sync
+
+        # ---- sparse metrics fetch: only every 2nd step returns ----
+        with mg.pipeline(depth=2, metrics_interval=2) as pipe:
+            for _ in range(5):
+                pipe.submit(_make_counting_step(), 1)
+            results = pipe.flush()
+        assert [idx for idx, _ in results] == [0, 2, 4]
+
+        # ---- backpressure blocks at depth ----
+        gate = str(tmp_path / "gate")
+        pipe = mg.pipeline(depth=2, metrics_interval=1)
+        for _ in range(2):
+            pipe.submit(_make_gated_step(), gate)  # fills the window
+        blocked = threading.Event()
+        done = threading.Event()
+
+        def third_submit():
+            blocked.set()
+            pipe.submit(_make_gated_step(), gate)  # must block: window full
+            done.set()
+
+        t = threading.Thread(target=third_submit, daemon=True)
+        t.start()
+        blocked.wait(5)
+        assert not done.wait(1.0), "submit past the window did not block"
+        (tmp_path / "gate").write_text("open")  # open the gate
+        assert done.wait(30), "blocked submit never completed"
+        results = pipe.flush()
+        pipe.close()
+        assert [r for _, r in results] == [[1], [2], [3]]
+
+        # ---- user exception: poisons the stream, no restart consumed ----
+        def boom(state):
+            raise ValueError("user bug")
+
+        with pytest.raises(TaskError):
+            with mg.pipeline(depth=2) as pipe:
+                pipe.submit(boom)
+                pipe.flush()
+        assert mg.restart_count == 0
+        # A fresh pipeline re-arms the sequence gate after the poison.
+        with mg.pipeline(depth=2) as pipe:
+            pipe.submit(_make_counting_step(), 5)
+            results = pipe.flush()
+        assert results[0][1][0]["acc"] >= 5
+    finally:
+        mg.shutdown()
+
+
+def test_rank_death_mid_pipeline_raises_fast(shutdown_only, monkeypatch):
+    """Rank 1 SIGKILLed at its 2nd pipelined step: the drain supervisor
+    must surface MeshGroupError naming the dead rank well before any
+    deadline, not hang on the poisoned window."""
+    from ray_tpu.parallel import MeshGroup
+
+    monkeypatch.setenv("RAY_TPU_TESTING_KILL_SCHEDULE", "pipeline_step:1:2:0")
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    mg = MeshGroup(num_hosts=2, platform="cpu", local_device_count=2,
+                   pipeline_depth=2)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(MeshGroupError) as ei:
+            with mg.pipeline(depth=2, metrics_interval=1) as pipe:
+                for _ in range(6):
+                    pipe.submit(_make_counting_step(), 1)
+                pipe.flush()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 20.0, f"rank death took {elapsed:.1f}s to surface"
+        assert 1 in ei.value.failed_ranks
+    finally:
+        mg.shutdown()
+
+
+def test_driver_sync_counter_monotonic():
+    before = mesh_group.driver_sync_count()
+    mesh_group._note_driver_sync()
+    assert mesh_group.driver_sync_count() == before + 1
+
+
+def test_learner_group_pipelined_updates(shutdown_only):
+    """DistributedLearnerGroup(pipeline_depth>0): update_async streams
+    donated updates through the step pipeline with zero driver syncs;
+    checkpoint_weights_async lands a weight snapshot without blocking;
+    flush_updates is the iteration barrier; the model actually learns."""
+    import numpy as np
+
+    from ray_tpu.parallel import driver_sync_count
+    from ray_tpu.rllib.core.learner import DistributedLearnerGroup
+
+    def make_learner():
+        import jax.numpy as jnp
+        import optax
+        from flax import linen as nn
+
+        from ray_tpu.rllib.core.learner import JaxLearner
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(nn.relu(nn.Dense(8)(x)))
+
+        def loss_fn(params, module, batch):
+            pred = module.apply(params, batch["x"])
+            loss = jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+            return loss, {"mse": loss}
+
+        return JaxLearner(MLP(), loss_fn, optimizer=optax.sgd(0.1),
+                          example_obs=jnp.zeros((2, 4)))
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    lg = DistributedLearnerGroup(make_learner, num_hosts=1,
+                                 platform="cpu", local_device_count=1,
+                                 pipeline_depth=2, metrics_interval=1)
+    try:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.float32)
+        base_syncs = driver_sync_count()
+        first = None
+        for i in range(15):
+            m = lg.update_async({"x": x, "y": y})
+            if first is None and m is not None:
+                first = m["total_loss"]
+            if i == 7:
+                lg.checkpoint_weights_async()  # rides the pipeline
+        final = lg.flush_updates()
+        assert driver_sync_count() == base_syncs, \
+            "pipelined learner updates performed a blocking driver sync"
+        assert final is not None and "total_loss" in final
+        assert final["total_loss"] < first, \
+            f"no learning: {first} -> {final['total_loss']}"
+        # The async snapshot drained into the restore cache.
+        assert lg._last_weights is not None
+        assert lg.get_weights() is not None
+    finally:
+        lg.shutdown()

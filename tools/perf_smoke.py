@@ -1,0 +1,102 @@
+"""Fast hot-path overlap smoke (CPU, virtual devices) — tier-1 guard.
+
+Asserts the two PR 2 overlap invariants cheaply enough to run in every
+test pass, so a regression fails tier-1 instead of only showing up in the
+full bench:
+
+1. **Pipelined dispatch overlaps completion**: driving a real (tiny,
+   donated) jax step through MeshGroup.pipeline, step N+1's dispatch span
+   must start BEFORE step N's drain begins, for every steady-state N —
+   i.e. the driver never falls back to lockstep dispatch→wait→dispatch.
+2. **Zero driver syncs**: the pipelined run leaves
+   mesh_group.driver_sync_count() untouched.
+
+Run standalone (``python tools/perf_smoke.py`` prints one JSON line) or
+through tests/test_perf_smoke.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# Standalone invocation (python tools/perf_smoke.py) from any cwd.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 8
+DEPTH = 2
+
+
+def _jax_step(state, scale):
+    """Tiny donated carry update: representative shape (device-resident
+    carry, jit + donate_argnums), negligible cost on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    if "carry" not in state:
+        state["carry"] = jnp.ones((32, 32))
+        state["step_fn"] = jax.jit(
+            lambda c, s: (c * s + 0.5).mean(keepdims=True) + c,
+            donate_argnums=(0,))
+    state["carry"] = state["step_fn"](state["carry"], scale)
+    return {"mean": float(state["carry"].mean())}
+
+
+def run_smoke(steps: int = STEPS, depth: int = DEPTH) -> dict:
+    import ray_tpu
+    from ray_tpu._private import profiling
+    from ray_tpu.parallel import MeshGroup, mesh_group
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    mg = MeshGroup(num_hosts=1, platform="cpu", local_device_count=1,
+                   pipeline_depth=depth)
+    try:
+        profiling.clear_recorded_spans()
+        syncs_before = mesh_group.driver_sync_count()
+        with mg.pipeline(depth=depth, metrics_interval=1) as pipe:
+            for _ in range(steps):
+                pipe.submit(_jax_step, 1.0)
+            results = pipe.flush()
+        syncs = mesh_group.driver_sync_count() - syncs_before
+
+        dispatch = {s["args"]["step"]: s
+                    for s in profiling.recorded_spans("pipeline_dispatch")}
+        drain = {s["args"]["step"]: s
+                 for s in profiling.recorded_spans("pipeline_drain")}
+        # The invariant: step N+1 is dispatched before step N's result is
+        # fetched (the drain of the tail after the last submit is exempt —
+        # there is nothing left to dispatch ahead of it).
+        violations = [
+            n for n in range(steps - depth)
+            if not (n + 1 in dispatch and
+                    dispatch[n + 1]["start"] < drain[n]["start"])
+        ]
+        out = {
+            "steps": steps,
+            "depth": depth,
+            "results_ok": len(results) == steps,
+            "driver_syncs": syncs,
+            "overlap_violations": violations,
+            "overlap_ok": not violations,
+            "avg_dispatch_ms": round(sum(
+                (s["end"] - s["start"]) for s in dispatch.values())
+                / max(1, len(dispatch)) * 1e3, 3),
+        }
+        out["ok"] = bool(out["results_ok"] and out["overlap_ok"]
+                         and syncs == 0)
+        return out
+    finally:
+        mg.shutdown()
+        ray_tpu.shutdown()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    out = run_smoke()
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
