@@ -125,6 +125,25 @@ CONFIG \
              "Objects <= this many bytes inline in replies/directory.") \
     .declare("transfer_chunk_bytes", int, 4 * 1024 * 1024,
              "Cross-host object transfer chunk size.") \
+    .declare("transfer_pipeline_depth", int, 2,
+             "Chunks kept in flight per transfer stream (read-next-"
+             "while-sending); 0/1 disables pipelining.") \
+    .declare("segment_pool", bool, True,
+             "Recycle shm segments across puts through size-class free "
+             "lists instead of create/unlink per object.") \
+    .declare("segment_pool_bytes", int, 0,
+             "Free-list byte cap of the segment pool (0 = the store's "
+             "capacity).") \
+    .declare("segment_pool_prewarm", str, "",
+             "Comma list of SIZE:COUNT segments to pre-create and "
+             "pre-fault in the background at store startup, e.g. "
+             "'64MiB:4,8MiB:8'.") \
+    .declare("copy_threads", int, 0,
+             "Worker threads for large-buffer memcpy in pack_into "
+             "(0 = auto: min(4, cpu//2); 1 = single-threaded).") \
+    .declare("parallel_copy_min_bytes", int, 8 * 1024 * 1024,
+             "Buffers at least this large are copied by the parallel "
+             "memcpy pool.") \
     .declare("spill_enabled", bool, True,
              "Spill referenced objects to disk under memory pressure.") \
     .declare("collective_timeout_s", float, 300.0,

@@ -115,6 +115,20 @@ class OwnedStore:
             if self._nwaiters:
                 self._cond.notify_all()
 
+    def put_with_ref(self, oid: ObjectID, meta: bytes, data: bytes) -> None:
+        """put() + the first local ref in ONE lock round trip — the small-
+        put hot path (the caller constructs its ObjectRef with
+        skip_adding_local_ref and marks it owner-registered)."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                e = self._entries[oid] = _Entry()
+            e.meta, e.data = meta, data
+            e.refs += 1
+            e.state = READY  # publish AFTER the bytes (unlocked readers)
+            if self._nwaiters:
+                self._cond.notify_all()
+
     def wait_fulfilled(self, e: _Entry, timeout: Optional[float]) -> bool:
         """Block until `e` leaves PENDING.  False on timeout."""
         with self._cond:

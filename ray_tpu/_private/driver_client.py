@@ -127,7 +127,7 @@ class RemoteDriverRuntime:
                 self._registered.set()
             elif t == "store_adopt":
                 self.store.adopt(ObjectID(msg["oid"]), msg["size"],
-                                 msg["meta"])
+                                 msg["meta"], segment=msg.get("segment"))
             elif t == "store_delete":
                 self.store.delete(ObjectID(msg["oid"]))
             elif t == "shutdown":

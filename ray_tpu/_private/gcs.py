@@ -100,7 +100,7 @@ class ObjectEntry:
 
     __slots__ = (
         "object_id", "locations", "inline", "holders", "lineage_task",
-        "size", "meta", "spilled_path", "lost",
+        "size", "meta", "spilled_path", "lost", "segment",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -115,6 +115,9 @@ class ObjectEntry:
         self.meta: Optional[bytes] = None
         self.spilled_path: Optional[str] = None
         self.lost = False
+        # Non-canonical shm segment name (pooled segments, SegmentPool);
+        # None means readers derive the name from the object id.
+        self.segment: Optional[str] = None
 
 
 class TaskEvent:
@@ -370,7 +373,8 @@ class GCS:
 
     def object_sealed(self, oid: ObjectID, node_id: NodeID, size: int,
                       lineage_task: Optional[TaskID] = None,
-                      meta: Optional[bytes] = None):
+                      meta: Optional[bytes] = None,
+                      segment: Optional[str] = None):
         with self._lock:
             e = self._entry(oid)
             e.locations.add(node_id)
@@ -378,6 +382,8 @@ class GCS:
             e.lost = False
             if meta is not None:
                 e.meta = meta
+            if segment is not None:
+                e.segment = segment
             if lineage_task is not None:
                 e.lineage_task = lineage_task
 

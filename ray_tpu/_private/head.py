@@ -498,6 +498,10 @@ class Head:
                     self.on_seal(msg)
                 elif mtype == "put_inline":
                     self.on_put_inline(msg)
+                elif mtype == "seal_batch":
+                    self.on_seal_batch(msg)
+                elif mtype == "put_inline_batch":
+                    self.on_put_inline_batch(msg)
                 elif mtype == "arena_release":
                     self.on_arena_release(msg)
                 elif mtype == "request":
@@ -806,6 +810,17 @@ class Head:
         with self._lock:
             if self.gcs.remove_reference(oid, holder):
                 self._free_object(oid)
+        reply(True)
+
+    def req_remove_ref_batch(self, payload, reply, caller):
+        """Coalesced ref drops (the worker's ref-gc drainer): one message
+        and one lock acquisition for a burst of K dropped ObjectRefs."""
+        holder = payload.get("holder") or (caller.binary() if caller else b"driver")
+        with self._lock:
+            for oid_bin in payload["oids"]:
+                oid = ObjectID(oid_bin)
+                if self.gcs.remove_reference(oid, holder):
+                    self._free_object(oid)
         reply(True)
 
     def req_job_config(self, payload, reply, caller):
@@ -1558,20 +1573,48 @@ class Head:
     # ================= objects =================
     def on_seal(self, msg: dict):
         """A worker sealed a large object directly into shm; adopt it."""
+        with self._lock:
+            self._seal_one_locked(msg)
+
+    def _seal_one_locked(self, msg: dict) -> Optional[ObjectID]:
         oid: ObjectID = ObjectID(msg["oid"])
         node_id = NodeID(msg["node_id"])
+        raylet = self.raylets.get(node_id)
+        if raylet is not None:
+            try:
+                # Adopt is a no-op when the object was created in the
+                # store directly (the driver's pooled-segment put path).
+                raylet.store.adopt(oid, msg["size"], msg["meta"],
+                                   segment=msg.get("segment"))
+            except Exception:
+                traceback.print_exc()
+                return None
+        self.gcs.object_sealed(oid, node_id, msg["size"],
+                               lineage_task=msg.get("lineage_task"),
+                               meta=msg.get("meta"),
+                               segment=msg.get("segment"))
+        self._notify_object(oid)
+        return oid
+
+    def on_seal_batch(self, msg: dict):
+        """Coalesced seal burst (put_many): adopt + register every object
+        and its submitter's holder ref under ONE lock acquisition / ONE
+        control-plane message, in submission order."""
+        holder = msg.get("holder")
         with self._lock:
-            raylet = self.raylets.get(node_id)
-            if raylet is not None:
-                try:
-                    raylet.store.adopt(oid, msg["size"], msg["meta"])
-                except Exception:
-                    traceback.print_exc()
-                    return
-            self.gcs.object_sealed(oid, node_id, msg["size"],
-                                   lineage_task=msg.get("lineage_task"),
-                                   meta=msg.get("meta"))
-            self._notify_object(oid)
+            for item in msg["items"]:
+                oid = self._seal_one_locked(item)
+                if oid is not None and holder is not None:
+                    self.gcs.add_reference(oid, holder)
+
+    def on_put_inline_batch(self, msg: dict):
+        """Coalesced inline-put burst (put_many), applied in order."""
+        with self._lock:
+            for item in msg["items"]:
+                oid = ObjectID(item["oid"])
+                self.gcs.object_inline(oid, item["meta"], item["data"],
+                                       lineage_task=item.get("lineage_task"))
+                self._notify_object(oid)
 
     def on_arena_sealed(self, msg: dict):
         """Driver wrote directly into the head raylet's native arena."""
@@ -1637,14 +1680,16 @@ class Head:
                 if hit is not None:
                     return hit
                 if entry.meta is not None:
-                    return {"kind": "store", "oid": oid, "meta": entry.meta}
+                    return {"kind": "store", "oid": oid, "meta": entry.meta,
+                            "segment": entry.segment}
             else:
                 hit = raylet.store.arena_lookup(oid)
                 if hit is not None:
                     return hit
                 meta = raylet.store.meta(oid)
                 if meta is not None:
-                    return {"kind": "store", "oid": oid, "meta": meta}
+                    return {"kind": "store", "oid": oid, "meta": meta,
+                            "segment": raylet.store.segment_of(oid)}
                 hit = raylet.store.spilled_lookup(oid)
                 if hit is not None:
                     return hit

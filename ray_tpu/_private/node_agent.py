@@ -153,7 +153,7 @@ class NodeAgent:
                 self._kill_worker(msg["worker_id"])
             elif t == "store_adopt":
                 self.store.adopt(ObjectID(msg["oid"]), msg["size"],
-                                 msg["meta"])
+                                 msg["meta"], segment=msg.get("segment"))
             elif t == "store_delete":
                 self.store.delete(ObjectID(msg["oid"]))
             elif t == "shutdown":

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from multiprocessing import resource_tracker, shared_memory
 from typing import Dict, Optional, Tuple
 
@@ -38,7 +38,20 @@ def _segment_name(object_id: ObjectID) -> str:
 
 # Names this process has already told the resource tracker to forget; a
 # second unregister makes the tracker process log KeyErrors at exit.
+# Bounded: delete() calls forget_untracked() when a segment is unlinked,
+# so long-lived drivers don't accumulate one entry per object ever seen.
 _untracked: set = set()
+
+# Segments THIS process created, keeps registered with the tracker, and
+# will unlink itself (store-created + pooled segments).  A same-process
+# attach must NOT untrack these: stripping the creator's registration
+# makes the eventual unlink() a double-unregister (KeyError spam in the
+# tracker daemon) and loses the crash-cleanup safety net.
+_process_owned: set = set()
+
+
+def note_owned(shm: shared_memory.SharedMemory):
+    _process_owned.add(shm._name)  # type: ignore[attr-defined]
 
 
 def untrack(shm: shared_memory.SharedMemory):
@@ -47,7 +60,7 @@ def untrack(shm: shared_memory.SharedMemory):
     Python 3.12 registers every SharedMemory (even attaches) with the
     tracker, which would unlink live objects when this process exits."""
     name = shm._name  # type: ignore[attr-defined]
-    if name in _untracked:
+    if name in _untracked or name in _process_owned:
         return
     try:
         resource_tracker.unregister(name, "shared_memory")
@@ -56,21 +69,223 @@ def untrack(shm: shared_memory.SharedMemory):
         pass
 
 
-def attach(object_id: ObjectID) -> shared_memory.SharedMemory:
-    """Attach to an existing sealed object's segment (any process on node)."""
-    shm = shared_memory.SharedMemory(name=_segment_name(object_id))
+def forget_untracked(shm: shared_memory.SharedMemory):
+    """The segment is gone (unlinked): drop its bookkeeping entries so
+    neither name set grows without bound in long-lived processes."""
+    name = shm._name  # type: ignore[attr-defined]
+    _untracked.discard(name)
+    _process_owned.discard(name)
+
+
+def attach(object_id: ObjectID,
+           segment: Optional[str] = None) -> shared_memory.SharedMemory:
+    """Attach to an existing sealed object's segment (any process on node).
+
+    ``segment`` overrides the canonical per-object name for objects whose
+    bytes landed in a recycled pool segment (see SegmentPool)."""
+    shm = shared_memory.SharedMemory(name=segment or _segment_name(object_id))
     untrack(shm)
     return shm
 
 
-class PlasmaObject:
-    __slots__ = ("shm", "metadata", "data_size", "sealed", "_view")
+class SegmentPool:
+    """Size-classed free lists of pre-created, pre-faulted shm segments.
 
-    def __init__(self, shm: shared_memory.SharedMemory, data_size: int):
+    The reference gets its put throughput from a pre-mapped dlmalloc arena
+    (plasma dlmalloc.cc): steady-state allocation never touches the kernel.
+    Per-object segments pay ``shm_open + ftruncate + mmap`` per put and —
+    far worse — fault in zero pages across the whole object on first
+    write, capping large-put bandwidth at roughly half of memcpy.  The
+    pool keeps that envelope with per-segment simplicity: segments are
+    recycled through power-of-two size classes instead of unlinked, so a
+    steady-state put reuses an already-mapped, already-faulted segment and
+    runs at memcpy speed.
+
+    Segments are named ``rtpu_pool_<pid>_<n>`` — readers learn the name
+    from the object's resolution (``segment`` field) instead of deriving
+    it from the object id.  Recycling follows plasma semantics: once an
+    object's refcount hits zero its memory may be reused, so holding
+    zero-copy views past the last ObjectRef is undefined (it was a
+    stale-but-valid read in the unlink-per-object design).
+    """
+
+    MIN_CLASS = 1 << 20          # segments below 1 MiB aren't worth pooling
+    MAX_CLASS = 1 << 31          # 2 GiB: larger objects get dedicated segments
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._free: Dict[int, deque] = {}
+        self.free_bytes = 0
+        self._lock = threading.Lock()
+        self._counter = 0
+        self._closed = False
+        self._prewarm_thread: Optional[threading.Thread] = None
+        self.hits = 0
+        self.misses = 0
+        self.created = 0
+
+    @classmethod
+    def class_for(cls, size: int) -> Optional[int]:
+        if size > cls.MAX_CLASS:
+            return None
+        c = cls.MIN_CLASS
+        while c < size:
+            c <<= 1
+        return c
+
+    def _new_segment(self, cls_size: int) -> shared_memory.SharedMemory:
+        with self._lock:
+            self._counter += 1
+            n = self._counter
+        shm = shared_memory.SharedMemory(
+            name=f"{_PREFIX}pool_{os.getpid()}_{n}", create=True,
+            size=cls_size)
+        note_owned(shm)
+        self.created += 1
+        return shm
+
+    def acquire(self, size: int
+                ) -> Optional[Tuple[shared_memory.SharedMemory, int]]:
+        """A segment of the right size class — recycled when one is free,
+        freshly created otherwise.  None when the size is un-poolable."""
+        cls_size = self.class_for(size)
+        if cls_size is None or self._closed:
+            return None
+        with self._lock:
+            q = self._free.get(cls_size)
+            if q:
+                self.hits += 1
+                self.free_bytes -= cls_size
+                return q.popleft(), cls_size
+            self.misses += 1
+        try:
+            return self._new_segment(cls_size), cls_size
+        except Exception:
+            return None
+
+    def release(self, shm: shared_memory.SharedMemory, cls_size: int) -> bool:
+        """Return a segment to its free list.  False when the pool is full
+        or closed — the caller unlinks the segment instead."""
+        with self._lock:
+            if self._closed or self.free_bytes + cls_size > self.max_bytes:
+                return False
+            self._free.setdefault(cls_size, deque()).append(shm)
+            self.free_bytes += cls_size
+            return True
+
+    # -- background prewarm ------------------------------------------------
+    def prewarm(self, spec: str):
+        """Pre-create and pre-fault segments per a 'SIZE:COUNT,...' spec on
+        a background thread, so the first puts of a fresh store hit the
+        pool instead of faulting zero pages on the hot path."""
+        plan = _parse_prewarm(spec)
+        if not plan:
+            return
+
+        def run():
+            for cls_size, count in plan:
+                for _ in range(count):
+                    if self._closed:
+                        return
+                    try:
+                        shm = self._new_segment(cls_size)
+                    except Exception:
+                        return
+                    _pretouch(shm.buf)
+                    if not self.release(shm, cls_size):
+                        _unlink_quiet(shm)
+                        return
+
+        self._prewarm_thread = threading.Thread(
+            target=run, name="rtpu-pool-prewarm", daemon=True)
+        self._prewarm_thread.start()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"pool_hits": self.hits, "pool_misses": self.misses,
+                    "pool_created": self.created,
+                    "pool_free_bytes": self.free_bytes,
+                    "pool_free_segments": sum(
+                        len(q) for q in self._free.values())}
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            frees, self._free = list(self._free.values()), {}
+            self.free_bytes = 0
+        for q in frees:
+            for shm in q:
+                _unlink_quiet(shm)
+
+
+def _parse_prewarm(spec: str):
+    """'64MiB:4,8MiB:8' -> [(class_size, count), ...] (bad entries skipped)."""
+    plan = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        size_s, _, count_s = part.partition(":")
+        try:
+            size = _parse_size(size_s)
+            count = int(count_s)
+        except ValueError:
+            continue
+        cls_size = SegmentPool.class_for(size)
+        if cls_size is not None and count > 0:
+            plan.append((cls_size, count))
+    return plan
+
+
+def _parse_size(s: str) -> int:
+    s = s.strip().lower()
+    for suffix, mult in (("kib", 1 << 10), ("mib", 1 << 20),
+                         ("gib", 1 << 30), ("kb", 10**3), ("mb", 10**6),
+                         ("gb", 10**9), ("k", 1 << 10), ("m", 1 << 20),
+                         ("g", 1 << 30), ("b", 1)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(s)
+
+
+def _pretouch(buf: memoryview, page: int = 4096):
+    """Fault every page in (cheap sequential writes of one byte/page)."""
+    try:
+        import numpy as np
+
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        arr[::page] = 0
+    except Exception:
+        for off in range(0, len(buf), page):
+            buf[off] = 0
+
+
+def _unlink_quiet(shm: shared_memory.SharedMemory):
+    try:
+        shm.unlink()
+    except Exception:
+        pass
+    forget_untracked(shm)
+    try:
+        shm.close()
+    except Exception:
+        pass
+
+
+class PlasmaObject:
+    __slots__ = ("shm", "metadata", "data_size", "sealed", "_view",
+                 "pool_class")
+
+    def __init__(self, shm: shared_memory.SharedMemory, data_size: int,
+                 pool_class: Optional[int] = None):
         self.shm = shm
         self.metadata: bytes = b""
         self.data_size = data_size
         self.sealed = False
+        # Size class of the pooled segment backing this object (None for
+        # dedicated per-object segments) — delete() recycles rather than
+        # unlinks when set.
+        self.pool_class = pool_class
         # ONE canonical zero-copy view per object, handed to every writer
         # (create) and reader (get).  Readers slice it for chunked sends —
         # slices borrow the underlying mmap, not this view, so the store
@@ -84,18 +299,21 @@ class PlasmaObject:
                           else memoryview(b""))
         return self._view
 
-    def release_view(self) -> None:
+    def release_view(self) -> bool:
         """Deterministic reclaim of the exported view (delete/shutdown
         path).  Any reader still holding the canonical view sees a
         released memoryview (ValueError on access) instead of silently
-        leaking the whole segment mapping."""
+        leaking the whole segment mapping.  Returns False when a C-level
+        buffer export is still live (the segment must NOT be recycled —
+        the exporter would read freshly-written bytes)."""
         v, self._view = self._view, None
         if v is not None:
             try:
                 v.release()
             except BufferError:
-                pass  # a C-level buffer export is live; close() will leak
-                # this one segment rather than crash the reader
+                return False  # a C-level buffer export is live; close()
+                # will leak this one segment rather than crash the reader
+        return True
 
 
 class SharedMemoryStore:
@@ -146,29 +364,70 @@ class SharedMemoryStore:
                         "rtpu_arena_" + os.urandom(6).hex(), capacity_bytes)
             except Exception:
                 self.arena = None
+        # Segment pool: steady-state large puts reuse pre-faulted recycled
+        # segments instead of paying shm_open + kernel page-zeroing per
+        # object (see SegmentPool).  Free-list bytes are NOT charged to
+        # `used` — like plasma's arena, pooled memory is store overhead.
+        self.pool: Optional[SegmentPool] = None
+        if CONFIG.segment_pool:
+            pool_cap = CONFIG.segment_pool_bytes or capacity_bytes
+            self.pool = SegmentPool(pool_cap)
+            spec = CONFIG.segment_pool_prewarm
+            if spec:
+                self.pool.prewarm(spec)
 
     # -- create/seal ------------------------------------------------------
-    def create(self, object_id: ObjectID, data_size: int) -> memoryview:
+    def create(self, object_id: ObjectID, data_size: int,
+               overcommit: bool = False) -> memoryview:
+        """Allocate a writable segment for a new object.
+
+        ``overcommit=True`` keeps the zero-round-trip in-process put path
+        lossless under pressure: after eviction/spill the create proceeds
+        even above capacity (the same contract adopt() gives worker-
+        written segments) instead of raising."""
         with self._lock:
             if object_id in self._objects:
                 raise ObjectExistsError(object_id)
-            if data_size > self.capacity:
+            if data_size > self.capacity and not overcommit:
                 raise OutOfMemoryError(
                     f"object of {data_size} bytes exceeds store capacity {self.capacity}"
                 )
             self._evict_until(data_size)
             if self.used + data_size > self.capacity:
-                raise OutOfMemoryError(
-                    f"store full: need {data_size}, "
-                    f"free {self.capacity - self.used} of {self.capacity}"
-                )
-            shm = shared_memory.SharedMemory(
-                name=_segment_name(object_id), create=True, size=max(1, data_size)
-            )
-            obj = PlasmaObject(shm, data_size)
+                if not overcommit:
+                    raise OutOfMemoryError(
+                        f"store full: need {data_size}, "
+                        f"free {self.capacity - self.used} of {self.capacity}"
+                    )
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "object store over capacity: %d + %d > %d",
+                    self.used, data_size, self.capacity)
+            pool_class = None
+            shm = None
+            if self.pool is not None and data_size >= SegmentPool.MIN_CLASS:
+                acq = self.pool.acquire(data_size)
+                if acq is not None:
+                    shm, pool_class = acq
+            if shm is None:
+                shm = shared_memory.SharedMemory(
+                    name=_segment_name(object_id), create=True,
+                    size=max(1, data_size))
+                note_owned(shm)
+            obj = PlasmaObject(shm, data_size, pool_class=pool_class)
             self._objects[object_id] = obj
             self.used += data_size
             return obj.view()
+
+    def segment_of(self, object_id: ObjectID) -> Optional[str]:
+        """Segment name when it differs from the canonical per-object name
+        (pooled segments); None means readers derive it from the id."""
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is None or obj.pool_class is None:
+                return None
+            return obj.shm.name
 
     def seal(self, object_id: ObjectID, metadata: bytes = b""):
         with self._lock:
@@ -219,7 +478,8 @@ class SharedMemoryStore:
             else:
                 self._pinned[object_id] = n
 
-    def adopt(self, object_id: ObjectID, data_size: int, metadata: bytes):
+    def adopt(self, object_id: ObjectID, data_size: int, metadata: bytes,
+              segment: Optional[str] = None):
         """Adopt a segment created (and already written) by a worker process.
 
         Workers create+write the segment directly — zero round-trips, like
@@ -229,23 +489,27 @@ class SharedMemoryStore:
             if object_id in self._objects:
                 return
             self._evict_until(data_size)
-            if self.used + data_size > self.capacity:
-                # The segment already exists (worker wrote it); adopting keeps
-                # the data reachable but flags the overflow — the reference
-                # instead backpressures at create time
-                # (plasma create_request_queue.h); that needs a create RPC,
-                # which trades away the zero-round-trip write path.
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "object store over capacity: %d + %d > %d",
-                    self.used, data_size, self.capacity)
-            shm = attach(object_id)
+            shm = attach(object_id, segment)
             obj = PlasmaObject(shm, data_size)
             obj.metadata = metadata
             obj.sealed = True
             self._objects[object_id] = obj
             self.used += data_size
+            if self.used > self.capacity:
+                # The segment already exists (worker wrote it), so the
+                # overflow is a fact; shed OTHER objects (evict or spill)
+                # until the store is back under capacity instead of only
+                # logging — the reference instead backpressures at create
+                # time (plasma create_request_queue.h), which needs a
+                # create RPC and trades away the zero-round-trip write.
+                self._evict_until(0, exclude=object_id)
+                if self.used > self.capacity:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "object store over capacity after adopt: %d > %d "
+                        "(remaining objects pinned or unsealed)",
+                        self.used, self.capacity)
 
     def delete(self, object_id: ObjectID, evicted: bool = False,
                keep_spilled: bool = False):
@@ -258,6 +522,7 @@ class SharedMemoryStore:
             was_pinned = self._pinned.pop(object_id, None) is not None
             if obj is not None:
                 self.used -= obj.data_size
+                view_clean = False
                 if not was_pinned:
                     # Reclaim the canonical exported view BEFORE close():
                     # without this every object ever read leaves an
@@ -265,25 +530,35 @@ class SharedMemoryStore:
                     # spam in the bench tail).  Pinned objects are being
                     # actively chunk-read; leave their view to the leak-
                     # tolerant path below rather than yank it mid-send.
-                    obj.release_view()
-                try:
-                    obj.shm.unlink()
-                except Exception:
+                    view_clean = obj.release_view()
+                if (obj.pool_class is not None and view_clean
+                        and self.pool is not None
+                        and self.pool.release(obj.shm, obj.pool_class)):
+                    # Recycled: the mapped, faulted segment goes back to
+                    # its size-class free list for the next put.  Pinned
+                    # or export-leaking segments are never recycled — an
+                    # active reader must see stale bytes, not new ones.
                     pass
-                try:
-                    obj.shm.close()
-                except BufferError:
-                    pass  # a reader's transient chunk slice still borrows
-                    # the mapping; it dies with the reader
-                except Exception:
-                    pass
+                else:
+                    try:
+                        obj.shm.unlink()
+                    except Exception:
+                        pass
+                    forget_untracked(obj.shm)
+                    try:
+                        obj.shm.close()
+                    except BufferError:
+                        pass  # a reader's transient chunk slice still
+                        # borrows the mapping; it dies with the reader
+                    except Exception:
+                        pass
                 if evicted and self.evict_callback is not None:
                     try:
                         self.evict_callback(object_id)
                     except Exception:
                         pass
 
-    def _evict_until(self, needed: int):
+    def _evict_until(self, needed: int, exclude: Optional[ObjectID] = None):
         # Evict unpinned sealed objects, least recently used first; objects
         # the policy says must survive are spilled to disk instead of
         # dropped (plasma eviction_policy.h + local_object_manager.h:41).
@@ -292,7 +567,7 @@ class SharedMemoryStore:
         for oid in list(self._objects.keys()):
             if self.used + needed <= self.capacity:
                 break
-            if oid in self._pinned:
+            if oid == exclude or oid in self._pinned:
                 continue
             if not self._objects[oid].sealed:
                 continue
@@ -374,15 +649,20 @@ class SharedMemoryStore:
             if self.arena is not None:
                 self.arena.close()
                 self.arena = None
+            if self.pool is not None:
+                self.pool.close()
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            return {
+            out = {
                 "num_objects": len(self._objects),
                 "used_bytes": self.used,
                 "capacity_bytes": self.capacity,
                 "num_pinned": len(self._pinned),
             }
+            if self.pool is not None:
+                out.update(self.pool.stats())
+            return out
 
 
 class ObjectExistsError(Exception):

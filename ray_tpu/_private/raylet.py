@@ -344,10 +344,15 @@ class RemoteStoreProxy:
         # head hand same-host callers a direct spill-file resolution.
         self._spilled: Dict = {}
 
-    def adopt(self, object_id, data_size: int, metadata: bytes):
+    def adopt(self, object_id, data_size: int, metadata: bytes,
+              segment=None):
         self._raylet.send_agent({"type": "store_adopt",
                                  "oid": object_id.binary(),
-                                 "size": data_size, "meta": metadata})
+                                 "size": data_size, "meta": metadata,
+                                 "segment": segment})
+
+    def segment_of(self, object_id):
+        return None
 
     def delete(self, object_id, evicted: bool = False):
         self._spilled.pop(object_id, None)

@@ -31,6 +31,73 @@ INLINE_ARRAY_THRESHOLD = 1024
 _SCALAR_FAST_TYPES = (type(None), bool, int, float, str, bytes)
 
 
+# ---------------------------------------------------------------------------
+# Parallel memcpy (pack_into hot path)
+# ---------------------------------------------------------------------------
+# numpy's assignment into a uint8 view is a real memcpy that RELEASES the
+# GIL, so a small worker pool copies disjoint chunks of one large buffer
+# concurrently and scales with memory bandwidth instead of one core.  The
+# pool is process-global, lazily built, and sized by CONFIG.copy_threads
+# (0 = auto).  Buffers below CONFIG.parallel_copy_min_bytes — and every
+# copy when the pool resolves to a single thread — take the plain
+# single-call path.
+_copy_pool = None
+_copy_pool_lock = threading.Lock()
+_copy_threads = 0
+
+
+def _get_copy_pool():
+    global _copy_pool, _copy_threads
+    if _copy_threads:
+        return _copy_pool
+    with _copy_pool_lock:
+        if _copy_threads:
+            return _copy_pool
+        import os
+
+        from ray_tpu._private.config import CONFIG
+
+        n = CONFIG.copy_threads
+        if n <= 0:
+            n = min(4, max(1, (os.cpu_count() or 2) // 2))
+        if n > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            try:
+                _copy_pool = ThreadPoolExecutor(
+                    max_workers=n - 1, thread_name_prefix="rtpu-memcpy")
+            except Exception:
+                _copy_pool, n = None, 1
+        _copy_threads = n
+        return _copy_pool
+
+
+def _memcpy(dst: memoryview, src: memoryview) -> None:
+    """Copy src -> dst (equal-length byte views), in parallel chunks when
+    the buffer is large enough and the copy pool has workers."""
+    n = src.nbytes
+    dst_a = np.frombuffer(dst, np.uint8)
+    src_a = np.frombuffer(src, np.uint8)
+    from ray_tpu._private.config import CONFIG
+
+    pool = _get_copy_pool() if n >= CONFIG.parallel_copy_min_bytes else None
+    if pool is None:
+        dst_a[:] = src_a
+        return
+    nthreads = _copy_threads
+    # 64-byte-aligned chunk bounds keep every slice cache-line disjoint.
+    step = -(-n // nthreads + 63) & ~63
+    futs = [pool.submit(_copy_chunk, dst_a, src_a, off, min(off + step, n))
+            for off in range(step, n, step)]
+    dst_a[:min(step, n)] = src_a[:min(step, n)]  # chunk 0 on this thread
+    for f in futs:
+        f.result()
+
+
+def _copy_chunk(dst_a, src_a, lo: int, hi: int) -> None:
+    dst_a[lo:hi] = src_a[lo:hi]
+
+
 class _RefSerializationContext(threading.local):
     """Collects ObjectRefs seen while (de)serializing a value, so the caller
     can register borrows / contained-ids (reference: contained object ids in
@@ -153,9 +220,7 @@ def pack(serialized: SerializedObject) -> Tuple[bytes, bytes]:
     if not serialized.buffers:
         # No out-of-band buffers: the data IS the in-band pickle (readers
         # slice data[:inband_len]; padding only matters for buffer align).
-        meta = pickle.dumps({"inband_len": len(serialized.inband),
-                             "buffers": ()})
-        return meta, serialized.inband
+        return _bufferless_meta(len(serialized.inband)), serialized.inband
     offsets = []
     pos = _align(len(serialized.inband))
     for b in serialized.buffers:
@@ -190,13 +255,17 @@ def pack_into(serialized: SerializedObject, dest: memoryview) -> bytes:
         n = memoryview(b).cast("B").nbytes
         offsets.append((pos, n))
         pos = _align(pos + n)
-    meta = pickle.dumps({"inband_len": len(serialized.inband), "buffers": offsets})
+    if offsets:
+        meta = pickle.dumps({"inband_len": len(serialized.inband),
+                             "buffers": offsets})
+    else:
+        meta = _bufferless_meta(len(serialized.inband))
     dest[: len(serialized.inband)] = serialized.inband
     for b, (off, n) in zip(serialized.buffers, offsets):
-        # numpy's copy is a real memcpy; CPython's memoryview slice
-        # assignment takes a bytewise path ~4x slower on large buffers.
-        np.frombuffer(dest[off:off + n], np.uint8)[:] = np.frombuffer(
-            memoryview(b).cast("B"), np.uint8)
+        # numpy memcpy (CPython's memoryview slice assignment takes a
+        # bytewise path ~4x slower), split across the copy-thread pool
+        # for large buffers — see _memcpy.
+        _memcpy(dest[off:off + n], memoryview(b).cast("B"))
     return meta
 
 
@@ -214,6 +283,21 @@ def num_oob_buffers(meta: bytes) -> int:
     """Number of out-of-band buffers recorded in an object's metadata —
     i.e. whether deserializing it yields zero-copy views over the store."""
     return len(pickle.loads(meta)["buffers"])
+
+
+# Bufferless-object metadata depends only on inband length; small puts
+# (ints, short strings) mint one per call otherwise — a measurable slice
+# of the sub-100KB put path.  Bounded dict, hot lengths stabilize fast.
+_bufferless_meta_cache: dict = {}
+
+
+def _bufferless_meta(inband_len: int) -> bytes:
+    meta = _bufferless_meta_cache.get(inband_len)
+    if meta is None:
+        meta = pickle.dumps({"inband_len": inband_len, "buffers": ()})
+        if len(_bufferless_meta_cache) < 4096:
+            _bufferless_meta_cache[inband_len] = meta
+    return meta
 
 
 def _align(n: int, a: int = 64) -> int:

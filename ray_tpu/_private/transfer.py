@@ -22,6 +22,7 @@ reads under a pin so eviction can never recycle a slot mid-stream.
 """
 from __future__ import annotations
 
+import queue
 import socket
 import threading
 import traceback
@@ -32,9 +33,27 @@ from ray_tpu._private.ids import ObjectID
 
 CHUNK = 4 * 1024 * 1024
 
+_routable_ip_cache: Optional[str] = None
+_routable_ip_lock = threading.Lock()
+
 
 def routable_ip() -> str:
-    """Best-effort externally-routable IP of this host."""
+    """Best-effort externally-routable IP of this host.
+
+    Cached after the first call: the probe opens a UDP socket and does two
+    syscalls, and callers hit this once per transfer connection — a host's
+    routable address does not change within a process's lifetime."""
+    global _routable_ip_cache
+    ip = _routable_ip_cache
+    if ip is not None:
+        return ip
+    with _routable_ip_lock:
+        if _routable_ip_cache is None:
+            _routable_ip_cache = _probe_routable_ip()
+        return _routable_ip_cache
+
+
+def _probe_routable_ip() -> str:
     try:
         u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         u.connect(("8.8.8.8", 80))
@@ -46,6 +65,24 @@ def routable_ip() -> str:
             return socket.gethostbyname(socket.gethostname())
         except OSError:
             return "127.0.0.1"
+
+
+def _chunk_size() -> int:
+    try:
+        from ray_tpu._private.config import CONFIG
+
+        return int(CONFIG.transfer_chunk_bytes) or CHUNK
+    except Exception:
+        return CHUNK
+
+
+def _pipeline_depth() -> int:
+    try:
+        from ray_tpu._private.config import CONFIG
+
+        return max(0, int(CONFIG.transfer_pipeline_depth))
+    except Exception:
+        return 2
 
 
 def wire_store_reporting(store, send) -> None:
@@ -132,20 +169,73 @@ class ObjectTransferServer:
                 conn.send({"ok": False,
                            "error": f"object {oid} not in this store"})
                 return
-            meta, data = got
-            size = len(data)
+            meta, size, chunks = got
             conn.send({"ok": True, "meta": bytes(meta), "size": size})
-            for off in range(0, size, CHUNK):
-                conn.send_bytes(data[off:off + CHUNK])
+            chunk = _chunk_size()
+            depth = _pipeline_depth()
             if size == 0:
                 conn.send_bytes(b"")
+                return
+            if depth >= 2 and size > chunk:
+                # Pipelined: a producer thread reads/slices chunk N+1..N+d
+                # while this thread's send_bytes(chunk N) blocks on the
+                # socket, so disk reads (spilled objects) and socket
+                # writes overlap instead of strictly alternating.
+                self._send_pipelined(conn, chunks, depth)
+            else:
+                for piece in chunks:
+                    conn.send_bytes(piece)
         finally:
             self.store.unpin(oid)
 
-    def _read(self, oid: ObjectID) -> Optional[Tuple[bytes, memoryview]]:
+    @staticmethod
+    def _send_pipelined(conn, chunks, depth: int):
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, depth - 1))
+        stop = threading.Event()
+        _END = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for piece in chunks:
+                    if not put(piece):
+                        return  # consumer bailed (socket error): closing
+                        # the generator runs its finally (file close)
+                put(_END)
+            except BaseException as e:  # noqa: BLE001 — forwarded to sender
+                put(e)
+
+        t = threading.Thread(target=produce, name="rtpu-xfer-read",
+                             daemon=True)
+        t.start()
+        try:
+            while True:
+                piece = q.get()
+                if piece is _END:
+                    return
+                if isinstance(piece, BaseException):
+                    raise piece
+                conn.send_bytes(piece)
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+    def _read(self, oid: ObjectID):
+        """Resolve an object to (meta, size, chunk_iterable); None if the
+        store has no trace of it."""
+        chunk = _chunk_size()
         got = self.store.get(oid)
         if got is not None:
-            return got
+            meta, data = got
+            return meta, len(data), _view_chunks(data, chunk)
         # Arena-resident object (owner-process put): copy out under the
         # store lock — an arena slot can be recycled by a concurrent
         # delete, and unlike shm segments the mapping gives no lifetime
@@ -160,15 +250,19 @@ class ObjectTransferServer:
 
                 view = ArenaReader.view(hit["store"], hit["offset"],
                                         hit["size"], hit["capacity"])
-                return hit["meta"], memoryview(bytes(view))
-        # Spilled-to-disk fallback: serve the bytes from the spill file
-        # (reference: spilled_object_reader.h).
-        spilled = getattr(self.store, "read_spilled", None)
-        if spilled is not None:
-            got = spilled(oid)
-            if got is not None:
-                meta, data = got
-                return meta, memoryview(data)
+                data = memoryview(bytes(view))
+                return hit["meta"], len(data), _view_chunks(data, chunk)
+        # Spilled-to-disk fallback: stream straight off the spill file
+        # (reference: spilled_object_reader.h) — chunked reads feed the
+        # pipelined sender, so the whole object is never buffered here.
+        lookup = getattr(self.store, "spilled_lookup", None)
+        rec = lookup(oid) if lookup is not None else None
+        if rec is not None:
+            try:
+                f = open(rec["path"], "rb")
+            except OSError:
+                return None
+            return rec["meta"], rec["size"], _file_chunks(f, chunk)
         return None
 
     def shutdown(self):
@@ -177,6 +271,22 @@ class ObjectTransferServer:
             self._listener.close()
         except Exception:
             pass
+
+
+def _view_chunks(data: memoryview, chunk: int):
+    for off in range(0, len(data), chunk):
+        yield data[off:off + chunk]
+
+
+def _file_chunks(f, chunk: int):
+    try:
+        while True:
+            piece = f.read(chunk)
+            if not piece:
+                return
+            yield piece
+    finally:
+        f.close()
 
 
 class TransferClient:

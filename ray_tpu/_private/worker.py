@@ -84,6 +84,10 @@ class DirectTransport:
             self.head.on_seal(msg)
         elif t == "put_inline":
             self.head.on_put_inline(msg)
+        elif t == "seal_batch":
+            self.head.on_seal_batch(msg)
+        elif t == "put_inline_batch":
+            self.head.on_put_inline_batch(msg)
         elif t == "task_done":
             self.head.on_task_done(msg)
         elif t == "arena_sealed":
@@ -91,13 +95,12 @@ class DirectTransport:
         elif t == "arena_release":
             self.head.on_arena_release(msg)
 
-    def arena_store_for(self, node_id):
+    def store_for(self, node_id):
         """In-process fast path: the driver writes straight into the head
-        raylet's native arena (zero IPC)."""
+        raylet's store — the native arena when present, pooled shm
+        segments otherwise (zero IPC either way)."""
         raylet = self.head.raylets.get(node_id)
-        if raylet is not None and raylet.store.arena is not None:
-            return raylet.store
-        return None
+        return raylet.store if raylet is not None else None
 
     def close(self):
         pass
@@ -334,6 +337,11 @@ def _arena_lease_releaser(transport, oid_bin: bytes, holder_bin: bytes):
     return release
 
 
+# Sentinel: _put_object_deferred consumed the put AND its first local ref
+# (owner-resident fast path) — no notify, no ObjectRef-side add_ref.
+_OWNED_WITH_REF = {"type": "_owned_with_ref"}
+
+
 # ---------------------------------------------------------------------------
 # CoreWorker
 # ---------------------------------------------------------------------------
@@ -505,15 +513,38 @@ class CoreWorker:
             self._ref_gc_wake.set()
 
     def _drain_ref_gc_queue(self):
+        # Head-side removals are coalesced: a burst of K dropped refs
+        # costs one remove_ref_batch message instead of K remove_refs
+        # (owner/borrow removals stay per-ref — they are local or ride
+        # dedicated owner channels).
+        batch: List[bytes] = []
         while self._ref_gc_queue:
             try:
                 oid, owner_addr = self._ref_gc_queue.popleft()
             except IndexError:
                 break
             try:
-                self.remove_local_ref(oid, owner_addr)
+                self.remove_local_ref(oid, owner_addr, head_batch=batch)
             except Exception:
                 pass
+            if len(batch) >= 4096:
+                self._send_remove_ref_batch(batch)
+                batch = []
+        if batch:
+            self._send_remove_ref_batch(batch)
+
+    def _send_remove_ref_batch(self, oids: List[bytes]):
+        try:
+            if len(oids) == 1:
+                self.transport.request_oneway(
+                    "remove_ref", {"oid": ObjectID(oids[0]),
+                                   "holder": self.worker_id.binary()})
+            else:
+                self.transport.request_oneway(
+                    "remove_ref_batch",
+                    {"oids": oids, "holder": self.worker_id.binary()})
+        except Exception:
+            pass
 
     def _ref_gc_loop(self):
         while not self._closed:
@@ -527,9 +558,25 @@ class CoreWorker:
                 _time.sleep(0.002)
             self._drain_ref_gc_queue()
 
-    def remove_local_ref(self, oid: ObjectID, owner_addr: Optional[dict] = None):
+    def remove_local_ref(self, oid: ObjectID, owner_addr: Optional[dict] = None,
+                         head_batch: Optional[List[bytes]] = None):
+        """Drop one local ref.  When ``head_batch`` is given, head-side
+        removals are appended to it instead of sent (the ref-gc drainer
+        flushes them as one remove_ref_batch)."""
         if self._closed:
             return
+
+        def head_remove():
+            if head_batch is not None:
+                head_batch.append(oid.binary())
+                return
+            try:
+                self.transport.request_oneway(
+                    "remove_ref",
+                    {"oid": oid, "holder": self.worker_id.binary()})
+            except Exception:
+                pass
+
         from ray_tpu._private.direct import EXTERN
 
         r = self._owned.remove_ref(oid)
@@ -540,12 +587,7 @@ class CoreWorker:
                 self._shm_registry.pop(oid, None)
                 if state == EXTERN:
                     # Drop the mirrored holder in the head directory.
-                    try:
-                        self.transport.request_oneway(
-                            "remove_ref",
-                            {"oid": oid, "holder": self.worker_id.binary()})
-                    except Exception:
-                        pass
+                    head_remove()
             return
         with self._refs_lock:
             rec = self._borrowed.get(oid)
@@ -577,12 +619,7 @@ class CoreWorker:
         if last:
             self._value_cache.pop(oid, None)
             self._shm_registry.pop(oid, None)
-            try:
-                self.transport.request_oneway(
-                    "remove_ref",
-                    {"oid": oid, "holder": self.worker_id.binary()})
-            except Exception:
-                pass
+            head_remove()
 
     # ---- put ----
     def current_task_id(self) -> TaskID:
@@ -600,11 +637,93 @@ class CoreWorker:
             self.ctx.put_counter += 1
             put_index = self.ctx.put_counter
         oid = ObjectID.for_put(self.current_task_id(), put_index)
-        self.put_object(oid, value)
+        msg = self._put_object_deferred(oid, value, with_ref=True)
+        if msg is _OWNED_WITH_REF:
+            r = ObjectRef(oid, skip_adding_local_ref=True)
+            r._owner_registered = True
+            return r
+        if msg is not None:
+            self.transport.notify(msg)
         return ObjectRef(oid)
+
+    def _next_put_id(self) -> ObjectID:
+        if self.ctx.task_id is None:
+            put_index = next(self._put_counter)
+        else:
+            self.ctx.put_counter += 1
+            put_index = self.ctx.put_counter
+        return ObjectID.for_put(self.current_task_id(), put_index)
+
+    def put_many(self, values: Sequence[Any]) -> List[ObjectRef]:
+        """Put a burst of K objects with O(1) control-plane messages.
+
+        Bytes move exactly as in put() (owner store / arena / pooled shm
+        segments), but the per-object ``seal``/``put_inline`` notifies are
+        coalesced into one ``seal_batch``/``put_inline_batch`` message, and
+        the head registers this process as holder of every store-resident
+        object in the same message — so a K-put burst costs at most two
+        head messages instead of up to 2K.  Item order inside each batch
+        is submission order (the head applies them in order under one
+        lock)."""
+        plan: List[Tuple[ObjectID, str]] = []
+        inline_items: List[dict] = []
+        seal_items: List[dict] = []
+        for value in values:
+            oid = self._next_put_id()
+            msg = self._put_object_deferred(oid, value, with_ref=True)
+            if msg is _OWNED_WITH_REF:
+                plan.append((oid, "seal"))  # ref pre-taken, like seal
+                continue
+            if msg is None:
+                plan.append((oid, "owned"))
+                continue
+            t = msg.pop("type")
+            if t == "put_inline":
+                inline_items.append(msg)
+                plan.append((oid, "inline"))
+            elif t == "seal":
+                # Holder rides the batch: pre-register the local ref and
+                # let the head's batch handler record it, instead of one
+                # add_ref message per object.
+                seal_items.append(msg)
+                with self._refs_lock:
+                    self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+                plan.append((oid, "seal"))
+            else:  # arena_sealed — rare; keep its dedicated handler
+                msg["type"] = t
+                self.transport.notify(msg)
+                plan.append((oid, "inline"))
+        if inline_items:
+            self.transport.notify({"type": "put_inline_batch",
+                                   "items": inline_items})
+        if seal_items:
+            self.transport.notify({"type": "seal_batch",
+                                   "items": seal_items,
+                                   "holder": self.worker_id.binary()})
+        refs: List[ObjectRef] = []
+        for oid, kind in plan:
+            if kind == "seal":
+                r = ObjectRef(oid, skip_adding_local_ref=True)
+                r._owner_registered = True
+                refs.append(r)
+            else:
+                refs.append(ObjectRef(oid))
+        return refs
 
     def put_object(self, oid: ObjectID, value: Any,
                    lineage_task: Optional[TaskID] = None):
+        msg = self._put_object_deferred(oid, value, lineage_task)
+        if msg is not None and msg is not _OWNED_WITH_REF:
+            self.transport.notify(msg)
+
+    def _put_object_deferred(self, oid: ObjectID, value: Any,
+                             lineage_task: Optional[TaskID] = None,
+                             with_ref: bool = False) -> Optional[dict]:
+        """Write the object's bytes; return the control-plane notify (or
+        None when no head message is needed) so callers batching a burst
+        of puts (put_many) can coalesce K notifies into one.  With
+        ``with_ref`` an owner-resident put also takes the first local ref
+        inside the same store lock (returns _OWNED_WITH_REF)."""
         s = ser.serialize(value)
         size = ser.packed_size(s)
         if size <= INLINE_OBJECT_THRESHOLD:
@@ -613,33 +732,52 @@ class CoreWorker:
                 # Owner-resident put: zero head traffic (reference: puts
                 # land in the owner's in-process store, memory_store.h:43;
                 # other processes fetch from the owner).
+                if with_ref:
+                    self._owned.put_with_ref(oid, meta, data)
+                    self._cache_value(oid, value)
+                    return _OWNED_WITH_REF
                 self._owned.put(oid, meta, data)
                 self._cache_value(oid, value)
-                return
-            self.transport.notify({"type": "put_inline", "oid": oid.binary(),
-                                   "meta": meta, "data": data,
-                                   "lineage_task": lineage_task})
-        else:
-            store = getattr(self.transport, "arena_store_for",
-                            lambda n: None)(self.node_id)
-            view = store.arena_write(oid, size) if store is not None else None
+                return None
+            self._cache_value(oid, value)
+            return {"type": "put_inline", "oid": oid.binary(),
+                    "meta": meta, "data": data,
+                    "lineage_task": lineage_task}
+        store = getattr(self.transport, "store_for",
+                        lambda n: None)(self.node_id)
+        if store is not None:
+            view = store.arena_write(oid, size)
             if view is not None:
                 try:
                     meta = ser.pack_into(s, view)
                 finally:
                     view.release()
                 store.arena_seal(oid, meta)
-                self.transport.notify({
-                    "type": "arena_sealed", "oid": oid.binary(),
+                self._cache_value(oid, value)
+                return {"type": "arena_sealed", "oid": oid.binary(),
+                        "node_id": self.node_id.binary(), "size": size,
+                        "lineage_task": lineage_task}
+            # In-process pooled path: allocate from the node store (a
+            # recycled, already-faulted pool segment in steady state —
+            # no shm_open, no kernel page-zeroing), pack straight in.
+            buf = store.create(oid, size, overcommit=True)
+            try:
+                meta = ser.pack_into(s, buf)
+                store.seal(oid, meta)
+            except BaseException:
+                store.delete(oid)
+                raise
+            self._cache_value(oid, value)
+            return {"type": "seal", "oid": oid.binary(),
                     "node_id": self.node_id.binary(), "size": size,
-                    "lineage_task": lineage_task})
-            else:
-                meta = self._write_to_store(oid, s, size)
-                self.transport.notify({"type": "seal", "oid": oid.binary(),
-                                       "node_id": self.node_id.binary(),
-                                       "size": size, "meta": meta,
-                                       "lineage_task": lineage_task})
+                    "meta": meta, "segment": store.segment_of(oid),
+                    "lineage_task": lineage_task}
+        meta = self._write_to_store(oid, s, size)
         self._cache_value(oid, value)
+        return {"type": "seal", "oid": oid.binary(),
+                "node_id": self.node_id.binary(),
+                "size": size, "meta": meta,
+                "lineage_task": lineage_task}
 
     def _write_to_store(self, oid: ObjectID, s: ser.SerializedObject,
                         size: int) -> bytes:
@@ -730,6 +868,49 @@ class CoreWorker:
                     except Exception:
                         pass
         return out[0] if single else out
+
+    def get_many(self, refs: Sequence[ObjectRef],
+                 timeout: Optional[float] = None) -> List[Any]:
+        """Batch get: one resolve_batch round trip covers every object
+        already available; stragglers fall back to the blocking path.
+        Semantically identical to get(list) — the name documents intent
+        at call sites that gather bursts (SampleBatch gathers, dataset
+        block fetches)."""
+        return self.get(list(refs), timeout)
+
+    def _prime_resolutions(self, oids: List[ObjectID]) -> None:
+        """One resolve_batch request materializes every already-available
+        head-resident object into the value cache, so a task with K ref
+        args costs one head round trip instead of K (stragglers keep the
+        per-object blocking path)."""
+        from ray_tpu._private.direct import EXTERN
+
+        def _head_resident(oid: ObjectID) -> bool:
+            e = self._owned.lookup(oid)
+            return e is None or e.state == EXTERN
+
+        missing = list(dict.fromkeys(
+            o for o in oids if o not in self._value_cache
+            and _head_resident(o)))
+        if len(missing) < 2:
+            return
+        try:
+            batch = self.transport.request("resolve_batch",
+                                           {"oids": missing})
+        except Exception:
+            return
+        for oid_bin, msg in (batch or {}).items():
+            oid = ObjectID(oid_bin)
+            if oid in self._value_cache:
+                if msg.get("kind") == "arena":
+                    self._release_arena_lease(oid)
+                continue
+            try:
+                self._materialize(oid, msg)
+            except Exception:
+                pass  # the per-arg path re-raises with proper context
+                # (arena failure paths inside _materialize already
+                # released their lease)
 
     def _cache_value(self, oid: ObjectID, value):
         self._value_cache[oid] = value
@@ -844,7 +1025,7 @@ class CoreWorker:
             return value
         if kind == "store":
             try:
-                shm = store_mod.attach(oid)
+                shm = store_mod.attach(oid, msg.get("segment"))
             except FileNotFoundError:
                 raise exc.ObjectLostError(f"object {oid} vanished from the store")
             value, _ = ser.unpack(msg["meta"], shm.buf)
@@ -1285,6 +1466,14 @@ class CoreWorker:
             if spec.args or spec.kwargs:
                 self.ctx.arg_resolve = True
                 try:
+                    ref_oids = [a.ref for a in
+                                list(spec.args) + list(spec.kwargs.values())
+                                if a.kind == ArgKind.REF]
+                    if len(ref_oids) > 1:
+                        # Coalesced resolution: one head round trip for
+                        # every already-available ref arg instead of one
+                        # get_locations per arg.
+                        self._prime_resolutions(ref_oids)
                     args = [self._resolve_arg(a) for a in spec.args]
                     kwargs = {k: self._resolve_arg(a)
                               for k, a in spec.kwargs.items()}

@@ -180,9 +180,15 @@ class DistributedLearnerGroup:
         re-build the learner on every rank, then re-broadcast the last
         known weights so the update that triggered the restart retries
         against the pre-failure policy."""
+        import ray_tpu
+
         group.run_stateful(_build_learner, self._factory)
         if self._last_weights is not None:
-            group.run_stateful(_learner_set_weights, self._last_weights)
+            # One put, num_hosts borrowers: each rank resolves the same
+            # store object zero-copy instead of the submit path
+            # serializing the weights once per host.
+            group.run_stateful(_learner_set_weights,
+                               ray_tpu.put(self._last_weights))
 
     def checkpoint_weights(self):
         """Pull rank-0 weights into the driver-side cache used to restore
@@ -258,12 +264,16 @@ class DistributedLearnerGroup:
         return self.group.run_rank_stateful(0, _learner_get_weights)
 
     def set_weights(self, weights):
+        import ray_tpu
+
         if self._pipeline is not None:
             # run_stateful bypasses the pipeline's sequence gate: drain
             # first so the broadcast can't interleave with queued updates.
             self._pipeline.flush()
         self._last_weights = weights
-        self.group.run_stateful(_learner_set_weights, weights,
+        # Broadcast through the object plane: one serialization + one
+        # store object shared by every host (same pattern as update()).
+        self.group.run_stateful(_learner_set_weights, ray_tpu.put(weights),
                                 on_restart=self._on_restart)
 
     def shutdown(self):

@@ -260,6 +260,18 @@ class WorkerSet:
         futures = [(i, make_future(w)) for i, w in enumerate(self.workers)]
         out: List[Tuple[int, Any]] = []
         replaced: List[int] = []
+        # Fast path: one batched gather (a single resolve round trip for
+        # every store-resident result) — the per-future harvest below only
+        # runs when a worker actually failed, to attribute the failure.
+        if len(futures) > 1:
+            try:
+                values = ray_tpu.get_many([f for _, f in futures])
+                for (i, _f), v in zip(futures, values):
+                    out.append((i, v))
+                    self._failures[i] = 0
+                return out
+            except ray_tpu.exceptions.RayTpuError:
+                out = []
         for i, f in futures:
             try:
                 out.append((i, ray_tpu.get(f)))

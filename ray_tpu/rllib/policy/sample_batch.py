@@ -38,6 +38,20 @@ class SampleBatch(dict):
         return len(self)
 
     @staticmethod
+    def gather(refs: List[Any]) -> List["SampleBatch"]:
+        """Fetch a burst of SampleBatch ObjectRefs with ONE batched
+        resolve round trip (ray_tpu.get_many) instead of one head
+        request per ref — the rollout-gather hot path."""
+        import ray_tpu
+
+        return ray_tpu.get_many(refs)
+
+    @staticmethod
+    def gather_concat(refs: List[Any]) -> "SampleBatch":
+        """gather() + concat into one training batch."""
+        return SampleBatch.concat_samples(SampleBatch.gather(refs))
+
+    @staticmethod
     def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
         if not batches:
             return SampleBatch()

@@ -4,7 +4,7 @@ blocking driver↔worker syncs — so an overlap regression fails the normal
 test pass instead of only surfacing in the full bench."""
 import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 
-from tools.perf_smoke import run_smoke
+from tools.perf_smoke import run_object_plane_smoke, run_smoke
 
 
 def test_pipeline_overlap_smoke(shutdown_only):
@@ -12,4 +12,16 @@ def test_pipeline_overlap_smoke(shutdown_only):
     assert out["results_ok"], out
     assert out["driver_syncs"] == 0, out
     assert out["overlap_ok"], f"lockstep regression: {out}"
+    assert out["ok"]
+
+
+def test_object_plane_smoke(shutdown_only):
+    """Steady-state large puts must hit the segment pool (no new shm
+    segment per put) and a put_many burst must reach the head as at most
+    one coalesced notify — no timing assertions, tier-1 safe."""
+    out = run_object_plane_smoke()
+    assert out["pool_enabled"], out
+    assert out["pool_reuse_ok"], f"pool regression: {out}"
+    assert out["batching_ok"], f"notify batching regression: {out}"
+    assert out["roundtrip_ok"], out
     assert out["ok"]

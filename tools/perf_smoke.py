@@ -91,9 +91,84 @@ def run_smoke(steps: int = STEPS, depth: int = DEPTH) -> dict:
         ray_tpu.shutdown()
 
 
+def run_object_plane_smoke(cycles: int = 4, burst: int = 4) -> dict:
+    """Object-plane invariants (no timing assertions — tier-1 safe):
+
+    1. **Pool reuse**: steady-state large puts are served from recycled
+       pool segments — after a warmup put/free cycle, further puts of the
+       same size class create NO new shm segment (``pool_created`` stays
+       flat while ``pool_hits`` climbs).
+    2. **Notify batching**: a ``put_many(K)`` burst of store-resident
+       objects reaches the head as at most ONE control-plane notify
+       (``seal_batch``), not K ``seal`` messages.
+    """
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    try:
+        from ray_tpu._private.worker import global_worker as gw
+
+        store = gw.transport.head.raylets[gw.node_id].store
+        out = {"pool_enabled": store.pool is not None}
+        data = np.random.randint(0, 255, (4 * 1024 * 1024,), dtype=np.uint8)
+
+        def cycle():
+            ref = ray_tpu.put(data)
+            del ref
+            gw._drain_ref_gc_queue()  # deterministic free (no GC races)
+
+        cycle()  # warmup: the first put of this size class may create
+        created_before = store.stats().get("pool_created", -1)
+        hits_before = store.stats().get("pool_hits", 0)
+        for _ in range(cycles):
+            cycle()
+        stats = store.stats()
+        out["segments_created_steady"] = (
+            stats.get("pool_created", -1) - created_before)
+        out["pool_hits_steady"] = stats.get("pool_hits", 0) - hits_before
+        out["pool_reuse_ok"] = (out["pool_enabled"]
+                                and out["segments_created_steady"] == 0
+                                and out["pool_hits_steady"] >= cycles)
+
+        # --- notify batching ---
+        notifies = []
+        orig_notify = gw.transport.notify
+
+        def counting_notify(msg):
+            if msg.get("type") in ("seal", "put_inline", "seal_batch",
+                                   "put_inline_batch", "arena_sealed"):
+                notifies.append(msg["type"])
+            return orig_notify(msg)
+
+        gw.transport.notify = counting_notify
+        try:
+            big = [np.random.randint(0, 255, (256 * 1024,), dtype=np.uint8)
+                   for _ in range(burst)]
+            refs = ray_tpu.put_many(big)
+        finally:
+            gw.transport.notify = orig_notify
+        got = ray_tpu.get_many(refs)
+        out["burst_notifies"] = len(notifies)
+        out["notify_types"] = sorted(set(notifies))
+        out["batching_ok"] = len(notifies) <= 1
+        out["roundtrip_ok"] = all(
+            np.array_equal(a, b) for a, b in zip(big, got))
+        out["ok"] = bool(out["pool_reuse_ok"] and out["batching_ok"]
+                         and out["roundtrip_ok"])
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
+    obj = run_object_plane_smoke()
+    out["object_plane"] = obj
+    out["ok"] = bool(out["ok"] and obj["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
