@@ -149,6 +149,47 @@ def bench_put_small(ray_tpu, n=2000):
     return timed(n, run, trials=3)
 
 
+def bench_checkpoint(size=64 * MB, chunk=1 * MB):
+    """Sharded checkpoint store envelope (pure filesystem, no cluster):
+    cold save seconds/bytes for `size` of state, then an identical re-save
+    (the dedup fast path — only changed chunks pay) and a 1-chunk-mutated
+    incremental save.  Reported as checkpoint_save_seconds /
+    checkpoint_bytes_written to match the runtime metrics' names."""
+    import shutil
+    import tempfile
+
+    from ray_tpu.checkpoint import save_tree
+
+    root = tempfile.mkdtemp(prefix="rtpu_ckpt_bench_")
+    try:
+        n_arrays = 8
+        per = size // n_arrays
+        tree = {f"w{i}": np.random.randint(
+                    0, 255, per, dtype=np.uint8).reshape(-1, 1024)
+                for i in range(n_arrays)}
+        t0 = time.perf_counter()
+        cold = save_tree(root, tree, step=1, chunk_bytes=chunk)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        dedup = save_tree(root, tree, step=2, chunk_bytes=chunk)
+        dedup_s = time.perf_counter() - t0
+        tree["w0"][:chunk // 1024] += 1  # dirty exactly ~one chunk
+        t0 = time.perf_counter()
+        incr = save_tree(root, tree, step=3, chunk_bytes=chunk)
+        incr_s = time.perf_counter() - t0
+        return {
+            "checkpoint_save_seconds": cold_s,
+            "checkpoint_bytes_written": cold["bytes_written"],
+            "checkpoint_save_gb_per_s": size / cold_s / 1e9,
+            "checkpoint_dedup_save_seconds": dedup_s,
+            "checkpoint_dedup_bytes_written": dedup["bytes_written"],
+            "checkpoint_incremental_bytes_written": incr["bytes_written"],
+            "checkpoint_incremental_save_seconds": incr_s,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_put_many_small(ray_tpu, n=2000, k=100):
     """Batched small puts: put_many coalesces the control plane, so the
     per-object cost is serialization + owner-store insert only."""
@@ -182,6 +223,7 @@ def main():
             bench_put_gbps(ray_tpu)
         out["memcpy_gb_per_s"], _ = bench_memcpy_gbps()
         out["get_gb_per_s"], _ = bench_get_gbps(ray_tpu)
+        out.update(bench_checkpoint())
         out = {k: round(v, 2) for k, v in out.items()}
         out["store"] = "arena" if args.native_arena == "1" else "segments"
         # Reference envelope for eyeballing (single node, release/

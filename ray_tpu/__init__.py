@@ -348,6 +348,6 @@ def __getattr__(name):
 
     if name in ("util", "air", "train", "tune", "data", "serve", "rllib",
                 "parallel", "ops", "models", "workflow", "dag",
-                "cluster_utils", "state", "internal_kv"):
+                "cluster_utils", "state", "internal_kv", "checkpoint"):
         return importlib.import_module(f"ray_tpu.{name}")
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -206,22 +206,60 @@ def main():
     actor_q: "queue.SimpleQueue" = queue.SimpleQueue()
     pool_started = 0
 
+    def _fallback_error(cause: BaseException):
+        """Serialized stand-in error for a reply whose construction raised
+        (e.g. a result-serialization double fault)."""
+        from ray_tpu import exceptions as exc
+        from ray_tpu._private import serialization as ser
+
+        error_str = f"worker failed to build task reply: {cause!r}"
+        try:
+            err = ser.pack(ser.serialize(exc.RayTpuError(error_str)))
+        except BaseException:
+            err = None
+        return err, error_str
+
+    def _run_and_reply(spec: TaskSpec, reply_conn) -> None:
+        """Execute + reply, with the invariant that the caller ALWAYS
+        receives a completion message: a swallowed reply (a raise between
+        task completion and the send, as a result-serialization double
+        fault used to do) leaves the driver blocked on a future that can
+        never resolve, which reads as a gang hang."""
+        import time as _time
+
+        if reply_conn is None:
+            try:
+                msg = worker.execute_task(spec)
+            except BaseException as e:  # noqa: BLE001 — reply must flow
+                err, error_str = _fallback_error(e)
+                now = _time.time()
+                msg = {"type": "task_done",
+                       "task_id": spec.task_id.binary(),
+                       "worker_id": worker.worker_id.binary(),
+                       "spec": spec, "results": [], "error": err,
+                       "error_str": error_str, "crashed": False,
+                       "start": now, "end": now}
+            transport.send(msg)
+        else:
+            try:
+                done = make_done(spec)
+            except BaseException as e:  # noqa: BLE001 — reply must flow
+                err, error_str = _fallback_error(e)
+                done = {"t": "done", "task_id": spec.task_id.binary(),
+                        "results": [], "error": err,
+                        "error_str": error_str}
+            reply_q.put((reply_conn, done))
+
     def pool_worker():
         while True:
             item = actor_q.get()
             if item is None:
                 return
             spec, reply_conn = item
-            if reply_conn is None:
-                transport.send(worker.execute_task(spec))
-            else:
-                reply_q.put((reply_conn, make_done(spec)))
+            _run_and_reply(spec, reply_conn)
 
     def run_one(spec: TaskSpec, reply_conn=None):
-        if reply_conn is None:
-            transport.send(worker.execute_task(spec))
-        else:
-            reply_q.put((reply_conn, make_done(spec)))
+        _run_and_reply(spec, reply_conn)
 
     done_buf: dict = {}
 
@@ -257,8 +295,15 @@ def main():
                 flush_done_buf()  # classic task may block for a long time
             run_one(spec, None)
         else:
+            try:
+                done = make_done(spec)
+            except BaseException as e:  # noqa: BLE001 — reply must flow
+                err, error_str = _fallback_error(e)
+                done = {"t": "done", "task_id": spec.task_id.binary(),
+                        "results": [], "error": err,
+                        "error_str": error_str}
             dones = done_buf.setdefault(id(reply_conn), (reply_conn, []))[1]
-            dones.append(make_done(spec))
+            dones.append(done)
             if len(dones) >= 32 or task_queue.empty():
                 flush_done_buf()
 

@@ -130,13 +130,26 @@ ref_context = _RefSerializationContext()
 
 
 def _is_jax_array(value) -> bool:
-    # Avoid importing jax unless the process already did.
+    # Avoid importing jax unless the process already did.  sys.modules is
+    # read WITHOUT the import lock, so another thread may be mid-`import
+    # jax` right now (e.g. a train-loop thread's first jax import while an
+    # actor-pool thread serializes a result): the module object exists but
+    # `jax.Array` isn't bound yet.  No jax array can exist in the process
+    # before that first import completes, so "not there yet" simply means
+    # False — raising here used to kill the actor thread mid-reply and
+    # hang the driver forever on a future that never resolves.
     import sys
 
     jax = sys.modules.get("jax")
     if jax is None:
         return False
-    return isinstance(value, jax.Array)
+    arr_type = getattr(jax, "Array", None)
+    if arr_type is None:
+        return False
+    try:
+        return isinstance(value, arr_type)
+    except TypeError:
+        return False
 
 
 class SerializedObject:

@@ -2,7 +2,7 @@
 
 Reference: python/ray/air/ (Checkpoint air/checkpoint.py:63, configs
 air/config.py:79-670, session air/session.py:41)."""
-from ray_tpu.air.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.air.checkpoint import Checkpoint, ShardedCheckpoint  # noqa: F401
 from ray_tpu.air.config import (  # noqa: F401
     CheckpointConfig,
     FailureConfig,

@@ -49,6 +49,15 @@ class Checkpoint:
                              jax.tree_util.tree_map(lambda x: None, host_tree)),
                          **(extra or {})})
 
+    @classmethod
+    def from_sharded(cls, root: str, step: Optional[int] = None) -> "Checkpoint":
+        """Open a committed step of a distributed sharded checkpoint store
+        (``ray_tpu.checkpoint``): ``step=None`` means the latest committed
+        manifest.  ``to_pytree`` reassembles the full global tree from the
+        per-rank shards (resharded restore: pass rank/world via
+        ``to_pytree_resharded``)."""
+        return ShardedCheckpoint(root, step)
+
     # ---- accessors ----
     def to_dict(self) -> Dict[str, Any]:
         if self._data is not None:
@@ -62,7 +71,11 @@ class Checkpoint:
         if os.path.exists(pt):
             with open(pt, "rb") as f:
                 out["__pytree__"] = f.read()
-        return out
+            return out
+        raise ValueError(
+            f"checkpoint directory {self._dir!r} contains neither "
+            f"{_DICT_FILE!r} nor {_PYTREE_FILE!r} — not a checkpoint "
+            f"(was the directory partially written or already deleted?)")
 
     def to_pytree(self, target: Any = None) -> Any:
         """Restore the stored pytree; `target` provides the structure (else
@@ -91,6 +104,80 @@ class Checkpoint:
                 pickle.dump(self._data, f)
         return path
 
+    def delete(self) -> None:
+        """Remove the checkpoint's on-disk footprint (no-op for in-memory
+        dict checkpoints).  Used by CheckpointManager eviction so
+        ``num_to_keep`` actually reclaims disk, not just list slots."""
+        if self._dir is not None:
+            shutil.rmtree(self._dir, ignore_errors=True)
+
     def __repr__(self):
         kind = "dict" if self._data is not None else f"dir:{self._dir}"
         return f"Checkpoint({kind})"
+
+
+class ShardedCheckpoint(Checkpoint):
+    """A committed step of a distributed sharded checkpoint store.
+
+    Directory-backed on the step dir, but the authoritative reader is the
+    manifest + chunk store: ``to_pytree`` reassembles global arrays from
+    every rank's shards (``ray_tpu.checkpoint.restore``)."""
+
+    def __init__(self, root: str, step: Optional[int] = None):
+        from ray_tpu.checkpoint import manifest as mf
+
+        if step is None:
+            step = mf.latest_committed_step(root)
+            if step is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint manifest under {root!r}")
+        super().__init__(directory=mf.step_dir(root, step))
+        self.root = root
+        self.step = int(step)
+
+    def manifest(self) -> Dict[str, Any]:
+        from ray_tpu.checkpoint import manifest as mf
+
+        return mf.read_manifest(self.root, self.step)
+
+    def to_dict(self) -> Dict[str, Any]:
+        m = self.manifest()
+        if m.get("kind") == "dict":
+            return super().to_dict()
+        return {"__sharded__": True, "root": self.root, "step": self.step,
+                **m.get("meta", {})}
+
+    def to_pytree(self, target: Any = None) -> Any:
+        from ray_tpu.checkpoint.restore import restore_tree
+
+        return restore_tree(self.root, step=self.step, target=target)
+
+    def to_pytree_resharded(self, target: Any = None, rank: int = 0,
+                            world_size: int = 1, index_fn=None) -> Any:
+        """Restore this rank's reshard of the checkpoint (an N-rank save
+        onto an M-rank gang).  Default resharding is the even axis-0
+        split; pass ``index_fn`` for custom layouts."""
+        from ray_tpu.checkpoint.restore import restore_tree
+        from ray_tpu.checkpoint.tree import axis0_restore_index
+
+        if index_fn is None and world_size > 1:
+            index_fn = axis0_restore_index(rank, world_size)
+        return restore_tree(self.root, step=self.step, target=target,
+                            index_fn=index_fn)
+
+    def extra(self) -> Dict[str, Any]:
+        return dict(self.manifest().get("meta", {}))
+
+    def delete(self) -> None:
+        """Evict this step: remove its dir, then sweep chunks no other
+        committed manifest references."""
+        from ray_tpu.checkpoint import manifest as mf
+
+        mf.delete_step(self.root, self.step)
+        try:
+            mf.gc_chunks(self.root)
+        except Exception:
+            pass
+
+    def __repr__(self):
+        return f"ShardedCheckpoint(root={self.root!r}, step={self.step})"

@@ -19,13 +19,15 @@ class _Session:
     def __init__(self, report_fn, world_rank=0, world_size=1,
                  checkpoint: Optional[Checkpoint] = None,
                  dataset_shards: Optional[Dict[str, Any]] = None,
-                 trial_info: Optional[dict] = None):
+                 trial_info: Optional[dict] = None,
+                 storage_path: Optional[str] = None):
         self.report_fn = report_fn
         self.world_rank = world_rank
         self.world_size = world_size
         self.loaded_checkpoint = checkpoint
         self.dataset_shards = dataset_shards or {}
         self.trial_info = trial_info or {}
+        self.storage_path = storage_path
 
 
 def init_session(**kw):
@@ -73,3 +75,30 @@ def get_world_size() -> int:
 def get_trial_name() -> Optional[str]:
     s = _get()
     return s.trial_info.get("name") if s else None
+
+
+def get_storage_path() -> Optional[str]:
+    """The experiment's checkpoint store root (RunConfig.storage_path),
+    exported to every training worker — rank loops use it to save per-rank
+    shards directly (``ray_tpu.checkpoint.ShardWriter(get_storage_path(),
+    get_world_rank(), get_world_size())``) instead of shipping full state
+    through ``session.report``."""
+    import os
+
+    s = _get()
+    if s is not None and s.storage_path:
+        return s.storage_path
+    return os.environ.get("RTPU_CHECKPOINT_ROOT") or None
+
+
+def sharded_writer():
+    """Convenience: a ``ShardWriter`` for this worker's (rank, world) into
+    the session's storage path.  Raises when no storage path is set."""
+    root = get_storage_path()
+    if not root:
+        raise RuntimeError(
+            "session.sharded_writer() needs RunConfig.storage_path (or "
+            "RTPU_CHECKPOINT_ROOT) to be set")
+    from ray_tpu.checkpoint.saver import ShardWriter
+
+    return ShardWriter(root, get_world_rank(), get_world_size())

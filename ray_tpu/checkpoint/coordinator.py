@@ -1,0 +1,202 @@
+"""Driver-side save coordination: gang fan-out, background commit, GC.
+
+The coordinator never touches array bytes — ranks write their own shards
+(phase 1); the coordinator's only writes are the atomic manifest rename
+(phase 2) and garbage collection.  ``commit_when_complete`` polls for the
+rank files instead of holding a rendezvous, so persist can be fully
+asynchronous worker-side (a pipeline step snapshots and returns; a
+background thread writes) and a crashed rank simply times the commit out —
+leaving the store at the previous committed checkpoint.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.checkpoint import manifest as mf
+
+
+def commit_when_complete(root: str, step: int, world_size: int,
+                         meta: Optional[dict] = None,
+                         timeout: float = 120.0,
+                         poll_interval: float = 0.05) -> dict:
+    """Wait for every rank's shard file, then commit + sweep orphans.
+    Raises TimeoutError (store untouched, previous checkpoint stands) if
+    the shards don't all land within ``timeout``."""
+    from ray_tpu._private import profiling
+
+    t0 = time.perf_counter()
+    deadline = time.monotonic() + timeout
+    while True:
+        missing = mf.missing_rank_files(root, step, world_size)
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"checkpoint step {step}: ranks {missing} never persisted "
+                f"their shards within {timeout}s; not committing")
+        time.sleep(poll_interval)
+    manifest = mf.commit_manifest(root, step, world_size, meta=meta)
+    mf.gc_orphans(root, below=step)
+    profiling.record_span("checkpoint_commit", t0, time.perf_counter(),
+                          step=int(step))
+    return manifest
+
+
+class AsyncCommitter:
+    """Background commit threads for async sharded saves.  One commit per
+    step; ``flush()`` joins them and re-raises the first failure.  A gang
+    restart cancels pending commits (their writers died with the gang)."""
+
+    def __init__(self):
+        self._threads: Dict[int, threading.Thread] = {}
+        self._cancelled: set = set()
+        self._errors: List[BaseException] = []
+        self._lock = threading.Lock()
+
+    def commit_async(self, root: str, step: int, world_size: int,
+                     meta: Optional[dict] = None,
+                     timeout: float = 120.0,
+                     on_commit: Optional[Callable[[dict], None]] = None
+                     ) -> None:
+        def run():
+            try:
+                poll = 0.05
+                deadline = time.monotonic() + timeout
+                while True:
+                    with self._lock:
+                        if step in self._cancelled:
+                            return
+                    if not mf.missing_rank_files(root, step, world_size):
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"checkpoint step {step} commit timed out")
+                    time.sleep(poll)
+                manifest = mf.commit_manifest(root, step, world_size,
+                                              meta=meta)
+                mf.gc_orphans(root, below=step)
+                if on_commit is not None:
+                    on_commit(manifest)
+            except BaseException as e:  # noqa: BLE001 — surfaced by flush
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                with self._lock:
+                    self._threads.pop(step, None)
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"ckpt-commit-{step}")
+        with self._lock:
+            self._threads[int(step)] = t
+        t.start()
+
+    def cancel_pending(self) -> None:
+        """Abandon uncommitted saves (e.g. after a gang restart killed the
+        writers): their step dirs become orphans for the next GC."""
+        with self._lock:
+            self._cancelled.update(self._threads.keys())
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        with self._lock:
+            threads = list(self._threads.values())
+        for t in threads:
+            t.join(timeout)
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            raise errors[0]
+
+
+def _rank_persist_shard(state, root, step, tree_fn, sync, extra):
+    """Worker-side (run_stateful shape): snapshot this rank's tree and
+    persist it — synchronously, or on the rank's background thread."""
+    import os
+
+    from ray_tpu.checkpoint.saver import ShardWriter
+
+    rank = int(os.environ.get("RTPU_RANK", "0"))
+    world = int(os.environ.get("RTPU_WORLD_SIZE", "1"))
+    writer = state.get("_ckpt_writer")
+    if writer is None or writer.root != root:
+        writer = ShardWriter(root, rank, world)
+        state["_ckpt_writer"] = writer
+    snap = writer.snapshot(tree_fn(state))
+    if sync:
+        writer.persist(snap, step, extra=extra)
+    else:
+        writer.persist_async(snap, step, extra=extra)
+    return {"rank": rank, "step": int(step)}
+
+
+def _rank_wait_persisted(state, timeout):
+    writer = state.get("_ckpt_writer")
+    if writer is not None:
+        writer.wait(timeout)
+    return True
+
+
+class DistributedCheckpointer:
+    """Sharded checkpointing over a MeshGroup gang.
+
+    ``tree_fn(state) -> pytree`` (picklable) extracts the rank's local
+    tree from its worker state dict.  ``save()`` is the lockstep form;
+    ``save_async()`` overlaps persist with the step stream: ranks snapshot
+    (the bounded pause) and return, chunk writes ride rank background
+    threads, and a driver-side committer publishes the manifest when the
+    shards land.  ``num_to_keep`` evicts old committed steps (and their
+    now-unreferenced chunks) after each commit.
+    """
+
+    def __init__(self, group, root: str,
+                 tree_fn: Callable[[dict], Any],
+                 num_to_keep: Optional[int] = None,
+                 commit_timeout: float = 120.0):
+        self.group = group
+        self.root = root
+        self.tree_fn = tree_fn
+        self.num_to_keep = num_to_keep
+        self.commit_timeout = commit_timeout
+        self.committer = AsyncCommitter()
+        self.last_manifest: Optional[dict] = None
+        # In-flight async saves die with the gang: stop their committers
+        # from publishing a half-written step after a rebuild.
+        if hasattr(group, "add_restart_hook"):
+            group.add_restart_hook(lambda g: self.committer.cancel_pending())
+
+    def _post_commit(self, manifest: dict) -> None:
+        self.last_manifest = manifest
+        if self.num_to_keep:
+            try:
+                mf.evict_steps(self.root, self.num_to_keep)
+            except Exception:
+                pass
+
+    def save(self, step: int, meta: Optional[dict] = None) -> dict:
+        """Lockstep sharded save: every rank persists, then commit."""
+        self.group.run_stateful(_rank_persist_shard, self.root, step,
+                                self.tree_fn, True, meta)
+        manifest = commit_when_complete(self.root, step,
+                                        self.group.num_hosts, meta=meta,
+                                        timeout=self.commit_timeout)
+        self._post_commit(manifest)
+        return manifest
+
+    def save_async(self, step: int, meta: Optional[dict] = None) -> None:
+        """Async sharded save: ranks snapshot and return (persist runs on
+        their background threads); the manifest commits from a driver
+        thread when every shard lands."""
+        self.group.run_stateful(_rank_persist_shard, self.root, step,
+                                self.tree_fn, False, meta)
+        self.committer.commit_async(self.root, step, self.group.num_hosts,
+                                    meta=meta, timeout=self.commit_timeout,
+                                    on_commit=self._post_commit)
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Barrier: worker persists joined, pending commits published."""
+        self.group.run_stateful(_rank_wait_persisted, self.commit_timeout)
+        self.committer.flush(timeout)
+
+    def latest_step(self) -> Optional[int]:
+        return mf.latest_committed_step(self.root)

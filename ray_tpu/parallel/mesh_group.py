@@ -748,6 +748,12 @@ class MeshGroup:
         self._resources = dict(resources_per_host or {"CPU": 1.0})
         self.pg = None
         self.workers: List[Any] = []
+        # Group-level restart hooks: run inside _restart after every
+        # successful respawn, BEFORE the caller's per-call on_restart —
+        # for cross-cutting state that must react to any rebuild (e.g.
+        # the checkpoint coordinator cancelling in-flight async commits
+        # whose writers died with the old gang).
+        self._restart_hooks: List[Callable] = []
         self._spawn(generation=0)
 
     # ---- gang lifecycle ----
@@ -839,6 +845,21 @@ class MeshGroup:
                 restarts_total.inc()
             except Exception:
                 pass
+        for hook in self._restart_hooks:
+            try:
+                hook(self)
+            except Exception:
+                # Group-level hooks are advisory (cancellation, metrics);
+                # state re-materialization belongs to per-call on_restart,
+                # whose failures DO propagate.
+                pass
+
+    def add_restart_hook(self, hook: Callable[["MeshGroup"], None]) -> None:
+        """Register ``hook(group)`` to run after every successful gang
+        rebuild, before the per-call ``on_restart``.  Exceptions are
+        swallowed — use for cross-cutting reactions (cancelling pending
+        checkpoint commits, cache invalidation), not state rebuilds."""
+        self._restart_hooks.append(hook)
 
     # ---- health ----
     def health_check(self, deadline: float = 10.0) -> List[int]:

@@ -128,6 +128,43 @@ def _learner_set_weights(state, weights):
     return True
 
 
+def _learner_shard_save(state, root, step, sync):
+    """Per-rank sharded checkpoint of the learner params: one bounded
+    device→host snapshot, then chunk/hash/write — synchronously, or on
+    this rank's background persist thread (``sync=False``), so a save
+    riding the step pipeline releases its slot after the snapshot and
+    never stalls the donated update stream."""
+    import os
+
+    from ray_tpu.checkpoint.saver import ShardWriter
+
+    rank = int(os.environ.get("RTPU_RANK", "0"))
+    world = int(os.environ.get("RTPU_WORLD_SIZE", "1"))
+    writer = state.get("_ckpt_writer")
+    if writer is None or writer.root != root:
+        writer = ShardWriter(root, rank, world)
+        state["_ckpt_writer"] = writer
+    snap = writer.snapshot(state["learner"].params)
+    if sync:
+        writer.persist(snap, step)
+    else:
+        writer.persist_async(snap, step)
+    return {"rank": rank, "step": int(step)}
+
+
+def _learner_shard_restore(state, root, step):
+    """Restore this rank's learner params from the latest (or given)
+    committed manifest.  Params are replicated across the gang, so every
+    rank loads the full tree — which is also why an N-rank checkpoint
+    restores onto an M-rank gang unchanged (resharded restore)."""
+    from ray_tpu.checkpoint.restore import restore_tree
+
+    learner = state["learner"]
+    learner.set_weights(
+        restore_tree(root, step=step, target=learner.get_weights()))
+    return True
+
+
 class DistributedLearnerGroup:
     """Multi-host LearnerGroup: one learner process per TPU host, gang-
     scheduled as a MeshGroup, all hosts running the same pjit update over
@@ -153,7 +190,9 @@ class DistributedLearnerGroup:
     def __init__(self, learner_factory, num_hosts: int = 1,
                  resources_per_host=None, platform=None,
                  local_device_count=None, max_group_restarts: int = 0,
-                 pipeline_depth: int = 0, metrics_interval: int = 1):
+                 pipeline_depth: int = 0, metrics_interval: int = 1,
+                 checkpoint_root: Optional[str] = None,
+                 checkpoint_keep: Optional[int] = None):
         from ray_tpu.parallel.mesh_group import MeshGroup
 
         self._factory = learner_factory
@@ -161,11 +200,26 @@ class DistributedLearnerGroup:
         self._last_metrics: Optional[Dict[str, float]] = None
         self._weight_steps: set = set()
         self._pipeline = None
+        # Sharded checkpointing (checkpoint_root set): every rank persists
+        # its own shard into the store; the driver only commits manifests.
+        self._ckpt_root = checkpoint_root
+        self._ckpt_keep = checkpoint_keep
+        self._ckpt_step = 0
+        self._ckpt_pipe_steps: Dict[int, Tuple[int, bool]] = {}
+        self._committer = None
         self.group = MeshGroup(num_hosts, resources_per_host,
                                platform=platform,
                                local_device_count=local_device_count,
                                max_group_restarts=max_group_restarts,
                                pipeline_depth=max(1, pipeline_depth))
+        if checkpoint_root is not None:
+            from ray_tpu.checkpoint.coordinator import AsyncCommitter
+
+            self._committer = AsyncCommitter()
+            # In-flight async saves die with a gang rebuild — cancel their
+            # commits so a half-persisted step can never publish.
+            self.group.add_restart_hook(
+                lambda g: self._committer.cancel_pending())
         self.group.run_stateful(_build_learner, learner_factory)
         if pipeline_depth > 0:
             # Zero-sync hot path: updates stream through a bounded window,
@@ -177,12 +231,20 @@ class DistributedLearnerGroup:
 
     def _on_restart(self, group):
         """After a gang rebuild the new host processes hold empty state:
-        re-build the learner on every rank, then re-broadcast the last
-        known weights so the update that triggered the restart retries
-        against the pre-failure policy."""
+        re-build the learner on every rank, then restore the latest
+        COMMITTED sharded checkpoint (when a checkpoint_root is set and
+        holds one — per-rank disk reads, no driver broadcast), falling
+        back to re-broadcasting the last driver-cached weights."""
         import ray_tpu
 
         group.run_stateful(_build_learner, self._factory)
+        if self._ckpt_root is not None:
+            from ray_tpu.checkpoint import manifest as mf
+
+            if mf.latest_committed_step(self._ckpt_root) is not None:
+                group.run_stateful(_learner_shard_restore,
+                                   self._ckpt_root, None)
+                return
         if self._last_weights is not None:
             # One put, num_hosts borrowers: each rank resolves the same
             # store object zero-copy instead of the submit path
@@ -190,11 +252,48 @@ class DistributedLearnerGroup:
             group.run_stateful(_learner_set_weights,
                                ray_tpu.put(self._last_weights))
 
-    def checkpoint_weights(self):
-        """Pull rank-0 weights into the driver-side cache used to restore
-        a rebuilt gang.  Call at whatever cadence bounds acceptable
-        rollback (every N updates, alongside algorithm checkpoints, ...).
-        Returns the fetched weights."""
+    def _commit_sharded(self, step: int) -> None:
+        from ray_tpu.checkpoint import manifest as mf
+        from ray_tpu.checkpoint.coordinator import commit_when_complete
+
+        commit_when_complete(self._ckpt_root, step, self.group.num_hosts)
+        if self._ckpt_keep:
+            try:
+                mf.evict_steps(self._ckpt_root, self._ckpt_keep)
+            except Exception:
+                pass
+
+    def checkpoint_weights(self, step: Optional[int] = None):
+        """Checkpoint the current policy.
+
+        With a ``checkpoint_root``: a per-rank SHARDED save — every host
+        snapshots and persists its own shard, the driver commits the
+        manifest — so save cost no longer scales with a full-weights
+        gather to the driver.  Returns the committed manifest.
+
+        Without one (legacy): pull rank-0 weights into the driver-side
+        restore cache and return them."""
+        if self._ckpt_root is not None:
+            if step is None:
+                self._ckpt_step += 1
+                step = self._ckpt_step
+            else:
+                self._ckpt_step = max(self._ckpt_step, int(step))
+            if self._pipeline is not None:
+                # Ride the step pipeline: the save serializes with the
+                # (donating) in-flight updates instead of racing them.
+                idx = self._pipeline.submit(_learner_shard_save,
+                                            self._ckpt_root, step, True,
+                                            fetch=True)
+                self._ckpt_pipe_steps[idx] = (step, True)
+                self._pipeline.flush()
+            else:
+                self.group.run_stateful(_learner_shard_save,
+                                        self._ckpt_root, step, True)
+                self._commit_sharded(step)
+            from ray_tpu.checkpoint import manifest as mf
+
+            return mf.read_manifest(self._ckpt_root, step)
         self._last_weights = self.group.run_rank_stateful(
             0, _learner_get_weights)
         return self._last_weights
@@ -214,6 +313,21 @@ class DistributedLearnerGroup:
 
     # ---- pipelined update stream (pipeline_depth > 0) ----
     def _on_pipe_result(self, idx: int, res) -> None:
+        if idx in self._ckpt_pipe_steps:
+            step, synchronous = self._ckpt_pipe_steps.pop(idx)
+            if res is None:
+                return  # save step lost to a gang restart replay edge
+            if synchronous:
+                # sync persist ran inside the pipeline step: every shard
+                # file already exists, commit is immediate.
+                self._commit_sharded(step)
+            else:
+                # async persist: rank background threads are still
+                # writing; a driver thread commits when the shards land.
+                self._committer.commit_async(
+                    self._ckpt_root, step, self.group.num_hosts,
+                    on_commit=lambda m: self._post_async_commit(step))
+            return
         if res is None:
             return  # non-fetch step: metrics stayed on device
         if idx in self._weight_steps:
@@ -221,6 +335,15 @@ class DistributedLearnerGroup:
             self._last_weights = res[0]
         else:
             self._last_metrics = res[0]
+
+    def _post_async_commit(self, step: int) -> None:
+        if self._ckpt_keep:
+            try:
+                from ray_tpu.checkpoint import manifest as mf
+
+                mf.evict_steps(self._ckpt_root, self._ckpt_keep)
+            except Exception:
+                pass
 
     def update_async(self, batch) -> Optional[Dict[str, float]]:
         """Pipelined update: dispatches the step and returns immediately
@@ -237,25 +360,75 @@ class DistributedLearnerGroup:
         self._pipeline.submit(_learner_update_device, batch_ref)
         return self._last_metrics
 
-    def checkpoint_weights_async(self) -> None:
-        """Non-blocking weight-sync snapshot: rides the step pipeline, so
-        it serializes with the (donating) update steps instead of racing
-        them, and the driver never blocks.  The snapshot lands in the
-        driver-side restore cache when its pipeline slot drains (at most
-        pipeline_depth steps later); it is also what a gang rebuild
-        re-broadcasts."""
+    def checkpoint_weights_async(self, step: Optional[int] = None) -> None:
+        """Non-blocking checkpoint: rides the step pipeline, so it
+        serializes with the (donating) update steps instead of racing
+        them, and the driver never blocks.
+
+        With a ``checkpoint_root``: a per-rank sharded save — the pipeline
+        step only pays the bounded host snapshot; chunk writes ride each
+        rank's background persist thread and a driver thread commits the
+        manifest when every shard lands (two-phase: a crash mid-persist
+        leaves the previous committed checkpoint as the latest).
+
+        Without one (legacy): a rank-0 weights fetch that lands in the
+        driver-side restore cache when its pipeline slot drains."""
         if self._pipeline is None:
             raise RuntimeError(
                 "pipelined snapshots need pipeline_depth > 0")
+        if self._ckpt_root is not None:
+            if step is None:
+                self._ckpt_step += 1
+                step = self._ckpt_step
+            else:
+                self._ckpt_step = max(self._ckpt_step, int(step))
+            idx = self._pipeline.submit(_learner_shard_save,
+                                        self._ckpt_root, step, False,
+                                        fetch=True)
+            self._ckpt_pipe_steps[idx] = (step, False)
+            return
         idx = self._pipeline.submit(_learner_get_weights, fetch=True)
         self._weight_steps.add(idx)
 
     def flush_updates(self) -> Optional[Dict[str, float]]:
-        """Drain every in-flight pipelined step; returns the final
-        metrics (the barrier to call at iteration end)."""
+        """Drain every in-flight pipelined step AND publish any pending
+        async checkpoint commits; returns the final metrics (the barrier
+        to call at iteration end)."""
         if self._pipeline is not None:
             self._pipeline.flush()
+        self.flush_checkpoints()
         return self._last_metrics
+
+    def flush_checkpoints(self) -> None:
+        """Barrier for async sharded saves: joins rank persist threads
+        and pending manifest commits (re-raising a failed commit)."""
+        if self._ckpt_root is None:
+            return
+        from ray_tpu.checkpoint.coordinator import _rank_wait_persisted
+
+        self.group.run_stateful(_rank_wait_persisted, 120.0)
+        self._committer.flush()
+
+    def restore_latest(self, step: Optional[int] = None) -> Optional[int]:
+        """Restore every rank's learner from the latest (or given)
+        committed manifest under ``checkpoint_root``.  Works across gang
+        sizes: an N-rank save restores onto this M-rank group (replicated
+        params — each rank reads the full tree from the store).  Returns
+        the restored step, or None when the store has no commit."""
+        if self._ckpt_root is None:
+            raise RuntimeError("restore_latest needs checkpoint_root")
+        from ray_tpu.checkpoint import manifest as mf
+
+        if step is None:
+            step = mf.latest_committed_step(self._ckpt_root)
+            if step is None:
+                return None
+        if self._pipeline is not None:
+            self._pipeline.flush()
+        self.group.run_stateful(_learner_shard_restore, self._ckpt_root,
+                                step, on_restart=self._on_restart)
+        self._ckpt_step = max(self._ckpt_step, int(step))
+        return int(step)
 
     def get_weights(self):
         if self._pipeline is not None:
@@ -283,4 +456,8 @@ class DistributedLearnerGroup:
             except Exception:
                 pass
             self._pipeline = None
+        if self._committer is not None:
+            # Workers are about to die: saves that haven't committed yet
+            # become orphans for the next save's GC, never partial reads.
+            self._committer.cancel_pending()
         self.group.shutdown()

@@ -43,7 +43,8 @@ _GANG_FAILURES = (exc.MeshGroupError, exc.ActorDiedError,
 
 class BackendExecutor:
     def __init__(self, backend_config: BackendConfig,
-                 scaling_config: ScalingConfig, generation: int = 0):
+                 scaling_config: ScalingConfig, generation: int = 0,
+                 storage_path: Optional[str] = None):
         self.backend_config = backend_config
         self.backend: Backend = backend_config.backend_cls()()
         self.scaling = scaling_config
@@ -52,6 +53,11 @@ class BackendExecutor:
         # Elastic-restart incarnation index (0 on the first attempt);
         # exported to workers so chaos schedules can target one gang.
         self.generation = generation
+        # Checkpoint store root, exported to every worker as
+        # RTPU_CHECKPOINT_ROOT: rank loops save per-rank shards directly
+        # into it (ray_tpu.checkpoint.ShardWriter) and elastic resume
+        # discovers the latest committed manifest there.
+        self.storage_path = storage_path
 
     def _gang_failure(self, e: BaseException) -> TrainingWorkerError:
         """Wrap a gang-poisoning failure so the trainer's elastic-restart
@@ -73,6 +79,13 @@ class BackendExecutor:
             self.pg.ready(timeout=60)
         self.worker_group = WorkerGroup(self.scaling.num_workers, res,
                                         self.pg, generation=self.generation)
+        if self.storage_path:
+            try:
+                gang_get([w.setup_env.remote(
+                    {"RTPU_CHECKPOINT_ROOT": self.storage_path})
+                    for w in self.worker_group.workers], timeout=30.0)
+            except _GANG_FAILURES as e:
+                raise self._gang_failure(e) from e
         # Gang rendezvous (jax.distributed coordinator on worker 0) is the
         # backend's job, shared with MeshGroup: see
         # ray_tpu/parallel/mesh_group.py:rendezvous.  A rank dying inside

@@ -76,13 +76,15 @@ class TrainWorker:
 
         def run():
             import inspect
+            import os
 
             from ray_tpu.air import session as air_session
 
             air_session.init_session(
                 report_fn=report_fn, world_rank=self.rank,
                 world_size=self.world_size, checkpoint=checkpoint,
-                dataset_shards=dataset_shards)
+                dataset_shards=dataset_shards,
+                storage_path=os.environ.get("RTPU_CHECKPOINT_ROOT"))
             try:
                 wants_arg = True
                 try:

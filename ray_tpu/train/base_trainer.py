@@ -12,7 +12,10 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
-from ray_tpu.air.checkpoint_manager import CheckpointManager
+from ray_tpu.air.checkpoint_manager import (
+    CheckpointManager,
+    discover_latest_checkpoint,
+)
 from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
 from ray_tpu.air.result import Result
 from ray_tpu.train.backend import BackendConfig
@@ -30,6 +33,19 @@ class BaseTrainer:
         self.run_config = run_config or RunConfig()
         self.resume_from_checkpoint = resume_from_checkpoint
 
+    def _discover_checkpoint(self) -> Optional[Checkpoint]:
+        """Latest COMMITTED checkpoint manifest under storage_path — the
+        durable resume pointer.  Survives a full driver process restart
+        (the in-memory ``_latest_checkpoint`` does not), and two-phase
+        commit guarantees it never names a partially-written save."""
+        storage = self.run_config.storage_path
+        if not storage:
+            return None
+        try:
+            return discover_latest_checkpoint(storage)
+        except Exception:
+            return None
+
     def fit(self) -> Result:
         import time
 
@@ -38,6 +54,10 @@ class BaseTrainer:
             if failure.max_failures >= 0 else 10**9
         last_error: Optional[BaseException] = None
         checkpoint = self.resume_from_checkpoint
+        if checkpoint is None:
+            # Fresh driver process against an existing experiment dir:
+            # resume where the last committed checkpoint left off.
+            checkpoint = self._discover_checkpoint()
         for attempt in range(attempts):
             # Incarnation index: the executor exports it to the gang so
             # chaos kill schedules can target exactly one generation, and
@@ -54,8 +74,14 @@ class BaseTrainer:
                 last_error = e
                 # Elastic restart resumes from the latest checkpoint: the
                 # next _run() builds a FRESH executor + worker gang (new
-                # processes re-run the jax.distributed rendezvous).
-                checkpoint = getattr(self, "_latest_checkpoint", checkpoint)
+                # processes re-run the jax.distributed rendezvous).  Disk
+                # manifest discovery outranks the in-memory cache — with a
+                # storage_path every registered checkpoint is committed
+                # there, and workers may have sharded-saved past the last
+                # driver-observed report.
+                checkpoint = (self._discover_checkpoint()
+                              or getattr(self, "_latest_checkpoint", None)
+                              or checkpoint)
                 try:
                     from ray_tpu.util.metrics import Counter
 
@@ -110,8 +136,10 @@ class DataParallelTrainer(BaseTrainer):
     def _run(self, checkpoint: Optional[Checkpoint]) -> Result:
         executor = BackendExecutor(
             self.backend_config, self.scaling_config,
-            generation=getattr(self, "_elastic_generation", 0))
-        ckpt_mgr = CheckpointManager(self.run_config.checkpoint_config)
+            generation=getattr(self, "_elastic_generation", 0),
+            storage_path=self.run_config.storage_path)
+        ckpt_mgr = CheckpointManager(self.run_config.checkpoint_config,
+                                     storage_path=self.run_config.storage_path)
         history = []
         final_metrics: Dict[str, Any] = {}
         try:

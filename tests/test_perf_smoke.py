@@ -4,7 +4,11 @@ blocking driver↔worker syncs — so an overlap regression fails the normal
 test pass instead of only surfacing in the full bench."""
 import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 
-from tools.perf_smoke import run_object_plane_smoke, run_smoke
+from tools.perf_smoke import (
+    run_checkpoint_smoke,
+    run_object_plane_smoke,
+    run_smoke,
+)
 
 
 def test_pipeline_overlap_smoke(shutdown_only):
@@ -12,6 +16,20 @@ def test_pipeline_overlap_smoke(shutdown_only):
     assert out["results_ok"], out
     assert out["driver_syncs"] == 0, out
     assert out["overlap_ok"], f"lockstep regression: {out}"
+    assert out["ok"]
+
+
+def test_checkpoint_overlap_smoke(shutdown_only):
+    """An async sharded save riding the step pipeline must not stall it:
+    overlap invariant intact, zero blocking driver syncs, and the save
+    still commits its manifest (restorable state) — the tier-1 guard for
+    the distributed checkpoint subsystem's 'off the step path' promise."""
+    out = run_checkpoint_smoke(steps=8, depth=2)
+    assert out["results_ok"], out
+    assert out["driver_syncs"] == 0, out
+    assert out["overlap_ok"], f"checkpoint stalled the pipeline: {out}"
+    assert out["committed_step"] == 1, out
+    assert out["restore_ok"], out
     assert out["ok"]
 
 

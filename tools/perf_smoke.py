@@ -163,12 +163,104 @@ def run_object_plane_smoke(cycles: int = 4, burst: int = 4) -> dict:
         ray_tpu.shutdown()
 
 
+def _ckpt_save_step(state, root, step):
+    """Pipeline-riding async sharded save of the smoke carry: the step
+    pays only the bounded host snapshot; chunk writes ride the rank's
+    background persist thread."""
+    import os
+
+    from ray_tpu.checkpoint.saver import ShardWriter
+
+    rank = int(os.environ.get("RTPU_RANK", "0"))
+    world = int(os.environ.get("RTPU_WORLD_SIZE", "1"))
+    writer = state.get("_ckpt_writer")
+    if writer is None:
+        writer = ShardWriter(root, rank, world)
+        state["_ckpt_writer"] = writer
+    writer.persist_async(writer.snapshot({"carry": state["carry"]}), step)
+    return {"rank": rank}
+
+
+def run_checkpoint_smoke(steps: int = STEPS, depth: int = DEPTH) -> dict:
+    """Async-checkpoint overlap guard (tier-1): an async sharded save
+    submitted mid-stream must NOT degrade the pipelined step loop —
+
+    1. every steady-state step still dispatches before its predecessor's
+       drain (no lockstep fallback around the save),
+    2. the whole run performs zero blocking driver syncs,
+    3. the save still COMMITS (manifest lands, restorable state).
+    """
+    import shutil
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu._private import profiling
+    from ray_tpu.checkpoint import latest_committed_step, restore_tree
+    from ray_tpu.checkpoint.coordinator import AsyncCommitter
+    from ray_tpu.parallel import MeshGroup, mesh_group
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    root = tempfile.mkdtemp(prefix="rtpu_ckpt_smoke_")
+    mg = MeshGroup(num_hosts=1, platform="cpu", local_device_count=1,
+                   pipeline_depth=depth)
+    committer = AsyncCommitter()
+    save_at = steps // 2
+    try:
+        profiling.clear_recorded_spans()
+        syncs_before = mesh_group.driver_sync_count()
+        with mg.pipeline(depth=depth, metrics_interval=1) as pipe:
+            for i in range(steps):
+                pipe.submit(_jax_step, 1.0)
+                if i == save_at:
+                    pipe.submit(_ckpt_save_step, root, 1, fetch=True)
+                    committer.commit_async(root, 1, mg.num_hosts)
+            results = pipe.flush()
+        syncs = mesh_group.driver_sync_count() - syncs_before
+        committer.flush(timeout=30.0)
+
+        total = steps + 1  # the save rides the stream as one extra step
+        dispatch = {s["args"]["step"]: s
+                    for s in profiling.recorded_spans("pipeline_dispatch")}
+        drain = {s["args"]["step"]: s
+                 for s in profiling.recorded_spans("pipeline_drain")}
+        violations = [
+            n for n in range(total - depth)
+            if not (n + 1 in dispatch and
+                    dispatch[n + 1]["start"] < drain[n]["start"])
+        ]
+        committed = latest_committed_step(root)
+        restored = None
+        if committed is not None:
+            restored = restore_tree(root, step=committed)
+        out = {
+            "steps": total,
+            "depth": depth,
+            "results_ok": len(results) == total,
+            "driver_syncs": syncs,
+            "overlap_violations": violations,
+            "overlap_ok": not violations,
+            "committed_step": committed,
+            "restore_ok": bool(restored is not None
+                               and "carry" in restored),
+        }
+        out["ok"] = bool(out["results_ok"] and out["overlap_ok"]
+                         and syncs == 0 and out["restore_ok"])
+        return out
+    finally:
+        mg.shutdown()
+        ray_tpu.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
     obj = run_object_plane_smoke()
     out["object_plane"] = obj
-    out["ok"] = bool(out["ok"] and obj["ok"])
+    ckpt = run_checkpoint_smoke()
+    out["checkpoint"] = ckpt
+    out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
