@@ -41,7 +41,19 @@ class CheckpointManager:
         self.storage_path = storage_path
         # (score, seq, checkpoint, metrics)
         self._entries: List[Tuple[float, int, Checkpoint, dict]] = []
+        # Seed the sequence from the store: register() uses it as the
+        # persisted step number, and a fresh manager (elastic retry or
+        # driver restart) starting back at 0 would overwrite committed
+        # step dirs while manifest discovery kept resuming from the
+        # stale, highest-numbered pre-restart checkpoint.
         self._seq = 0
+        if storage_path:
+            try:
+                from ray_tpu.checkpoint import manifest as mf
+
+                self._seq = mf.latest_committed_step(storage_path) or 0
+            except Exception:
+                pass
         self.latest: Optional[Checkpoint] = None
 
     def _persist(self, checkpoint: Checkpoint, metrics: dict,

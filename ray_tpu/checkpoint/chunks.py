@@ -15,11 +15,15 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 import uuid
 from typing import Iterator, List, Optional, Set, Tuple
 
 CHUNK_BYTES_ENV = "RAY_TPU_CHECKPOINT_CHUNK_BYTES"
 _DEFAULT_CHUNK_BYTES = 1 << 20  # 1 MiB
+
+GC_GRACE_ENV = "RAY_TPU_CHECKPOINT_GC_GRACE_SECONDS"
+_DEFAULT_GC_GRACE = 300.0
 
 CHUNKS_DIR = "chunks"
 
@@ -30,6 +34,14 @@ def default_chunk_bytes() -> int:
                                             _DEFAULT_CHUNK_BYTES)))
     except ValueError:
         return _DEFAULT_CHUNK_BYTES
+
+
+def gc_grace_seconds() -> float:
+    try:
+        return max(0.0, float(os.environ.get(GC_GRACE_ENV,
+                                             _DEFAULT_GC_GRACE)))
+    except ValueError:
+        return _DEFAULT_GC_GRACE
 
 
 def hash_chunk(view) -> str:
@@ -63,6 +75,14 @@ class ChunkStore:
         h = hash_chunk(view)
         path = self._path(h)
         if os.path.exists(path):
+            # Refresh mtime so a dedup-reused chunk counts as "young" to a
+            # concurrent gc(): without this, a chunk referenced only by a
+            # step being evicted could be swept in the window between this
+            # existence check and our rank file publishing.
+            try:
+                os.utime(path, None)
+            except OSError:
+                pass
             return h, 0
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = os.path.join(self.dir, f".tmp_{uuid.uuid4().hex}")
@@ -115,13 +135,38 @@ class ChunkStore:
             out.update(os.listdir(p))
         return out
 
-    def gc(self, referenced: Set[str]) -> int:
-        """Delete chunks not in ``referenced``; returns deleted count."""
+    def gc(self, referenced: Set[str],
+           grace_seconds: Optional[float] = None) -> int:
+        """Delete chunks not in ``referenced``; returns deleted count.
+
+        Chunks younger than the grace window are kept even when
+        unreferenced: a rank persist writes (or utime-refreshes) its
+        chunks BEFORE publishing its rank file, so a concurrent sweep
+        computed from on-disk rank files would otherwise delete chunks an
+        about-to-commit step needs.  Also unlinks stale ``.tmp_*`` files
+        left in the store root by writers that crashed between the tmp
+        write and ``os.replace`` (``known_chunks`` never sees those, so
+        no other sweep reclaims them)."""
+        grace = gc_grace_seconds() if grace_seconds is None else grace_seconds
+        cutoff = time.time() - grace
         deleted = 0
         for h in self.known_chunks() - set(referenced):
+            path = self._path(h)
             try:
-                os.remove(self._path(h))
+                if os.path.getmtime(path) > cutoff:
+                    continue
+                os.remove(path)
                 deleted += 1
             except OSError:
                 pass
+        if os.path.isdir(self.dir):
+            for name in os.listdir(self.dir):
+                if not name.startswith(".tmp_"):
+                    continue
+                p = os.path.join(self.dir, name)
+                try:
+                    if os.path.getmtime(p) <= cutoff:
+                        os.remove(p)
+                except OSError:
+                    pass
         return deleted

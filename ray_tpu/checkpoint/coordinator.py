@@ -20,10 +20,13 @@ from ray_tpu.checkpoint import manifest as mf
 def commit_when_complete(root: str, step: int, world_size: int,
                          meta: Optional[dict] = None,
                          timeout: float = 120.0,
-                         poll_interval: float = 0.05) -> dict:
+                         poll_interval: float = 0.05,
+                         in_progress: Optional[List[int]] = None) -> dict:
     """Wait for every rank's shard file, then commit + sweep orphans.
     Raises TimeoutError (store untouched, previous checkpoint stands) if
-    the shards don't all land within ``timeout``."""
+    the shards don't all land within ``timeout``.  ``in_progress`` lists
+    steps with saves still in flight (e.g. pending async commits) so the
+    orphan sweep never deletes a step that is about to commit."""
     from ray_tpu._private import profiling
 
     t0 = time.perf_counter()
@@ -38,7 +41,7 @@ def commit_when_complete(root: str, step: int, world_size: int,
                 f"their shards within {timeout}s; not committing")
         time.sleep(poll_interval)
     manifest = mf.commit_manifest(root, step, world_size, meta=meta)
-    mf.gc_orphans(root, below=step)
+    mf.gc_orphans(root, in_progress=in_progress or (), below=step)
     profiling.record_span("checkpoint_commit", t0, time.perf_counter(),
                           step=int(step))
     return manifest
@@ -76,7 +79,13 @@ class AsyncCommitter:
                     time.sleep(poll)
                 manifest = mf.commit_manifest(root, step, world_size,
                                               meta=meta)
-                mf.gc_orphans(root, below=step)
+                # Sibling commits still pending (e.g. step N while we are
+                # N+1) have fully persisted, manifest-less dirs — exempt
+                # them from the sweep or we'd destroy a valid save in the
+                # window between its poll and its manifest rename.
+                with self._lock:
+                    pending = [s for s in self._threads if s != int(step)]
+                mf.gc_orphans(root, in_progress=pending, below=step)
                 if on_commit is not None:
                     on_commit(manifest)
             except BaseException as e:  # noqa: BLE001 — surfaced by flush
@@ -84,11 +93,18 @@ class AsyncCommitter:
                     self._errors.append(e)
             finally:
                 with self._lock:
-                    self._threads.pop(step, None)
+                    # A cancelled-then-resaved step re-registers under the
+                    # same key: only deregister if we still own it.
+                    if self._threads.get(int(step)) is t:
+                        self._threads.pop(int(step), None)
 
         t = threading.Thread(target=run, daemon=True,
                              name=f"ckpt-commit-{step}")
         with self._lock:
+            # A fresh save supersedes any stale cancellation of this step
+            # (a restart can roll training back and replay through a step
+            # whose earlier save was cancelled).
+            self._cancelled.discard(int(step))
             self._threads[int(step)] = t
         t.start()
 
@@ -97,6 +113,11 @@ class AsyncCommitter:
         writers): their step dirs become orphans for the next GC."""
         with self._lock:
             self._cancelled.update(self._threads.keys())
+
+    def pending_steps(self) -> List[int]:
+        """Steps whose commit threads are still registered."""
+        with self._lock:
+            return list(self._threads.keys())
 
     def flush(self, timeout: Optional[float] = None) -> None:
         with self._lock:
@@ -179,7 +200,9 @@ class DistributedCheckpointer:
                                 self.tree_fn, True, meta)
         manifest = commit_when_complete(self.root, step,
                                         self.group.num_hosts, meta=meta,
-                                        timeout=self.commit_timeout)
+                                        timeout=self.commit_timeout,
+                                        in_progress=self.committer
+                                        .pending_steps())
         self._post_commit(manifest)
         return manifest
 

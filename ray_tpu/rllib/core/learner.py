@@ -256,7 +256,10 @@ class DistributedLearnerGroup:
         from ray_tpu.checkpoint import manifest as mf
         from ray_tpu.checkpoint.coordinator import commit_when_complete
 
-        commit_when_complete(self._ckpt_root, step, self.group.num_hosts)
+        pending = (self._committer.pending_steps()
+                   if self._committer is not None else [])
+        commit_when_complete(self._ckpt_root, step, self.group.num_hosts,
+                             in_progress=pending)
         if self._ckpt_keep:
             try:
                 mf.evict_steps(self._ckpt_root, self._ckpt_keep)

@@ -21,6 +21,7 @@ import pickle
 import signal
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -246,7 +247,8 @@ def test_async_persist_and_poll_commit(tmp_path):
 
 
 # ---- eviction / GC ----
-def test_evict_steps_sweeps_unreferenced_chunks(tmp_path):
+def test_evict_steps_sweeps_unreferenced_chunks(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CHECKPOINT_GC_GRACE_SECONDS", "0")
     root = str(tmp_path)
     a = {"x": np.random.default_rng(1).normal(size=4096).astype(np.float32)}
     b = {"x": np.random.default_rng(2).normal(size=4096).astype(np.float32)}
@@ -260,6 +262,84 @@ def test_evict_steps_sweeps_unreferenced_chunks(tmp_path):
     assert len(store.known_chunks()) < n_before
     assert committed_steps(root) == [2, 3]
     np.testing.assert_array_equal(restore_tree(root, step=2)["x"], b["x"])
+
+
+def test_gc_grace_window_protects_inflight_chunks(tmp_path):
+    """The eviction sweep must not eat chunks a concurrent persist just
+    wrote (or dedup-reused) but whose rank file hasn't published yet:
+    young-mtime chunks survive gc even when no rank file references
+    them."""
+    store = ChunkStore(str(tmp_path), chunk_bytes=1024)
+    data = np.random.default_rng(7).integers(
+        0, 255, size=4096, dtype=np.uint8).tobytes()
+    hashes, _, _ = store.put_buffer(data)
+    # no rank file references these chunks, but they were written just now
+    assert store.gc(referenced=set(), grace_seconds=300.0) == 0
+    assert store.known_chunks() == set(hashes)
+    # a dedup hit refreshes mtime, pulling an old chunk back into the
+    # grace window
+    old = time.time() - 600
+    for h in hashes:
+        os.utime(store._path(h), (old, old))
+    store.put_buffer(data)  # pure reuse: writes nothing, refreshes mtime
+    assert store.gc(referenced=set(), grace_seconds=300.0) == 0
+    # outside the window the sweep proceeds
+    for h in hashes:
+        os.utime(store._path(h), (old, old))
+    assert store.gc(referenced=set(), grace_seconds=300.0) == len(hashes)
+    assert store.known_chunks() == set()
+
+
+def test_gc_reclaims_stale_tmp_files(tmp_path):
+    """A writer crashing between the tmp write and os.replace leaves
+    .tmp_* in chunks/; gc unlinks the stale ones (and only those)."""
+    store = ChunkStore(str(tmp_path), chunk_bytes=1024)
+    os.makedirs(store.dir, exist_ok=True)
+    stale = os.path.join(store.dir, ".tmp_deadbeef")
+    fresh = os.path.join(store.dir, ".tmp_cafebabe")
+    for p in (stale, fresh):
+        with open(p, "wb") as f:
+            f.write(b"partial chunk")
+    old = time.time() - 600
+    os.utime(stale, (old, old))
+    store.gc(referenced=set(), grace_seconds=300.0)
+    assert not os.path.exists(stale)
+    assert os.path.exists(fresh)  # may still be mid-write
+
+
+def test_gc_orphans_spares_in_progress_steps(tmp_path):
+    """A commit's orphan sweep must skip manifest-less step dirs whose
+    saves are still in flight (a sibling async commit between its shard
+    poll and its manifest rename)."""
+    root = str(tmp_path)
+    w = ShardWriter(root, rank=0, world_size=1)
+    w.persist(w.snapshot(_tree(1)), step=1)  # persisted, not committed
+    w.persist(w.snapshot(_tree(2)), step=2)
+    commit_when_complete(root, 2, 1, in_progress=[1])
+    assert os.path.isdir(mf.step_dir(root, 1))  # survived the sweep
+    commit_manifest(root, 1, 1)  # its commit now lands fine
+    assert committed_steps(root) == [1, 2]
+
+
+def test_committer_resave_supersedes_cancellation(tmp_path):
+    """cancel_pending() must not poison a step number forever: a fresh
+    save of a previously cancelled step commits normally (restarts can
+    roll training back and replay through a cancelled step)."""
+    from ray_tpu.checkpoint.coordinator import AsyncCommitter
+
+    root = str(tmp_path)
+    committer = AsyncCommitter()
+    # a save of step 1 whose writers died: shards never land
+    committer.commit_async(root, 1, 1, timeout=30.0)
+    committer.cancel_pending()
+    committer.flush()
+    assert latest_committed_step(root) is None
+    # post-restart replay saves step 1 again — this one must commit
+    w = ShardWriter(root, rank=0, world_size=1)
+    w.persist(w.snapshot(_tree(5)), step=1)
+    committer.commit_async(root, 1, 1, timeout=30.0)
+    committer.flush()
+    assert latest_committed_step(root) == 1
 
 
 def test_checkpoint_manager_eviction_deletes_dirs(tmp_path):
@@ -300,6 +380,22 @@ def test_manager_persists_to_storage_path(tmp_path):
     assert found.to_dict()["step"] == 2  # payload of the 3rd register
     # a fresh manager (driver restart) discovers the same pointer
     assert discover_latest_checkpoint(root).step == found.step
+
+
+def test_manager_restart_does_not_overwrite_committed_steps(tmp_path):
+    """A fresh manager over an existing store (elastic retry / driver
+    restart) must continue the step sequence past the committed steps —
+    not restart at 1 and clobber them while discovery keeps resuming
+    from the stale highest-numbered checkpoint."""
+    root = str(tmp_path)
+    mgr = CheckpointManager(CheckpointConfig(), storage_path=root)
+    for i in range(3):
+        mgr.register(Checkpoint.from_dict({"step": i}), {})
+    assert committed_steps(root) == [1, 2, 3]
+    mgr2 = CheckpointManager(CheckpointConfig(), storage_path=root)
+    mgr2.register(Checkpoint.from_dict({"step": 99}), {})
+    assert committed_steps(root) == [1, 2, 3, 4]
+    assert discover_latest_checkpoint(root).to_dict()["step"] == 99
 
 
 def test_sharded_checkpoint_to_dict_meta(tmp_path):
