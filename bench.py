@@ -335,8 +335,17 @@ def bench_ppo_real_env() -> dict:
     try:
         algo = (PPOConfig()
                 .environment("LunarLander-v3")
+                # Same learning hyperparams as r05 (4096 steps/iter, 6
+                # SGD epochs); the speed comes from the async rollout
+                # plane: streaming K=2-deep fragment production
+                # overlapping the SGD epochs, versioned async weight
+                # broadcast, and parallel (subprocess) env stepping on
+                # multicore hosts (env_parallelism="auto").
                 .rollouts(num_rollout_workers=2, num_envs_per_worker=8,
-                          rollout_fragment_length=256, mode="actor")
+                          rollout_fragment_length=256, mode="actor",
+                          sample_streaming=True,
+                          max_in_flight_per_worker=2,
+                          env_parallelism="auto")
                 .training(lr=3e-4, num_sgd_iter=6, sgd_minibatch_size=512,
                           entropy_coeff=0.01, gamma=0.999)
                 .debugging(seed=0)
@@ -361,7 +370,21 @@ def bench_ppo_real_env() -> dict:
         out["ppo_real_env_steps_per_s"] = round(steps_per_s)
         if last_reward == last_reward:
             out["ppo_real_env_reward"] = round(last_reward, 2)
-        algo.workers.stop()
+        # Where the remaining iteration time goes (ISSUE 5 satellite):
+        # idle fraction ~0 means the workers never wait on the learner;
+        # the version lag shows how far off-policy consumption runs.
+        stream = getattr(algo, "_stream", None)
+        if stream is not None:
+            st = stream.stats()
+            out["ppo_real_env_worker_idle_frac"] = round(
+                st["worker_idle_frac"], 4)
+            out["ppo_real_env_weight_lag_mean"] = round(
+                st["weight_lag_mean"], 3)
+            out["ppo_real_env_weight_lag_max"] = st["weight_lag_max"]
+            out["ppo_real_env_fragments_per_s"] = round(
+                st["fragments_per_s"], 2)
+            out["ppo_real_env_stale_dropped"] = st["stale_dropped"]
+        algo.stop()
         return out
     except Exception as e:  # noqa: BLE001 — bench must still emit a line,
         # and gate evidence gathered before the failure must survive it

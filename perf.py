@@ -190,6 +190,59 @@ def bench_checkpoint(size=64 * MB, chunk=1 * MB):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_rollout_plane(ray_tpu, fragments=24, num_workers=2, num_envs=4,
+                        fragment_length=64):
+    """Streaming rollout-plane envelope (no learner, native CPU env): the
+    driver consumes fragments from the SampleStream as fast as the worker
+    pool produces them, publishing a weight version every 4 fragments.
+    Reports fragments/s, env-steps/s, the weight-staleness histogram, and
+    the worker idle fraction — the same keys the bench's real-env PPO now
+    records, measured on the plane alone."""
+    import jax
+
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.env.py_envs import make_py_env
+    from ray_tpu.rllib.evaluation.sample_stream import SampleStream
+    from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+
+    config = (PPOConfig().environment("CartPole-v1")
+              .rollouts(num_rollout_workers=num_workers,
+                        num_envs_per_worker=num_envs,
+                        rollout_fragment_length=fragment_length,
+                        mode="actor")
+              .training(model={"fcnet_hiddens": [32]}))
+    spec = RLModuleSpec.for_env(make_py_env("CartPole-v1"),
+                                tuple(config.hiddens))
+    workers = WorkerSet(config, spec)
+    stream = SampleStream(workers, kind="gae", max_in_flight_per_worker=2,
+                          max_weight_staleness=4)
+    module = spec.build()
+    params = module.init(jax.random.PRNGKey(0), spec.example_obs())
+    stream.publish_weights(params)
+    stream.next_fragment(timeout=60.0)  # warmup: jit compiles on workers
+    t0 = time.perf_counter()
+    got = 0
+    for i in range(fragments):
+        if stream.next_fragment(timeout=60.0) is None:
+            break
+        got += 1
+        if (i + 1) % 4 == 0:
+            stream.publish_weights(params)
+    dt = time.perf_counter() - t0
+    st = stream.stats()
+    stream.close()
+    workers.stop()
+    steps = got * num_envs * fragment_length
+    return {
+        "rollout_fragments_per_s": got / dt,
+        "rollout_steps_per_s": steps / dt,
+        "rollout_worker_idle_frac": st["worker_idle_frac"],
+        "rollout_weight_lag_hist": st["weight_lag_hist"],
+        "rollout_stale_dropped": st["stale_dropped"],
+    }
+
+
 def bench_put_many_small(ray_tpu, n=2000, k=100):
     """Batched small puts: put_many coalesces the control plane, so the
     per-object cost is serialization + owner-store insert only."""
@@ -224,7 +277,9 @@ def main():
         out["memcpy_gb_per_s"], _ = bench_memcpy_gbps()
         out["get_gb_per_s"], _ = bench_get_gbps(ray_tpu)
         out.update(bench_checkpoint())
-        out = {k: round(v, 2) for k, v in out.items()}
+        out.update(bench_rollout_plane(ray_tpu))
+        out = {k: (round(v, 2) if isinstance(v, float) else v)
+               for k, v in out.items()}
         out["store"] = "arena" if args.native_arena == "1" else "segments"
         # Reference envelope for eyeballing (single node, release/
         # benchmarks/README.md: cluster-wide numbers; ray_perf.py runs

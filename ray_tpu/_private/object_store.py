@@ -18,8 +18,10 @@ Small objects never come here — they live in the in-process memory store
 """
 from __future__ import annotations
 
+import atexit
 import os
 import threading
+import weakref
 from collections import OrderedDict, deque
 from multiprocessing import resource_tracker, shared_memory
 from typing import Dict, Optional, Tuple
@@ -77,6 +79,72 @@ def forget_untracked(shm: shared_memory.SharedMemory):
     _process_owned.discard(name)
 
 
+# Every SharedMemory this process opens (create or attach) is tracked in
+# a weak set so interpreter shutdown can DEFUSE the mappings that still
+# have live C-level buffer exports.  Zero-copy reads hand numpy views
+# over segment mmaps to user code (sample batches, weights); when such a
+# view survives to interpreter teardown, SharedMemory.__del__ -> close()
+# -> mmap.close() raises "BufferError: cannot close exported pointers
+# exist" and CPython prints an ignored-exception traceback per segment —
+# the bench-tail spam.  The atexit hook below releases what is
+# releasable and detaches the rest (fd closed, mmap handle dropped; the
+# mapping itself dies with the process microseconds later).
+_live_shms: "weakref.WeakSet[shared_memory.SharedMemory]" = weakref.WeakSet()
+
+
+def track_for_exit(shm: shared_memory.SharedMemory
+                   ) -> shared_memory.SharedMemory:
+    _live_shms.add(shm)
+    return shm
+
+
+def defuse_shm(shm: shared_memory.SharedMemory) -> bool:
+    """Deterministically release a segment handle that may still have
+    exported buffer pointers.  Returns True when close() fully succeeded;
+    on a live export the mmap/fd handles are dropped so a later __del__
+    (or a second close()) is a silent no-op instead of a BufferError
+    traceback."""
+    try:
+        shm.close()
+        return True
+    except BufferError:
+        pass
+    except Exception:
+        return False
+    buf = getattr(shm, "_buf", None)
+    if buf is not None:
+        try:
+            buf.release()
+        except BufferError:
+            pass
+        shm._buf = None  # type: ignore[attr-defined]
+    # The mmap still has exporters (numpy views): leak the mapping — the
+    # process is exiting (or the last view owner will drop it) — but
+    # close the fd and clear the handles so __del__ cannot raise.
+    shm._mmap = None  # type: ignore[attr-defined]
+    fd = getattr(shm, "_fd", -1)
+    if fd >= 0:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+        shm._fd = -1  # type: ignore[attr-defined]
+    return False
+
+
+def _defuse_all_at_exit() -> None:
+    for shm in list(_live_shms):
+        try:
+            defuse_shm(shm)
+        except Exception:
+            pass
+
+
+# Registered at import (atexit is LIFO): runs AFTER the store/worker
+# shutdown hooks registered later, as the last line of defense.
+atexit.register(_defuse_all_at_exit)
+
+
 def attach(object_id: ObjectID,
            segment: Optional[str] = None) -> shared_memory.SharedMemory:
     """Attach to an existing sealed object's segment (any process on node).
@@ -85,7 +153,7 @@ def attach(object_id: ObjectID,
     bytes landed in a recycled pool segment (see SegmentPool)."""
     shm = shared_memory.SharedMemory(name=segment or _segment_name(object_id))
     untrack(shm)
-    return shm
+    return track_for_exit(shm)
 
 
 class SegmentPool:
@@ -141,6 +209,7 @@ class SegmentPool:
             name=f"{_PREFIX}pool_{os.getpid()}_{n}", create=True,
             size=cls_size)
         note_owned(shm)
+        track_for_exit(shm)
         self.created += 1
         return shm
 
@@ -266,10 +335,7 @@ def _unlink_quiet(shm: shared_memory.SharedMemory):
     except Exception:
         pass
     forget_untracked(shm)
-    try:
-        shm.close()
-    except Exception:
-        pass
+    defuse_shm(shm)
 
 
 class PlasmaObject:
@@ -415,6 +481,7 @@ class SharedMemoryStore:
                     name=_segment_name(object_id), create=True,
                     size=max(1, data_size))
                 note_owned(shm)
+                track_for_exit(shm)
             obj = PlasmaObject(shm, data_size, pool_class=pool_class)
             self._objects[object_id] = obj
             self.used += data_size
@@ -545,13 +612,13 @@ class SharedMemoryStore:
                     except Exception:
                         pass
                     forget_untracked(obj.shm)
-                    try:
-                        obj.shm.close()
-                    except BufferError:
-                        pass  # a reader's transient chunk slice still
-                        # borrows the mapping; it dies with the reader
-                    except Exception:
-                        pass
+                    # defuse, not plain close: when a reader's view still
+                    # borrows the mapping, a failed close() used to leave
+                    # the handles set and __del__ retried it at interpreter
+                    # shutdown — the BufferError traceback spam in the
+                    # bench tail.  Defusing drops the handles so the
+                    # mapping dies silently with its last view.
+                    defuse_shm(obj.shm)
                 if evicted and self.evict_callback is not None:
                     try:
                         self.evict_callback(object_id)

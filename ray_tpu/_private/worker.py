@@ -788,6 +788,7 @@ class CoreWorker:
         shm = shared_memory.SharedMemory(
             name=store_mod._segment_name(oid), create=True, size=max(1, size))
         store_mod.untrack(shm)
+        store_mod.track_for_exit(shm)
         view = shm.buf[:size]
         try:
             meta = ser.pack_into(s, view)
@@ -1120,6 +1121,7 @@ class CoreWorker:
                 name=store_mod._segment_name(oid), create=True,
                 size=max(1, size))
             store_mod.untrack(shm)
+            store_mod.track_for_exit(shm)
         except FileExistsError:
             # Another local reader is already landing this object; fall
             # through to a plain in-memory pull.

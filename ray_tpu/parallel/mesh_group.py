@@ -456,6 +456,57 @@ def _restart_metrics():
                     "failed MeshGroup gang-restart attempts"))
 
 
+class InflightWindow:
+    """Bounded window of dispatched-but-undrained work — the backpressure
+    primitive under both the step pipeline (gang-wide steps, below) and
+    the rollout plane's per-worker fragment streams
+    (rllib/evaluation/sample_stream.py): items append at dispatch,
+    ``over_depth`` tells the owner to drain the oldest before dispatching
+    more, so the producer side always holds queued work while the
+    consumer touches a result."""
+
+    __slots__ = ("depth", "_items")
+
+    def __init__(self, depth: int):
+        if depth < 1:
+            raise ValueError(f"window depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._items: collections.deque = collections.deque()
+
+    def append(self, item) -> None:
+        self._items.append(item)
+
+    def popleft(self):
+        return self._items.popleft()
+
+    def peek(self):
+        return self._items[0]
+
+    def remove(self, item) -> None:
+        self._items.remove(item)
+
+    def clear(self) -> list:
+        out, self._items = list(self._items), collections.deque()
+        return out
+
+    @property
+    def over_depth(self) -> bool:
+        return len(self._items) > self.depth
+
+    @property
+    def full(self) -> bool:
+        return len(self._items) >= self.depth
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+
 class _InflightStep:
     """One dispatched-but-undrained step: the per-rank futures plus the
     spec needed to resubmit it after a gang restart (the window is bounded
@@ -534,8 +585,7 @@ class StepPipeline:
         self.on_restart = on_restart
         self.on_result = on_result
         self.drain_timeout = drain_timeout
-        self._inflight: "collections.deque[_InflightStep]" = \
-            collections.deque()
+        self._inflight: InflightWindow = InflightWindow(depth)
         self._results: List[Any] = []
         self._next_idx = 0
         self._drained = 0
@@ -599,7 +649,7 @@ class StepPipeline:
             raise
         if self.on_restart is not None:
             self.on_restart(self.group)
-        base = self._inflight[0].idx if self._inflight else self._next_idx
+        base = self._inflight.peek().idx if self._inflight else self._next_idx
         self._seek(base)
         for step in self._inflight:
             self._dispatch(step)
@@ -611,7 +661,7 @@ class StepPipeline:
                 pass
 
     def _drain_one(self) -> None:
-        step = self._inflight[0]
+        step = self._inflight.peek()
         t0 = time.perf_counter()
         while True:
             try:
@@ -619,7 +669,7 @@ class StepPipeline:
                 break
             except exc.MeshGroupError as e:
                 self._recover(e)
-                step = self._inflight[0]
+                step = self._inflight.peek()
             except BaseException:
                 self._broken = True
                 raise
@@ -670,7 +720,7 @@ class StepPipeline:
         step = _InflightStep(idx, None, bool(fetch), fn, args, kwargs, 0.0)
         self._dispatch(step)
         self._inflight.append(step)
-        while len(self._inflight) > self.depth:
+        while self._inflight.over_depth:
             self._drain_one()
         return idx
 

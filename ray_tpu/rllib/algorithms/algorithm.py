@@ -58,6 +58,9 @@ class Algorithm:
         return metrics
 
     def stop(self):
+        stream = getattr(self, "_stream", None)
+        if stream is not None:
+            stream.close()
         workers = getattr(self, "workers", None)
         if workers is not None:
             workers.stop()

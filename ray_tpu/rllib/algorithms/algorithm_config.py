@@ -18,6 +18,17 @@ class AlgorithmConfig:
         self.num_envs_per_worker = 1
         self.rollout_fragment_length = 200
         self.mode = "anakin"  # "anakin" (on-device envs) | "actor" (CPU actors)
+        # streaming rollout plane (actor mode; see evaluation/sample_stream.py)
+        self.sample_streaming = True          # PPO/IMPALA actor samplers
+        self.max_in_flight_per_worker = 2     # fragment futures per worker
+        # Consumption gate: fragments acted under weights older than this
+        # many published versions are dropped before the learner sees
+        # them.  None disables the gate.
+        self.max_weight_staleness: Optional[int] = 4
+        # VectorEnv stepping: "serial" | "thread" | "subprocess" | "auto"
+        # (auto: subprocess when the actor's host has >= 4 cores).
+        self.env_parallelism = "serial"
+        self.num_env_workers: Optional[int] = None  # per rollout actor
         # anakin-specific
         self.num_envs = 64
         self.unroll_length = 128
@@ -72,7 +83,12 @@ class AlgorithmConfig:
     def rollouts(self, num_rollout_workers: Optional[int] = None,
                  num_envs_per_worker: Optional[int] = None,
                  rollout_fragment_length: Optional[int] = None,
-                 mode: Optional[str] = None):
+                 mode: Optional[str] = None,
+                 sample_streaming: Optional[bool] = None,
+                 max_in_flight_per_worker: Optional[int] = None,
+                 max_weight_staleness: Optional[int] = None,
+                 env_parallelism: Optional[str] = None,
+                 num_env_workers: Optional[int] = None):
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
             if mode is None and num_rollout_workers > 0:
@@ -83,6 +99,21 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if mode is not None:
             self.mode = mode
+        if sample_streaming is not None:
+            self.sample_streaming = bool(sample_streaming)
+        if max_in_flight_per_worker is not None:
+            self.max_in_flight_per_worker = int(max_in_flight_per_worker)
+        if max_weight_staleness is not None:
+            self.max_weight_staleness = max_weight_staleness
+        if env_parallelism is not None:
+            if env_parallelism not in ("serial", "thread", "subprocess",
+                                       "auto"):
+                raise ValueError(
+                    f"env_parallelism must be serial|thread|subprocess|"
+                    f"auto, got {env_parallelism!r}")
+            self.env_parallelism = env_parallelism
+        if num_env_workers is not None:
+            self.num_env_workers = int(num_env_workers)
         return self
 
     def env_runners(self, **kw):  # new-stack alias

@@ -196,50 +196,38 @@ class IMPALA(Algorithm):
             self.module, self._make_loss(),
             optimizer=tx, example_obs=example, seed=self.config.seed)
         self.workers = WorkerSet(self.config, spec)
-        self.workers.sync_weights(self.learner.get_weights())
-        self._inflight: Dict[Any, Any] = {}
+        from ray_tpu.rllib.evaluation.sample_stream import SampleStream
+
+        # The streaming rollout plane (sample_stream.py): K fragments in
+        # flight per worker, versioned async weight broadcast, bounded
+        # staleness — V-trace's behaviour/target correction absorbs the
+        # staleness natively, so the gate here is a safety bound, not a
+        # correctness requirement.
+        self._stream = SampleStream(
+            self.workers, kind="timemajor",
+            max_in_flight_per_worker=self.config.max_in_flight_per_worker,
+            max_weight_staleness=self.config.max_weight_staleness)
+        self._stream.publish_weights(self.learner.get_weights())
         self._updates_since_broadcast = 0
 
     def _training_step_actor(self) -> Dict[str, Any]:
-        import ray_tpu
-
-        # Keep one sample request in flight per worker (async pipeline).
-        for w in self.workers.workers:
-            if not any(wk is w for wk, _ in self._inflight.items()):
-                self._inflight[w] = w.sample_timemajor.remote()
         metrics: Dict[str, Any] = {}
         ep_returns = []
         target_updates = max(1, len(self.workers.workers))
         updates = 0
+        steps = 0
         while updates < target_updates:
-            futs = list(self._inflight.values())
-            if not futs:
+            frag = self._stream.next_fragment(timeout=120.0)
+            if frag is None:
                 break
-            ready, _ = ray_tpu.wait(futs, num_returns=1, timeout=120)
-            if not ready:
-                break
-            fut = ready[0]
-            worker = next(w for w, f in self._inflight.items() if f is fut)
-            del self._inflight[worker]
-            try:
-                batch, eps = ray_tpu.get(fut)
-            except ray_tpu.exceptions.RayTpuError:
-                # Feed the FT manager (it may replace the worker), then
-                # re-seed any worker slot with nothing in flight so the
-                # pipeline never drains to empty.
-                self.workers.report_failure(worker)
-                for w in self.workers.workers:
-                    if w not in self._inflight:
-                        self._inflight[w] = w.sample_timemajor.remote()
-                continue
-            ep_returns.extend(eps)
-            metrics = self.learner.update(batch)
+            ep_returns.extend(frag.episode_returns)
+            metrics = self.learner.update(frag.batch)
             updates += 1
+            steps += frag.env_steps
             self._updates_since_broadcast += 1
             if self._updates_since_broadcast >= self.config.broadcast_interval:
-                self.workers.sync_weights(self.learner.get_weights())
+                self._stream.publish_weights(self.learner.get_weights())
                 self._updates_since_broadcast = 0
-            self._inflight[worker] = worker.sample_timemajor.remote()
         if metrics:
             from ray_tpu.rllib.core.learner import metrics_to_host
 
@@ -248,7 +236,13 @@ class IMPALA(Algorithm):
             self._ep_reward_ema = float(np.mean(ep_returns))
         metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",
                                                  float("nan"))
-        metrics["num_env_steps_sampled_this_iter"] = (
-            updates * self.config.rollout_fragment_length
-            * self.config.num_envs_per_worker)
+        metrics["num_env_steps_sampled_this_iter"] = steps
+        st = self._stream.stats()
+        metrics.update({
+            "rollout_fragments_per_s": st["fragments_per_s"],
+            "rollout_weight_lag_mean": st["weight_lag_mean"],
+            "rollout_weight_lag_max": st["weight_lag_max"],
+            "rollout_worker_idle_frac": st["worker_idle_frac"],
+            "rollout_stale_dropped": st["stale_dropped"],
+        })
         return metrics

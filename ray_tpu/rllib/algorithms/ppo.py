@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import ray_tpu
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
@@ -374,13 +375,23 @@ class PPO(Algorithm):
                               entropy_coeff=self.config.entropy_coeff),
             optimizer=tx, example_obs=example, seed=self.config.seed)
         self.workers = WorkerSet(self.config, spec)
-        self.workers.sync_weights(self.learner.get_weights())
+        self._stream = None
+        if self.config.sample_streaming:
+            from ray_tpu.rllib.evaluation.sample_stream import SampleStream
 
-    def _training_step_actor(self) -> Dict[str, Any]:
-        from ray_tpu.rllib.policy.sample_batch import SampleBatch
+            self._stream = SampleStream(
+                self.workers, kind="gae",
+                max_in_flight_per_worker=self.config.max_in_flight_per_worker,
+                max_weight_staleness=self.config.max_weight_staleness)
+            # Version 1 lands before the first fragment dispatch (FIFO
+            # mailboxes), so no worker ever samples with params=None.
+            self._stream.publish_weights(self.learner.get_weights())
+        else:
+            self.workers.sync_weights(self.learner.get_weights())
 
-        batches, ep_returns = self.workers.sample_sync()
-        train_batch = SampleBatch.concat_samples(batches)
+    def _run_ppo_epochs(self, train_batch) -> Dict[str, Any]:
+        """The shared SGD half of both actor paths: advantage
+        normalization + shuffled minibatch epochs on the learner."""
         adv = train_batch["advantages"]
         train_batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
         metrics: Dict[str, Any] = {}
@@ -393,7 +404,51 @@ class PPO(Algorithm):
             from ray_tpu.rllib.core.learner import metrics_to_host
 
             metrics = metrics_to_host(metrics)
-        self.workers.sync_weights(self.learner.get_weights())
+        return metrics
+
+    def _training_step_actor(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+        if self._stream is None:
+            # Legacy lockstep path (sample_streaming=False): barrier
+            # sample -> train -> blocking weight sync.
+            batches, ep_returns = self.workers.sample_sync()
+            train_batch = SampleBatch.concat_samples(batches)
+            metrics = self._run_ppo_epochs(train_batch)
+            self.workers.sync_weights(self.learner.get_weights())
+        else:
+            # Streaming path: consume one fragment per worker slot as
+            # they land — while the SGD epochs below run, every worker
+            # still holds queued fragment work (the overlap the smoke
+            # guards), and the new weights broadcast asynchronously.
+            target = max(1, self.config.num_rollout_workers)
+            batches, ep_returns = [], []
+            for _ in range(target):
+                frag = self._stream.next_fragment(timeout=120.0)
+                if frag is None:
+                    break
+                batches.append(frag.batch)
+                ep_returns.extend(frag.episode_returns)
+            if not batches:
+                raise ray_tpu.exceptions.RayTpuError(
+                    "rollout stream produced no fragments within timeout")
+            # Reuse last iteration's concat buffer (the learner consumed
+            # it during the previous SGD epochs) — one batch-sized
+            # allocation less per iteration.
+            train_batch = SampleBatch.concat_samples_into(
+                batches, getattr(self, "_train_buf", None))
+            self._train_buf = train_batch
+            metrics = self._run_ppo_epochs(train_batch)
+            self._stream.publish_weights(self.learner.get_weights())
+            st = self._stream.stats()
+            metrics.update({
+                "rollout_fragments_per_s": st["fragments_per_s"],
+                "rollout_weight_lag_mean": st["weight_lag_mean"],
+                "rollout_weight_lag_max": st["weight_lag_max"],
+                "rollout_worker_idle_frac": st["worker_idle_frac"],
+                "rollout_queue_depth": st["inflight"],
+                "rollout_stale_dropped": st["stale_dropped"],
+            })
         if ep_returns:
             self._ep_reward_ema = float(np.mean(ep_returns))
         metrics["episode_reward_mean"] = getattr(self, "_ep_reward_ema",

@@ -227,26 +227,164 @@ def make_py_env(name: str, seed: Optional[int] = None):
     return GymEnvAdapter(name, seed)
 
 
+def _step_one(env, action):
+    """One env step with the vector contract: scalar actions cast to int,
+    auto-reset on termination.  The ONE copy of the per-env semantics, so
+    serial/thread/subprocess modes are step-equivalent by construction."""
+    o, r, term, trunc, info = env.step(
+        int(action) if np.ndim(action) == 0 else action)
+    done = term or trunc
+    if done:
+        o = env.reset()
+    return o, r, done, info
+
+
+def _resolve_mode(mode: str, num_envs: int) -> str:
+    if mode != "auto":
+        return mode
+    import os
+
+    # Parallel stepping only pays when there are cores to step on and
+    # enough envs to amortize the per-step fan-out.
+    if (os.cpu_count() or 1) >= 4 and num_envs >= 4:
+        return "subprocess"
+    return "serial"
+
+
+def _subproc_env_main(conn, env_fn_blob: bytes, indices, num_total: int,
+                      seed: int):
+    """Child process of a subprocess-mode VectorEnv: owns a slice of envs,
+    steps them on command, and writes observations straight into the
+    parent's shared-memory obs buffer (zero-copy hand-back; rewards/dones
+    are tiny and ride the pipe reply)."""
+    import cloudpickle
+    import numpy as np
+
+    env_fn = cloudpickle.loads(env_fn_blob)
+    envs = [env_fn() for _ in indices]
+    probe = None
+    for e, gi in zip(envs, indices):
+        o = e.reset(seed + gi)
+        if probe is None:
+            probe = np.asarray(o)
+    conn.send(("meta", tuple(probe.shape), probe.dtype.str))
+    shm, obs_view = None, None
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return  # parent died: exit quietly
+            cmd = msg[0]
+            if cmd == "attach":
+                from multiprocessing import shared_memory
+
+                shm = shared_memory.SharedMemory(name=msg[1])
+                # NO untrack here: a spawn child shares the parent's
+                # resource-tracker daemon, so unregistering would strip
+                # the parent's registration and make its eventual
+                # unlink() a tracker KeyError.  The attach-side register
+                # dedups into the parent's entry.
+                obs_view = np.ndarray((num_total,) + tuple(probe.shape),
+                                      dtype=np.dtype(msg[2]), buffer=shm.buf)
+                conn.send(("ok",))
+            elif cmd == "reset":
+                for e, gi in zip(envs, indices):
+                    obs_view[gi] = e.reset()
+                conn.send(("ok",))
+            elif cmd == "step":
+                actions = msg[1]
+                rews, dones, infos = [], [], []
+                for a, e, gi in zip(actions, envs, indices):
+                    o, r, done, info = _step_one(e, a)
+                    obs_view[gi] = o
+                    rews.append(r)
+                    dones.append(done)
+                    infos.append(info)
+                conn.send((np.asarray(rews, np.float32),
+                           np.asarray(dones), infos))
+            elif cmd == "close":
+                conn.send(("ok",))
+                return
+    finally:
+        for e in envs:
+            if hasattr(e, "close"):
+                try:
+                    e.close()
+                except Exception:
+                    pass
+        if obs_view is not None:
+            del obs_view
+        if shm is not None:
+            try:
+                shm.close()
+            except Exception:
+                pass
+
+
+def _slice_indices(num_envs: int, num_workers: int) -> List[List[int]]:
+    """Contiguous env-index slices, one per worker (serial order preserved
+    inside each slice so trajectories match the serial mode exactly)."""
+    base, rem = divmod(num_envs, num_workers)
+    out, start = [], 0
+    for w in range(num_workers):
+        n = base + (1 if w < rem else 0)
+        out.append(list(range(start, start + n)))
+        start += n
+    return [s for s in out if s]
+
+
 class VectorEnv:
-    """N python envs stepped together (reference: rllib/env/vector_env.py)."""
+    """N python envs stepped together (reference: rllib/env/vector_env.py
+    + the subprocess fan-out of vector_env.py's remote modes).
 
-    def __init__(self, env_fn, num_envs: int, seed: int = 0):
-        self.envs = [env_fn() for _ in range(num_envs)]
+    ``mode``:
+
+    - ``"serial"`` (default): step envs in a python loop in this process.
+    - ``"thread"``: persistent worker threads each own a contiguous slice
+      of envs and step them concurrently, writing into preallocated
+      [N, ...] buffers.  Pays off when env.step releases the GIL
+      (numpy/C-backed dynamics); GIL-bound envs see no speedup but
+      identical trajectories.
+    - ``"subprocess"``: one child process per slice — true parallelism for
+      GIL-bound envs (Box2D, ALE).  Observations come back through a
+      preallocated shared-memory buffer (a recycled SegmentPool segment,
+      the PR 3 object-plane allocator), so the per-step IPC payload is
+      one tiny action message + reward/done reply per worker.
+    - ``"auto"``: subprocess when the host has >= 4 cores and >= 4 envs,
+      else serial.
+
+    All modes are step-equivalent: same seeds => identical trajectories
+    (guarded by tests/test_rollout_plane.py).
+    """
+
+    def __init__(self, env_fn, num_envs: int, seed: int = 0,
+                 mode: str = "serial", num_workers: Optional[int] = None):
         self.num_envs = num_envs
-        for i, e in enumerate(self.envs):
-            e.reset(seed + i)
+        self.mode = _resolve_mode(mode, num_envs)
+        if self.mode not in ("serial", "thread", "subprocess"):
+            raise ValueError(f"unknown VectorEnv mode {mode!r}")
+        import os
 
-    def reset_all(self) -> np.ndarray:
-        return np.stack([e.reset() for e in self.envs])
+        if num_workers is None:
+            num_workers = min(num_envs,
+                              max(2, (os.cpu_count() or 2) // 2))
+        self.num_workers = max(1, min(int(num_workers), num_envs))
+        self.envs: List[Any] = []
+        if self.mode == "subprocess":
+            self._setup_subprocess(env_fn, seed)
+        else:
+            self.envs = [env_fn() for _ in range(num_envs)]
+            for i, e in enumerate(self.envs):
+                e.reset(seed + i)
+            if self.mode == "thread":
+                self._setup_threads()
 
-    def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[dict]]:
+    # ---- serial ---------------------------------------------------------
+    def _step_serial(self, actions):
         obs, rews, dones, infos = [], [], [], []
         for e, a in zip(self.envs, actions):
-            o, r, term, trunc, info = e.step(
-                int(a) if np.ndim(a) == 0 else a)
-            done = term or trunc
-            if done:
-                o = e.reset()
+            o, r, done, info = _step_one(e, a)
             obs.append(o)
             rews.append(r)
             dones.append(done)
@@ -254,7 +392,222 @@ class VectorEnv:
         return (np.stack(obs), np.asarray(rews, np.float32),
                 np.asarray(dones), infos)
 
+    # ---- threads --------------------------------------------------------
+    def _setup_threads(self):
+        import threading
+
+        self._slices = _slice_indices(self.num_envs, self.num_workers)
+        self._cv = threading.Condition()
+        self._epoch = 0
+        self._cmd: Optional[str] = None
+        self._actions = None
+        self._pending = 0
+        self._err: Optional[BaseException] = None
+        self._obs_buf = None  # allocated on first step/reset (shape probe)
+        self._rew_buf = np.zeros(self.num_envs, np.float32)
+        self._done_buf = np.zeros(self.num_envs, bool)
+        self._info_buf: List[dict] = [{} for _ in range(self.num_envs)]
+        self._threads = [
+            threading.Thread(target=self._thread_main, args=(sl,),
+                             name=f"rtpu-env-{i}", daemon=True)
+            for i, sl in enumerate(self._slices)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _ensure_obs_buf(self, probe: np.ndarray):
+        if self._obs_buf is None:
+            self._obs_buf = np.zeros((self.num_envs,) + probe.shape,
+                                     probe.dtype)
+
+    def _thread_main(self, indices: List[int]):
+        local_epoch = 0
+        while True:
+            with self._cv:
+                while self._epoch == local_epoch:
+                    self._cv.wait()
+                local_epoch = self._epoch
+                cmd, actions = self._cmd, self._actions
+            if cmd == "close":
+                return
+            try:
+                if cmd == "reset":
+                    for gi in indices:
+                        self._obs_buf[gi] = self.envs[gi].reset()
+                else:
+                    for gi in indices:
+                        o, r, done, info = _step_one(self.envs[gi],
+                                                     actions[gi])
+                        self._obs_buf[gi] = o
+                        self._rew_buf[gi] = r
+                        self._done_buf[gi] = done
+                        self._info_buf[gi] = info
+            except BaseException as e:  # noqa: BLE001 — surfaced to caller
+                with self._cv:
+                    self._err = e
+                    self._pending -= 1
+                    self._cv.notify_all()
+                continue
+            with self._cv:
+                self._pending -= 1
+                self._cv.notify_all()
+
+    def _run_threads(self, cmd: str, actions=None):
+        with self._cv:
+            self._cmd, self._actions = cmd, actions
+            self._pending = len(self._threads)
+            self._err = None
+            self._epoch += 1
+            self._cv.notify_all()
+            while self._pending > 0:
+                self._cv.wait()
+            if self._err is not None:
+                raise self._err
+
+    def _step_thread(self, actions):
+        if self._obs_buf is None:
+            raise RuntimeError(
+                "thread-mode VectorEnv: call reset_all() before step() "
+                "(the first reset defines the obs buffer shape)")
+        self._run_threads("step", np.asarray(actions))
+        return (self._obs_buf.copy(), self._rew_buf.copy(),
+                self._done_buf.copy(), list(self._info_buf))
+
+    # ---- subprocesses ---------------------------------------------------
+    def _setup_subprocess(self, env_fn, seed: int):
+        import multiprocessing as mp
+
+        import cloudpickle
+
+        self._slices = _slice_indices(self.num_envs, self.num_workers)
+        ctx = mp.get_context("spawn")
+        blob = cloudpickle.dumps(env_fn)
+        self._conns, self._procs = [], []
+        for sl in self._slices:
+            parent, child = ctx.Pipe()
+            p = ctx.Process(target=_subproc_env_main,
+                            args=(child, blob, sl, self.num_envs, seed),
+                            daemon=True)
+            p.start()
+            child.close()
+            self._conns.append(parent)
+            self._procs.append(p)
+        metas = [self._recv(c) for c in self._conns]
+        shape, dtype = tuple(metas[0][1]), np.dtype(metas[0][2])
+        self._obs_shape, self._obs_dtype = shape, dtype
+        nbytes = int(np.prod((self.num_envs,) + shape)) * dtype.itemsize
+        self._shm, self._shm_pool_class, self._pool = \
+            self._alloc_obs_segment(max(1, nbytes))
+        self._obs_np = np.ndarray((self.num_envs,) + shape, dtype,
+                                  buffer=self._shm.buf)
+        self._obs_np[:] = 0
+        for c in self._conns:
+            c.send(("attach", self._shm.name, dtype.str))
+        for c in self._conns:
+            self._recv(c)
+
+    @staticmethod
+    def _alloc_obs_segment(nbytes: int):
+        """Obs buffer segment: a recycled SegmentPool segment (pre-faulted,
+        power-of-two class) when poolable, else a dedicated segment."""
+        from multiprocessing import shared_memory
+
+        from ray_tpu._private.object_store import SegmentPool, note_owned
+
+        pool = SegmentPool(max_bytes=2 * SegmentPool.MIN_CLASS + 2 * nbytes)
+        acq = pool.acquire(nbytes)
+        if acq is not None:
+            shm, cls = acq
+            return shm, cls, pool
+        import os
+
+        shm = shared_memory.SharedMemory(
+            name=f"rtpu_venv_{os.getpid()}_{id(pool) & 0xffffff:x}",
+            create=True, size=nbytes)
+        note_owned(shm)
+        return shm, None, pool
+
+    def _recv(self, conn):
+        try:
+            return conn.recv()
+        except (EOFError, OSError) as e:
+            raise RuntimeError(
+                "VectorEnv subprocess died (env worker crashed or was "
+                "killed)") from e
+
+    def _step_subprocess(self, actions):
+        actions = np.asarray(actions)
+        for c, sl in zip(self._conns, self._slices):
+            c.send(("step", actions[sl[0]: sl[-1] + 1]))
+        rews = np.zeros(self.num_envs, np.float32)
+        dones = np.zeros(self.num_envs, bool)
+        infos: List[dict] = [{}] * self.num_envs
+        for c, sl in zip(self._conns, self._slices):
+            r, d, inf = self._recv(c)
+            rews[sl[0]: sl[-1] + 1] = r
+            dones[sl[0]: sl[-1] + 1] = d
+            infos[sl[0]: sl[-1] + 1] = inf
+        return self._obs_np.copy(), rews, dones, infos
+
+    # ---- public API ------------------------------------------------------
+    def reset_all(self) -> np.ndarray:
+        if self.mode == "subprocess":
+            for c in self._conns:
+                c.send(("reset",))
+            for c in self._conns:
+                self._recv(c)
+            return self._obs_np.copy()
+        if self.mode == "thread":
+            if self._obs_buf is None:
+                # First reset_all runs inline: the first obs defines the
+                # buffer shape/dtype.  Each env resets exactly once (same
+                # RNG draws as serial mode).
+                first = np.asarray(self.envs[0].reset())
+                self._ensure_obs_buf(first)
+                self._obs_buf[0] = first
+                for gi in range(1, self.num_envs):
+                    self._obs_buf[gi] = self.envs[gi].reset()
+                return self._obs_buf.copy()
+            self._run_threads("reset")
+            return self._obs_buf.copy()
+        return np.stack([e.reset() for e in self.envs])
+
+    def step(self, actions) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[dict]]:
+        if self.mode == "subprocess":
+            return self._step_subprocess(actions)
+        if self.mode == "thread":
+            return self._step_thread(actions)
+        return self._step_serial(actions)
+
     def close(self):
+        if self.mode == "subprocess":
+            for c in self._conns:
+                try:
+                    c.send(("close",))
+                except (BrokenPipeError, OSError):
+                    pass
+            for p in self._procs:
+                p.join(timeout=5.0)
+                if p.is_alive():
+                    p.terminate()
+            for c in self._conns:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            del self._obs_np
+            from ray_tpu._private.object_store import _unlink_quiet
+
+            _unlink_quiet(self._shm)
+            self._pool.close()
+            return
+        if self.mode == "thread":
+            with self._cv:
+                self._cmd = "close"
+                self._epoch += 1
+                self._cv.notify_all()
+            for t in self._threads:
+                t.join(timeout=5.0)
         for e in self.envs:
             if hasattr(e, "close"):
                 e.close()

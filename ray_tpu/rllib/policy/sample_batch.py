@@ -52,9 +52,7 @@ class SampleBatch(dict):
         return SampleBatch.concat_samples(SampleBatch.gather(refs))
 
     @staticmethod
-    def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
-        if not batches:
-            return SampleBatch()
+    def _check_columns(batches: List["SampleBatch"]) -> set:
         keys = set(batches[0].keys())
         for b in batches[1:]:
             if set(b.keys()) != keys:
@@ -63,8 +61,49 @@ class SampleBatch(dict):
                 raise ValueError(
                     "concat_samples requires identical columns; got "
                     f"{sorted(keys)} vs {sorted(b.keys())}")
+        return keys
+
+    @staticmethod
+    def concat_samples(batches: List["SampleBatch"]) -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = SampleBatch._check_columns(batches)
         return SampleBatch({
             k: np.concatenate([b[k] for b in batches]) for k in keys})
+
+    @staticmethod
+    def concat_samples_into(batches: List["SampleBatch"],
+                            out: Optional["SampleBatch"]) -> "SampleBatch":
+        """``concat_samples`` with destination reuse: when ``out`` (a
+        previous result) already has matching shapes/dtypes, fragment rows
+        are copied into its arrays instead of allocating a fresh batch —
+        the streaming consumer concatenates one train batch per iteration,
+        so reuse removes a full batch-sized allocation + GC churn from the
+        per-iteration hot path.  The caller must be done with ``out``'s
+        previous contents (the learner has consumed them)."""
+        if not batches:
+            return SampleBatch()
+        keys = SampleBatch._check_columns(batches)
+        total = sum(len(b) for b in batches)
+        result: Dict[str, np.ndarray] = {}
+        for k in keys:
+            first = np.asarray(batches[0][k])
+            shape = (total,) + first.shape[1:]
+            dst = None
+            if out is not None:
+                prev = out.get(k)
+                if prev is not None and prev.shape == shape \
+                        and prev.dtype == first.dtype:
+                    dst = prev
+            if dst is None:
+                dst = np.empty(shape, first.dtype)
+            pos = 0
+            for b in batches:
+                arr = b[k]
+                dst[pos:pos + len(arr)] = arr
+                pos += len(arr)
+            result[k] = dst
+        return SampleBatch(result)
 
     def shuffle(self, seed: Optional[int] = None) -> "SampleBatch":
         idx = np.random.default_rng(seed).permutation(len(self))

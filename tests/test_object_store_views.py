@@ -73,3 +73,65 @@ def test_reader_chunk_slices_survive_parent_reclaim(store):
     store.delete(oid)
     assert bytes(chunk) == b"abab"  # still valid until the reader drops it
     del chunk
+
+
+def test_defuse_shm_silences_del_with_live_exports():
+    """The interpreter-shutdown guard (ISSUE 5 satellite): a segment whose
+    mmap still has C-level buffer exports (numpy views) cannot close() —
+    defuse_shm must drop the handles so SharedMemory.__del__'s close() is
+    a silent no-op instead of the bench-tail BufferError traceback."""
+    from multiprocessing import shared_memory
+
+    import numpy as np
+
+    from ray_tpu._private import object_store as store_mod
+
+    shm = shared_memory.SharedMemory(create=True, size=4096)
+    store_mod.note_owned(shm)
+    store_mod.track_for_exit(shm)
+    arr = np.frombuffer(shm.buf, dtype=np.uint8)  # live C-level export
+    arr[:4] = 7
+    name = shm.name
+    assert store_mod.defuse_shm(shm) is False  # export kept close() from
+    # completing, but the handles are gone:
+    assert getattr(shm, "_mmap", None) is None
+    assert getattr(shm, "_fd", -1) == -1
+    shm.close()  # what __del__ does at interpreter shutdown — now silent
+    assert (arr[:4] == 7).all()  # the mapping survives for the exporter
+    del arr
+    # Clean the name from /dev/shm (a fresh handle owns the unlink).
+    cleanup = shared_memory.SharedMemory(name=name)
+    store_mod.untrack(cleanup)
+    cleanup.close()
+    try:
+        cleanup.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def test_exit_guard_defuses_tracked_segments():
+    """_defuse_all_at_exit walks every tracked handle: segments with live
+    exports are defused, fully-closeable ones are closed."""
+    from multiprocessing import shared_memory
+
+    import numpy as np
+
+    from ray_tpu._private import object_store as store_mod
+
+    a = shared_memory.SharedMemory(create=True, size=1024)
+    b = shared_memory.SharedMemory(create=True, size=1024)
+    for s in (a, b):
+        store_mod.note_owned(s)
+        store_mod.track_for_exit(s)
+    view = np.frombuffer(a.buf, dtype=np.uint8)  # pin a only
+    store_mod._defuse_all_at_exit()
+    assert getattr(a, "_mmap", None) is None  # defused (export live)
+    assert getattr(b, "_mmap", None) is None  # plain-closed
+    a.close()  # both now silent under __del__-style retries
+    b.close()
+    del view
+    for s in (a, b):
+        try:
+            shared_memory.SharedMemory(name=s.name).unlink()
+        except FileNotFoundError:
+            pass

@@ -7,6 +7,7 @@ import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 from tools.perf_smoke import (
     run_checkpoint_smoke,
     run_object_plane_smoke,
+    run_rollout_smoke,
     run_smoke,
 )
 
@@ -31,6 +32,18 @@ def test_checkpoint_overlap_smoke(shutdown_only):
     assert out["committed_step"] == 1, out
     assert out["restore_ok"], out
     assert out["ok"]
+
+
+def test_rollout_plane_smoke(shutdown_only):
+    """The streaming rollout plane must overlap sampling with learning
+    (a fragment is consumed while others are still in flight / being
+    produced) and broadcast weights as ONE put per version — the tier-1
+    guard for ISSUE 5's async rollout plane."""
+    out = run_rollout_smoke()
+    assert out["one_put_per_version"], f"broadcast fan-out regressed: {out}"
+    assert out["inflight_ok"], f"stream drained at consume time: {out}"
+    assert out["produce_consume_overlap"], f"lockstep sampling: {out}"
+    assert out["ok"], out
 
 
 def test_object_plane_smoke(shutdown_only):

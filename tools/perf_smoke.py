@@ -253,6 +253,105 @@ def run_checkpoint_smoke(steps: int = STEPS, depth: int = DEPTH) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_rollout_smoke(fragments: int = 6, k: int = 2,
+                      consume_s: float = 0.05) -> dict:
+    """Rollout-plane invariants (tier-1 guard for ISSUE 5):
+
+    1. **Sample/learn overlap**: with 2 workers and K=2 fragments in
+       flight, the learner consuming a fragment never drains production —
+       at every consume the stream still holds in-flight fragment
+       futures, and at least one consumed fragment's worker-side
+       production interval overlaps a (simulated) learner consume
+       interval of a DIFFERENT fragment wall-clock.
+    2. **One put per version**: publishing W weight versions to N workers
+       performs exactly W object-store puts (one ref, N borrowers), not
+       W*N.
+    """
+    import jax
+
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.env.py_envs import make_py_env
+    from ray_tpu.rllib.evaluation.sample_stream import SampleStream
+    from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    try:
+        config = (PPOConfig().environment("CartPole-v1")
+                  .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                            rollout_fragment_length=16, mode="actor")
+                  .training(model={"fcnet_hiddens": [16]}))
+        spec = RLModuleSpec.for_env(make_py_env("CartPole-v1"),
+                                    tuple(config.hiddens))
+        workers = WorkerSet(config, spec)
+        stream = SampleStream(workers, kind="gae",
+                              max_in_flight_per_worker=k)
+        module = spec.build()
+        params = module.init(jax.random.PRNGKey(0), spec.example_obs())
+
+        puts = []
+        orig_put = ray_tpu.put
+
+        def counting_put(value):
+            puts.append(1)
+            return orig_put(value)
+
+        ray_tpu.put = counting_put
+        try:
+            versions = 3
+            for _ in range(versions):
+                stream.publish_weights(params)
+        finally:
+            ray_tpu.put = orig_put
+
+        import time
+
+        produce_iv, consume_iv = [], []
+        inflight_at_consume = []
+        got = 0
+        for _ in range(fragments):
+            frag = stream.next_fragment(timeout=60.0)
+            if frag is None:
+                break
+            got += 1
+            inflight_at_consume.append(stream.inflight)
+            c0 = time.time()
+            time.sleep(consume_s)  # the simulated learner update
+            consume_iv.append((c0, time.time()))
+            produce_iv.append((frag.info["produce_start"],
+                               frag.info["produce_end"]))
+        stream.close()
+        workers.stop()
+
+        # Overlap: some fragment j was being PRODUCED while the learner
+        # was consuming some other fragment i (wall clock; worker stamps
+        # use time.time(), comparable across same-host processes).
+        overlap = any(
+            ps < ce and pe > cs
+            for j, (ps, pe) in enumerate(produce_iv)
+            for i, (cs, ce) in enumerate(consume_iv)
+            if i != j)
+        out = {
+            "fragments": got,
+            "k": k,
+            "weight_versions": versions,
+            "weight_puts": len(puts),
+            "one_put_per_version": len(puts) == versions,
+            "min_inflight_at_consume": min(inflight_at_consume or [0]),
+            "inflight_ok": bool(inflight_at_consume
+                                and min(inflight_at_consume) >= 1),
+            "produce_consume_overlap": overlap,
+        }
+        out["ok"] = bool(got == fragments and out["one_put_per_version"]
+                         and out["inflight_ok"]
+                         and out["produce_consume_overlap"])
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
@@ -260,7 +359,9 @@ def main() -> int:
     out["object_plane"] = obj
     ckpt = run_checkpoint_smoke()
     out["checkpoint"] = ckpt
-    out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"])
+    roll = run_rollout_smoke()
+    out["rollout"] = roll
+    out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
