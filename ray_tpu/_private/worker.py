@@ -14,6 +14,7 @@ import hashlib
 import threading
 import traceback
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -60,6 +61,8 @@ class DirectTransport:
         self.authkey = head.authkey
 
     def request(self, op: str, payload: dict, timeout: Optional[float] = None):
+        import time as _time
+
         fut: Future = Future()
 
         def reply(value=None, error=None):
@@ -69,8 +72,15 @@ class DirectTransport:
             elif not fut.done():
                 fut.set_result(value)
 
+        start = _time.monotonic()
         self.head.handle_request(op, payload, reply, self.worker_id)
-        return fut.result(timeout=None)  # head enforces timeouts itself
+        try:
+            # timeout=None keeps blocking semantics (in-process calls
+            # cannot lose their reply); a given timeout is enforced.
+            return fut.result(timeout=timeout)
+        except FuturesTimeoutError:
+            raise exc.RpcTimeoutError(
+                op=op, elapsed=_time.monotonic() - start, timeout=timeout)
 
     def request_oneway(self, op: str, payload: dict):
         """Fire-and-forget request — the reply (always just an ack on these
@@ -126,14 +136,25 @@ class ConnTransport:
         self._futures_lock = threading.Lock()
 
     def request(self, op: str, payload: dict, timeout: Optional[float] = None):
+        import time as _time
+
         with self._futures_lock:
             self._msg_counter += 1
             msg_id = self._msg_counter
             fut: Future = Future()
             self._futures[msg_id] = fut
+        start = _time.monotonic()
         self.send({"type": "request", "msg_id": msg_id, "op": op,
                    "payload": payload})
-        return fut.result()
+        try:
+            return fut.result(timeout=timeout)
+        except FuturesTimeoutError:
+            with self._futures_lock:
+                self._futures.pop(msg_id, None)
+            if fut.done():  # reply raced the timeout sweep: deliver it
+                return fut.result()
+            raise exc.RpcTimeoutError(
+                op=op, elapsed=_time.monotonic() - start, timeout=timeout)
 
     def on_reply(self, msg: dict):
         with self._futures_lock:

@@ -54,6 +54,54 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """`get(timeout=...)` expired."""
 
 
+class RpcTimeoutError(RayTpuError, TimeoutError):
+    """A control-plane RPC exceeded its deadline (the reply never arrived
+    within the transport's budget — lost frame, dead peer, or a wedged
+    head).  Distinct from :class:`GetTimeoutError`: that one means the
+    *object* wasn't ready in time; this one means the *channel* gave no
+    answer at all, so retries/failover are the right reaction."""
+
+    def __init__(self, op: str = "", elapsed: float = 0.0,
+                 timeout: Optional[float] = None, attempts: int = 1):
+        self.op = op
+        self.elapsed = elapsed
+        self.timeout = timeout
+        self.attempts = attempts
+        bound = f"{timeout:.3f}s" if timeout is not None else "unbounded"
+        super().__init__(
+            f"RPC {op!r} got no reply within its deadline "
+            f"(elapsed {elapsed:.3f}s, budget {bound}, "
+            f"{attempts} attempt(s))")
+
+    def __reduce__(self):
+        return (RpcTimeoutError,
+                (self.op, self.elapsed, self.timeout, self.attempts))
+
+
+class HeadConnectionError(RayTpuError, ConnectionError):
+    """Connecting/registering with the head failed.  Carries the head
+    address, how long we tried, and whether the TCP socket ever connected
+    (separates "nothing is listening" from "the head accepted the socket
+    but never completed registration")."""
+
+    def __init__(self, address: str, elapsed: float,
+                 socket_connected: bool, detail: str = ""):
+        self.address = address
+        self.elapsed = elapsed
+        self.socket_connected = socket_connected
+        phase = ("socket connected but registration never completed"
+                 if socket_connected else "TCP connection failed")
+        msg = (f"could not join head at {address}: {phase} "
+               f"after {elapsed:.1f}s")
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (HeadConnectionError,
+                (self.address, self.elapsed, self.socket_connected))
+
+
 class ObjectStoreFullError(RayTpuError):
     pass
 

@@ -1,0 +1,129 @@
+"""Transport fault-injection tests: deadlines, retries, dedup, reconnect.
+
+The no-hang invariant is enforced with an outer alarm: every blocking call
+in these tests must resolve within 2x its deadline or the alarm fails the
+test instead of wedging the suite.
+"""
+import contextlib
+import signal
+import threading
+import time
+from multiprocessing.connection import Pipe
+
+import pytest
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.worker import ConnTransport
+
+
+@contextlib.contextmanager
+def no_hang(seconds: float):
+    """Outer alarm: fail (don't wedge) if the body blocks past the bound."""
+
+    def on_alarm(signum, frame):
+        raise AssertionError(
+            f"no-hang invariant violated: test body exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+
+
+class _FakeHead:
+    """Minimal head: one reader thread serving `request` frames on a Pipe.
+
+    `behavior(op, payload, n_seen)` -> "reply" | "drop" decides per frame;
+    executions are counted per idempotency key so tests can assert
+    exactly-once application."""
+
+    def __init__(self, conn, behavior=None):
+        self.conn = conn
+        self.behavior = behavior or (lambda op, payload, n: "reply")
+        self.seen = {}          # key/op -> frames received
+        self.executed = []      # ops actually applied
+        self.lock = threading.Lock()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while True:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                return
+            if msg.get("type") not in ("request",):
+                continue
+            op = msg["op"]
+            key = msg.get("rpc_key") or op
+            with self.lock:
+                n = self.seen.get(key, 0) + 1
+                self.seen[key] = n
+            action = self.behavior(op, msg.get("payload") or {}, n)
+            if action == "drop":
+                continue
+            with self.lock:
+                self.executed.append(op)
+            try:
+                self.conn.send({"type": "reply", "msg_id": msg["msg_id"],
+                                "op": op, "ok": True,
+                                "value": {"op": op, "n": n}})
+            except (OSError, BrokenPipeError):
+                return
+
+
+def _wire(transport):
+    """Reader thread pumping replies into the transport (default_worker's
+    reader loop, minus the task plumbing)."""
+
+    def reader():
+        while True:
+            try:
+                msg = transport.conn.recv()
+            except (EOFError, OSError):
+                return
+            if msg.get("type") == "reply":
+                transport.on_reply(msg)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    return t
+
+
+def test_conn_request_timeout_enforced():
+    """Satellite 1: a lost reply must raise RpcTimeoutError within the
+    caller's budget, not block forever (worker.py used fut.result())."""
+    a, b = Pipe()
+    _FakeHead(b, behavior=lambda op, payload, n: "drop")
+    tr = ConnTransport(a, authkey=b"k")
+    _wire(tr)
+    with no_hang(10.0):
+        t0 = time.monotonic()
+        with pytest.raises(exc.RpcTimeoutError) as ei:
+            tr.request("resolve_batch", {"oids": []}, timeout=0.4)
+        elapsed = time.monotonic() - t0
+    assert elapsed < 0.8 * 2, f"blocked {elapsed:.2f}s past 2x deadline"
+    assert "resolve_batch" in str(ei.value)
+    tr.close()
+
+
+def test_direct_request_timeout_enforced():
+    """DirectTransport.request must enforce its timeout too (worker.py:62):
+    a head handler that defers its reply forever may not wedge the driver."""
+    from ray_tpu._private.worker import DirectTransport
+    from ray_tpu._private.ids import WorkerID
+
+    class _NeverHead:
+        authkey = b"k"
+        raylets = {}
+
+        def handle_request(self, op, payload, reply, caller):
+            pass  # deferred reply that never fires
+
+    tr = DirectTransport(_NeverHead(), WorkerID.from_random())
+    with no_hang(10.0):
+        with pytest.raises(exc.RpcTimeoutError):
+            tr.request("get_locations", {"oid": None}, timeout=0.3)
