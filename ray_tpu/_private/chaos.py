@@ -20,6 +20,11 @@ loops deterministic.  Kill sites: ``mesh_run`` (MeshWorker.run entry) and
 ``train_report`` (TrainWorker result reporting).  Driver-side,
 ``kill_mesh_rank`` murders a specific (or seeded-random) rank of a live
 MeshGroup/WorkerGroup by killing its hosting worker process.
+
+Message-level transport faults (drop/duplicate/delay/sever individual
+control- and data-plane messages, deterministic and seeded): set
+RAY_TPU_TESTING_NET_SCHEDULE — see :class:`NetSchedule` and
+docs/FAULT_TOLERANCE.md "RPC deadlines, retries, and transport chaos".
 """
 from __future__ import annotations
 
@@ -30,6 +35,226 @@ from typing import List, Optional, Tuple
 
 KILL_SCHEDULE_ENV = "RAY_TPU_TESTING_KILL_SCHEDULE"
 GENERATION_ENV = "RTPU_MESH_GENERATION"
+NET_SCHEDULE_ENV = "RAY_TPU_TESTING_NET_SCHEDULE"
+
+
+# ---------------------------------------------------------------------------
+# Message-level transport faults
+# ---------------------------------------------------------------------------
+class NetSchedule:
+    """A seeded, deterministic message-fault schedule.
+
+    RAY_TPU_TESTING_NET_SCHEDULE is a ``;``-separated list of
+    ``<op>:<kind>:<prob>:<seed>[:<times>[:<delay_ms>]]`` entries:
+
+    - ``op``    — substring matched against the fault-point label.
+      Labels are directional: ``request:<op>`` / ``notify:<type>`` on the
+      send side, ``reply:<op>`` / ``push:<type>`` on the receive side,
+      and ``pull`` on the transfer.py data channel.
+    - ``kind``  — ``drop`` (message vanishes), ``dup`` (delivered twice),
+      ``delay`` (sleeps ``delay_ms``, default 25), ``sever`` (the
+      connection is closed mid-flight, like a mid-stream RST).
+    - ``prob``  — per-message trigger probability, drawn from a dedicated
+      ``random.Random(seed)`` so a schedule replays identically.
+    - ``times`` — optional cap on total triggers (e.g. ``1`` = exactly
+      the first matching draw fires, then the link heals).
+
+    Example: ``reply:resolve:drop:0.3:42;request:submit:dup:1.0:7:1``
+    drops ~30% of resolve replies forever and duplicates exactly one
+    submit frame.
+    """
+
+    def __init__(self, entries):
+        import threading
+
+        # entries: list of dicts {needle, kind, prob, rng, left, delay_ms}
+        self.entries = entries
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "NetSchedule":
+        entries = []
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) < 4:
+                continue
+            # The op label may itself contain ':' ("request:submit"), so
+            # anchor the parse on the first known fault kind.
+            try:
+                kinds = ("drop", "dup", "delay", "sever")
+                ki = next(i for i in range(1, len(bits))
+                          if bits[i] in kinds)
+                op = ":".join(bits[:ki])
+                kind = bits[ki]
+                prob = float(bits[ki + 1])
+                seed = int(bits[ki + 2])
+                times = (int(bits[ki + 3])
+                         if len(bits) > ki + 3 and bits[ki + 3] else None)
+                delay_ms = (float(bits[ki + 4])
+                            if len(bits) > ki + 4 else 25.0)
+            except (StopIteration, ValueError, IndexError):
+                continue
+            entries.append({"needle": op, "kind": kind, "prob": prob,
+                            "rng": random.Random(seed),
+                            "left": times, "delay_ms": delay_ms})
+        return cls(entries)
+
+    def fault(self, label: str) -> Optional[Tuple[str, float]]:
+        """Consult the schedule for a message at ``label``; returns
+        ``(kind, delay_ms)`` when a fault fires, else None.  First
+        matching entry wins; draws are per-entry deterministic."""
+        for e in self.entries:
+            if e["needle"] not in label:
+                continue
+            if e["left"] is not None and e["left"] <= 0:
+                continue
+            if e["rng"].random() >= e["prob"]:
+                continue
+            if e["left"] is not None:
+                with self._lock:
+                    if e["left"] <= 0:
+                        continue
+                    e["left"] -= 1
+            from ray_tpu._private import retry as _retry
+
+            _retry.note("net_faults")
+            return e["kind"], e["delay_ms"]
+        return None
+
+
+def net_request_label(op: str, payload: Optional[dict]) -> str:
+    """Fault-point label for a request frame.  Acked notifies (op
+    ``notify_msg``) append the inner message type so schedules can target
+    the real op ("seal", "task_done") instead of the envelope."""
+    if op == "notify_msg" and isinstance(payload, dict):
+        inner = payload.get("msg")
+        if isinstance(inner, dict) and inner.get("type"):
+            return f"notify_msg:{inner['type']}"
+    return op
+
+
+_net_schedule: Optional[NetSchedule] = None
+_net_schedule_spec: Optional[str] = None
+
+
+def net_schedule() -> Optional[NetSchedule]:
+    """Process-wide schedule parsed from RAY_TPU_TESTING_NET_SCHEDULE
+    (re-parsed when the env var changes, like the kill schedule)."""
+    global _net_schedule, _net_schedule_spec
+    spec = os.environ.get(NET_SCHEDULE_ENV)
+    if not spec:
+        if _net_schedule is not None:
+            _net_schedule = None
+            _net_schedule_spec = None
+        return None
+    if _net_schedule is None or spec != _net_schedule_spec:
+        _net_schedule = NetSchedule.from_spec(spec)
+        _net_schedule_spec = spec
+    return _net_schedule
+
+
+def net_fault(label: str) -> Optional[Tuple[str, float]]:
+    sched = net_schedule()
+    return sched.fault(label) if sched is not None else None
+
+
+class FaultableConn:
+    """Fault-injecting wrapper around a multiprocessing Connection.
+
+    Installed under ConnTransport (and the node agent's head link) when a
+    net schedule is active.  Send-side labels come from the outgoing
+    frame (``request:<op>`` / ``notify:<type>``); receive-side labels
+    from the incoming frame (``reply:<op>`` / ``push:<type>``, the op
+    echoed in reply frames by the head).  ``sever`` closes the underlying
+    connection — exactly what a dropped TCP link looks like to both
+    reader loops, driving the reconnect/resend path.
+    """
+
+    def __init__(self, conn, schedule_fn=net_fault):
+        self._conn = conn
+        self._fault = schedule_fn
+        self._recv_dups = []
+
+    # -- label derivation --
+    @staticmethod
+    def _send_label(msg) -> str:
+        if isinstance(msg, dict):
+            t = msg.get("type")
+            if t == "request":
+                return f"request:{net_request_label(msg.get('op', ''), msg.get('payload'))}"
+            if t == "notify":
+                return f"notify:{msg.get('op', '')}"
+            return f"notify:{t}"
+        return "notify:raw"
+
+    @staticmethod
+    def _recv_label(msg) -> str:
+        if isinstance(msg, dict):
+            t = msg.get("type")
+            if t == "reply":
+                return f"reply:{msg.get('op', '')}"
+            return f"push:{t}"
+        return "push:raw"
+
+    # -- faulted endpoints --
+    def send(self, msg):
+        act = self._fault(self._send_label(msg))
+        if act is None:
+            return self._conn.send(msg)
+        kind, delay_ms = act
+        if kind == "drop":
+            return None
+        if kind == "dup":
+            self._conn.send(msg)
+            return self._conn.send(msg)
+        if kind == "delay":
+            time.sleep(delay_ms / 1000.0)
+            return self._conn.send(msg)
+        if kind == "sever":
+            try:
+                self._conn.close()
+            finally:
+                raise OSError("chaos: connection severed (send)")
+        return self._conn.send(msg)
+
+    def recv(self):
+        while True:
+            if self._recv_dups:
+                return self._recv_dups.pop()
+            msg = self._conn.recv()
+            act = self._fault(self._recv_label(msg))
+            if act is None:
+                return msg
+            kind, delay_ms = act
+            if kind == "drop":
+                continue
+            if kind == "dup":
+                self._recv_dups.append(msg)
+                return msg
+            if kind == "delay":
+                time.sleep(delay_ms / 1000.0)
+                return msg
+            if kind == "sever":
+                try:
+                    self._conn.close()
+                finally:
+                    raise EOFError("chaos: connection severed (recv)")
+            return msg
+
+    # -- transparent delegation --
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
+def wrap_net_faults(conn):
+    """Wrap ``conn`` in a FaultableConn when a net schedule is active
+    (identity no-op otherwise, and never double-wraps)."""
+    if isinstance(conn, FaultableConn):
+        return conn
+    return FaultableConn(conn) if net_schedule() is not None else conn
 
 
 def _parse() -> Optional[Tuple[str, float, float]]:

@@ -187,4 +187,39 @@ CONFIG \
     .declare("reconnect_window_s", float, 30.0,
              "How long agents/workers/drivers retry reconnecting to a "
              "restarted head before giving up (reference: the GCS "
-             "reconnect window, ray_config_def.h:58-62).")
+             "reconnect window, ray_config_def.h:58-62).") \
+    .declare("rpc_timeout", float, 0.0,
+             "Default overall deadline (seconds) for control-plane "
+             "requests without an explicit timeout; 0 keeps blocking "
+             "semantics unbounded (lost replies still recover via "
+             "per-attempt resends).  Env: RAY_TPU_RPC_TIMEOUT.") \
+    .declare("rpc_attempt_timeout", float, 15.0,
+             "Per-attempt reply wait before a pending request frame is "
+             "resent (idempotency keys + the head reply cache make the "
+             "resend exactly-once).") \
+    .declare("rpc_retry_base_s", float, 0.05,
+             "Base backoff between RPC retry attempts (exponential, "
+             "jittered, capped at rpc_retry_cap_s).") \
+    .declare("rpc_retry_cap_s", float, 2.0,
+             "Backoff cap between RPC retry attempts.") \
+    .declare("rpc_acked_ops", bool, False,
+             "Route one-way notifies/submits through acked, idempotency-"
+             "keyed requests so dropped frames are retried (auto-enabled "
+             "while RAY_TPU_TESTING_NET_SCHEDULE is set).") \
+    .declare("rpc_reply_cache_size", int, 1024,
+             "Head-side idempotency reply-cache entries (exactly-once "
+             "dedup window for retried/duplicated frames).") \
+    .declare("rpc_reply_cache_ttl_s", float, 300.0,
+             "Reply-cache entries are evictable this long after their "
+             "reply was recorded.") \
+    .declare("rpc_hang_dump_s", float, 120.0,
+             "The RPC watchdog dumps the blocked thread's stack for any "
+             "in-flight call older than this (0 disables dumps).") \
+    .declare("rpc_watchdog_interval_s", float, 1.0,
+             "Scan period of the per-transport RPC keeper thread "
+             "(async resends + hung-call detection).") \
+    .declare("transfer_timeout_s", float, 120.0,
+             "Per-chunk progress deadline on cross-host object pulls "
+             "(0 = wait forever, the pre-deadline behavior).") \
+    .declare("transfer_retries", int, 2,
+             "Extra pull attempts after a transfer connection failure.")

@@ -104,11 +104,15 @@ def main():
                                  authkey=authkey)
             except Exception:
                 continue
-            transport.replace_conn(newconn)
+            # Resends are held until registration completes on the new
+            # conn, then every unacked in-flight request is resent (its
+            # idempotency key makes the resend exactly-once at the head).
+            transport.replace_conn(newconn, hold_resend=True)
             try:
                 register()
             except Exception:
                 continue  # head died again mid-handshake: keep retrying
+            transport.release_resend()
             return True
         return False
 
@@ -239,7 +243,9 @@ def main():
                        "spec": spec, "results": [], "error": err,
                        "error_str": error_str, "crashed": False,
                        "start": now, "end": now}
-            transport.send(msg)
+            # notify() (not raw send): in acked mode a dropped task_done
+            # is retried instead of stranding the driver on its future.
+            transport.notify(msg)
         else:
             try:
                 done = make_done(spec)

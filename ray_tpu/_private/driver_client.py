@@ -17,6 +17,7 @@ from multiprocessing.connection import Client
 from typing import Optional
 
 from ray_tpu._private.ids import JobID, NodeID, ObjectID, WorkerID
+from ray_tpu.exceptions import HeadConnectionError
 from ray_tpu._private.object_store import SharedMemoryStore
 from ray_tpu._private.transfer import (
     ObjectTransferServer,
@@ -30,8 +31,11 @@ class RemoteDriverRuntime:
                  store_capacity: int = 512 * 1024**2,
                  job_config: Optional[dict] = None,
                  timeout: float = 30.0):
+        import time as _time
+
         host, port = address.rsplit(":", 1)
         self._head_host, self._head_port = host, int(port)
+        self._address = address
         self._job_config = job_config
         self.authkey = authkey
         self.worker_id = WorkerID.from_random()
@@ -44,10 +48,16 @@ class RemoteDriverRuntime:
                                        spill_dir=self._spill_dir)
         wire_store_reporting(self.store, lambda m: self.transport.send(m))
         self.conn = None
+        start = _time.monotonic()
         try:
             self.xfer = ObjectTransferServer(self.store, authkey)
-            self.conn = Client((host, int(port)), family="AF_INET",
-                               authkey=authkey)
+            try:
+                self.conn = Client((host, int(port)), family="AF_INET",
+                                   authkey=authkey)
+            except (OSError, EOFError) as e:
+                raise HeadConnectionError(
+                    address, elapsed=_time.monotonic() - start,
+                    socket_connected=False, detail=str(e)) from e
             self.transport = ConnTransport(self.conn, authkey)
             self.node_id: Optional[NodeID] = None
             self._registered = threading.Event()
@@ -68,8 +78,12 @@ class RemoteDriverRuntime:
                     self._job_config["runtime_env"], self.transport)
             self._send_register()
             if not self._registered.wait(timeout):
-                raise TimeoutError(
-                    f"driver registration with {address} timed out")
+                # Typed: the socket DID connect (Client succeeded) — the
+                # head accepted us but never completed registration.
+                raise HeadConnectionError(
+                    address, elapsed=_time.monotonic() - start,
+                    socket_connected=True,
+                    detail="no driver_registered reply")
         except BaseException:
             self.shutdown()
             raise
@@ -102,18 +116,24 @@ class RemoteDriverRuntime:
             except Exception:
                 continue
             self.conn = conn
-            self.transport.replace_conn(conn)
+            # Hold resends until re-registration lands on the new conn,
+            # then resend unacked in-flight requests (idempotency-keyed,
+            # so the head applies each at most once).
+            self.transport.replace_conn(conn, hold_resend=True)
             try:
                 self._send_register()
             except Exception:
                 continue  # head died again mid-handshake: keep retrying
+            self.transport.release_resend()
             return True
         return False
 
     def _read_loop(self):
         while True:
             try:
-                msg = self.conn.recv()
+                # Read through the transport's conn (the fault-injection
+                # wrapper when a net schedule is active).
+                msg = self.transport.conn.recv()
             except (EOFError, OSError, BrokenPipeError):
                 if self._closing or not self._reconnect():
                     self.transport.close()

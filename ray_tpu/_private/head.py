@@ -105,6 +105,16 @@ class Head:
         self._cancelled: set = set()  # task ids cancelled while running
         self._oom_killed: set = set()  # task ids killed by the memory monitor
         self._shutdown = False
+        # Idempotency-key reply cache: retried/duplicated request frames
+        # (client resends after a lost reply, chaos dup injection,
+        # reconnect resends) are applied exactly once — duplicates attach
+        # to the original execution and are answered from its reply.
+        from ray_tpu._private.config import CONFIG as _CONFIG
+        from ray_tpu._private.retry import ReplyCache
+
+        self._rpc_cache = ReplyCache(
+            cap=_CONFIG.rpc_reply_cache_size,
+            ttl=_CONFIG.rpc_reply_cache_ttl_s)
         # ---- multi-host plane ----
         # Host identity: object resolutions are host-aware — same host means
         # "attach the shm segment", different host means "pull over TCP from
@@ -673,15 +683,34 @@ class Head:
     # ================= request router =================
     def _handle_request(self, msg: dict, conn, worker_id: Optional[WorkerID]):
         msg_id = msg["msg_id"]
+        op = msg["op"]
 
         def reply(value=None, error: Optional[BaseException] = None):
+            # The op is echoed in the reply frame so client-side fault
+            # injection and debugging can address replies by op.
             self._send_on(conn, {"type": "reply", "msg_id": msg_id,
-                                 "ok": error is None, "value": value,
-                                 "error": error})
+                                 "op": op, "ok": error is None,
+                                 "value": value, "error": error})
 
+        self.handle_request_keyed(op, msg.get("payload") or {}, reply,
+                                  worker_id, msg.get("rpc_key"))
+
+    def handle_request_keyed(self, op: str, payload: dict,
+                             reply: Callable[..., None],
+                             caller: Optional[WorkerID] = None,
+                             key: Optional[bytes] = None):
+        """Keyed entry point: frames carrying an idempotency key pass the
+        reply cache first — the first frame per key executes, duplicates
+        (resends after a dropped reply, chaos dup injection, reconnect
+        resends) are answered from the cached/attached reply and never
+        re-applied."""
+        if key is not None:
+            run, wrapped = self._rpc_cache.admit(key, reply)
+            if not run:
+                return
+            reply = wrapped
         try:
-            self.handle_request(msg["op"], msg.get("payload") or {}, reply,
-                                worker_id)
+            self.handle_request(op, payload, reply, caller)
         except BaseException as e:  # noqa: BLE001 — errors go to the caller
             reply(error=e)
 
@@ -694,6 +723,32 @@ class Head:
             reply(error=ValueError(f"unknown op {op!r}"))
             return
         fn(payload, reply, caller)
+
+    def req_notify_msg(self, payload, reply, caller):
+        """Acked notify: a one-way message routed through the keyed
+        request path (chaos / rpc_acked_ops), so a dropped seal or
+        task_done is retried by its sender and a duplicated frame is
+        deduplicated by the reply cache instead of double-applying."""
+        msg = payload["msg"]
+        t = msg.get("type")
+        fn = {
+            "seal": self.on_seal,
+            "put_inline": self.on_put_inline,
+            "seal_batch": self.on_seal_batch,
+            "put_inline_batch": self.on_put_inline_batch,
+            "task_done": self.on_task_done,
+            "arena_sealed": self.on_arena_sealed,
+            "arena_release": self.on_arena_release,
+            "worker_blocked":
+                lambda m: self.on_worker_blocked(WorkerID(m["worker_id"])),
+            "worker_unblocked":
+                lambda m: self.on_worker_unblocked(WorkerID(m["worker_id"])),
+        }.get(t)
+        if fn is None:
+            reply(error=ValueError(f"notify_msg cannot route {t!r}"))
+            return
+        fn(msg)
+        reply(True)
 
     # ----- ops -----
     def req_submit(self, payload, reply, caller):

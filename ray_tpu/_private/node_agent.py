@@ -55,8 +55,13 @@ class NodeAgent:
         # everything evicted is the safe default.
         wire_store_reporting(self.store, self.send)
         self.xfer = ObjectTransferServer(self.store, authkey)
-        self.conn = Client(tuple(head_addr), family="AF_INET",
-                           authkey=authkey)
+        from ray_tpu._private.chaos import wrap_net_faults
+
+        # Fault-injection wrapper (identity no-op without a net schedule):
+        # agent notifies label as notify:<type>, head pushes as
+        # push:<type> (spawn_worker, store_adopt, ...).
+        self.conn = wrap_net_faults(Client(tuple(head_addr), family="AF_INET",
+                                           authkey=authkey))
         self._send_lock = threading.Lock()
         self._children: Dict[bytes, subprocess.Popen] = {}
         self._children_lock = threading.Lock()
@@ -100,8 +105,11 @@ class NodeAgent:
         while not self._shutdown.is_set() and time.monotonic() < deadline:
             time.sleep(1.0)
             try:
-                conn = Client(tuple(self.head_addr), family="AF_INET",
-                              authkey=self.authkey)
+                from ray_tpu._private.chaos import wrap_net_faults
+
+                conn = wrap_net_faults(
+                    Client(tuple(self.head_addr), family="AF_INET",
+                           authkey=self.authkey))
             except Exception:
                 continue
             with self._send_lock:

@@ -25,6 +25,7 @@ from __future__ import annotations
 import queue
 import socket
 import threading
+import time
 import traceback
 from multiprocessing.connection import Client, Listener
 from typing import Optional, Tuple
@@ -313,6 +314,24 @@ class TransferClient:
             return old, lock
         return conn, lock
 
+    @staticmethod
+    def _await_bytes(conn, timeout_s: float, oid: ObjectID, what: str):
+        """Per-chunk progress deadline: a stream that stops moving raises
+        instead of blocking recv() forever (a severed peer whose FIN was
+        lost looks exactly like a slow one — bound it)."""
+        if timeout_s and timeout_s > 0 and not conn.poll(timeout_s):
+            raise OSError(
+                f"transfer of {oid} stalled: no {what} for {timeout_s}s")
+
+    def _invalidate(self, addr):
+        with self._lock:
+            conn = self._conns.pop(tuple(addr), None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
     def pull(self, addr: Tuple[str, int], oid: ObjectID,
              sink=None) -> Tuple[bytes, bytes]:
         """Fetch (meta, data) for oid from the store at addr.
@@ -320,14 +339,38 @@ class TransferClient:
         If `sink` (a writable buffer of the right size, e.g. a local shm
         view) is provided, chunks are written into it and `data` returns
         that buffer's bytes are NOT copied again — the caller owns sink.
-        Connection errors invalidate the cached conn and retry once."""
-        for attempt in (0, 1):
+        Connection errors/stalls invalidate the cached conn and retry
+        with backoff (`transfer_retries`); each chunk must arrive within
+        `transfer_timeout_s` or the attempt counts as failed."""
+        from ray_tpu._private.chaos import net_fault
+        from ray_tpu._private.config import CONFIG
+        from ray_tpu._private.retry import RetryPolicy
+
+        retries = max(0, int(CONFIG.transfer_retries))
+        timeout_s = float(CONFIG.transfer_timeout_s)
+        policy = RetryPolicy(base=0.05, cap=1.0)
+        for attempt in range(retries + 1):
+            act = net_fault("pull")
+            if act is not None:
+                kind, delay_ms = act
+                if kind == "delay":
+                    time.sleep(delay_ms / 1000.0)
+                elif kind in ("drop", "sever"):
+                    # The data channel is strict request/response: a lost
+                    # frame is indistinguishable from a severed conn, so
+                    # both surface as a connection failure (and retry).
+                    self._invalidate(addr)
+                    if attempt >= retries:
+                        raise OSError("chaos: transfer connection severed")
+                    time.sleep(policy.delay(attempt + 1))
+                    continue
             conn, conn_lock = self._conn_for(addr)
             try:
                 # One in-flight request per CONNECTION (request/response
                 # protocol); pulls against different servers overlap.
                 with conn_lock:
                     conn.send({"oid": oid.binary()})
+                    self._await_bytes(conn, timeout_s, oid, "header")
                     hdr = conn.recv()
                     if not hdr["ok"]:
                         raise KeyError(hdr["error"])
@@ -336,25 +379,29 @@ class TransferClient:
                         view = memoryview(sink)
                         off = 0
                         if size == 0:
+                            self._await_bytes(conn, timeout_s, oid, "chunk")
                             conn.recv_bytes()
                         while off < size:
+                            self._await_bytes(conn, timeout_s, oid, "chunk")
                             n = conn.recv_bytes_into(view[off:])
                             off += n
                         return hdr["meta"], None
                     parts = []
                     got = 0
                     while got < size:
+                        self._await_bytes(conn, timeout_s, oid, "chunk")
                         b = conn.recv_bytes()
                         parts.append(b)
                         got += len(b)
                     if size == 0:
+                        self._await_bytes(conn, timeout_s, oid, "chunk")
                         conn.recv_bytes()
                     return hdr["meta"], b"".join(parts)
             except (EOFError, OSError, BrokenPipeError):
-                with self._lock:
-                    self._conns.pop(tuple(addr), None)
-                if attempt:
+                self._invalidate(addr)
+                if attempt >= retries:
                     raise
+                time.sleep(policy.delay(attempt + 1))
         raise RuntimeError("unreachable")
 
     def close(self):

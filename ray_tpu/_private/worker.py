@@ -56,13 +56,28 @@ class DirectTransport:
     """Driver-side transport: function calls straight into the Head."""
 
     def __init__(self, head, worker_id: WorkerID):
+        import itertools
+        import os as _os
+
         self.head = head
         self.worker_id = worker_id
         self.authkey = head.authkey
+        # Idempotency-key namespace: used only while a net-fault schedule
+        # is active (in-process calls cannot be lost otherwise).
+        self._key_prefix = _os.urandom(8)
+        self._key_counter = itertools.count(1)
+
+    def _net_schedule(self):
+        from ray_tpu._private.chaos import net_schedule
+
+        return net_schedule()
 
     def request(self, op: str, payload: dict, timeout: Optional[float] = None):
         import time as _time
 
+        sched = self._net_schedule()
+        if sched is not None:
+            return self._request_faulted(sched, op, payload, timeout)
         fut: Future = Future()
 
         def reply(value=None, error=None):
@@ -82,13 +97,76 @@ class DirectTransport:
             raise exc.RpcTimeoutError(
                 op=op, elapsed=_time.monotonic() - start, timeout=timeout)
 
+    def _request_faulted(self, sched, op: str, payload: dict,
+                         timeout: Optional[float]):
+        """Chaos path: the schedule may drop/dup/delay the request or its
+        reply, so the call runs a keyed retry loop — resends carry the
+        same idempotency key and the head's reply cache applies the op
+        exactly once, replaying the recorded reply to late attempts."""
+        import time as _time
+
+        from ray_tpu._private import retry as retry_mod
+        from ray_tpu._private.chaos import net_request_label
+
+        default_total, attempt_iv = retry_mod.rpc_defaults()
+        deadline = retry_mod.Deadline(
+            timeout if timeout is not None else default_total)
+        key = self._key_prefix + next(self._key_counter).to_bytes(8, "little")
+        label = net_request_label(op, payload)
+        fut: Future = Future()
+
+        def reply(value=None, error=None):
+            act = sched.fault(f"reply:{label}")
+            kind = act[0] if act is not None else None
+            if kind in ("drop", "sever"):
+                return
+            if kind == "delay":
+                _time.sleep(act[1] / 1000.0)
+            if error is not None:
+                if not fut.done():
+                    fut.set_exception(error)
+            elif not fut.done():
+                fut.set_result(value)
+
+        attempts = 0
+        while True:
+            act = sched.fault(f"request:{label}")
+            kind = act[0] if act is not None else None
+            if kind == "delay":
+                _time.sleep(act[1] / 1000.0)
+            if kind not in ("drop", "sever"):
+                for _ in range(2 if kind == "dup" else 1):
+                    self.head.handle_request_keyed(op, payload, reply,
+                                                   self.worker_id, key)
+            attempts += 1
+            try:
+                return fut.result(
+                    timeout=max(0.001, deadline.bound(attempt_iv)))
+            except FuturesTimeoutError:
+                pass
+            if deadline.expired():
+                retry_mod.note("timeouts")
+                raise exc.RpcTimeoutError(op=op, elapsed=deadline.elapsed(),
+                                          timeout=deadline.timeout,
+                                          attempts=attempts)
+            retry_mod.note("retries")
+
     def request_oneway(self, op: str, payload: dict):
         """Fire-and-forget request — the reply (always just an ack on these
-        ops) is dropped; errors surface through the task result path."""
+        ops) is dropped; errors surface through the task result path.
+        Under an active net-fault schedule the op rides the acked, keyed
+        request path instead, so a dropped frame is retried and a
+        duplicated one applied exactly once."""
+        if self._net_schedule() is not None:
+            self.request(op, payload)
+            return
         self.head.handle_request(op, payload, lambda *a, **k: None,
                                  self.worker_id)
 
     def notify(self, msg: dict):
+        if self._net_schedule() is not None:
+            self.request("notify_msg", {"msg": msg})
+            return
         t = msg["type"]
         if t == "seal":
             self.head.on_seal(msg)
@@ -116,50 +194,174 @@ class DirectTransport:
         pass
 
 
+class _Rpc:
+    """One logical RPC on a ConnTransport: a single msg_id + idempotency
+    key for its whole lifetime — retries resend the *identical* frame, so
+    replies to any attempt resolve the same record and the head's reply
+    cache applies the op exactly once."""
+
+    __slots__ = ("fut", "op", "frame", "key", "deadline", "started",
+                 "last_send", "attempts", "mode", "thread_id", "dumped")
+
+    def __init__(self, fut, op: str, frame: dict, key: bytes, deadline,
+                 mode: str):
+        import time as _time
+
+        self.fut = fut
+        self.op = op
+        self.frame = frame
+        self.key = key
+        self.deadline = deadline
+        now = _time.monotonic()
+        self.started = now
+        self.last_send = now
+        self.attempts = 0
+        self.mode = mode  # "call" (blocking) | "async" (acked one-way)
+        self.thread_id = threading.get_ident()
+        self.dumped = False
+
+
 class ConnTransport:
     """Subprocess-worker transport over a multiprocessing Connection.
 
     A reader thread (owned by default_worker) routes replies into
-    self._futures; sends are serialized by a lock."""
+    self._pending; sends are serialized by a lock.
+
+    Deadlines + retries: every ``request`` frame carries an idempotency
+    key.  A blocking request waits ``rpc_attempt_timeout`` for its reply
+    and then resends the same frame (exponentially paced), bounded by the
+    caller's timeout (or RAY_TPU_RPC_TIMEOUT when set) — on expiry it
+    raises :class:`RpcTimeoutError` instead of blocking forever.  Under
+    ``rpc_acked_ops`` (auto-on while a net-fault schedule is active),
+    one-way ops (submits, seal/put notifies, task_done) also ride keyed
+    request frames; a keeper thread resends the unacked ones, and the
+    head's reply cache makes any resend/duplicate exactly-once.  On head
+    failover ``replace_conn`` keeps unacked requests registered so they
+    are *resent* on the new connection instead of erroring.  The keeper
+    doubles as the hung-call watchdog: in-flight ages feed
+    retry.rpc_inflight_stats() and calls older than ``rpc_hang_dump_s``
+    get their waiting thread's stack dumped to stderr."""
 
     def __init__(self, conn, authkey: Optional[bytes] = None):
-        self.conn = conn
+        import os
+
+        from ray_tpu._private import chaos as chaos_mod
+        from ray_tpu._private import retry as retry_mod
+
+        self.conn = chaos_mod.wrap_net_faults(conn)
         self.authkey = authkey
         if self.authkey is None:
-            import os
-
             hexkey = os.environ.get("RAY_TPU_AUTHKEY")
             self.authkey = bytes.fromhex(hexkey) if hexkey else None
         self._send_lock = threading.Lock()
-        self._futures: Dict[int, Future] = {}
+        self._pending: Dict[int, _Rpc] = {}
         self._msg_counter = 0
         self._futures_lock = threading.Lock()
+        self._key_prefix = os.urandom(8)
+        self._closed = False
+        # Cleared while a reconnect handshake is in flight so resends
+        # don't race ahead of re-registration on the fresh conn.
+        self._resume_evt = threading.Event()
+        self._resume_evt.set()
+        self._keeper: Optional[threading.Thread] = None
+        retry_mod.register_transport(self)
+
+    # ---- config / chaos accessors ----
+    def _acked_ops(self) -> bool:
+        from ray_tpu._private.chaos import net_schedule
+
+        if net_schedule() is not None:
+            return True
+        from ray_tpu._private.config import CONFIG
+
+        return bool(CONFIG.rpc_acked_ops)
+
+    def pending_rpcs(self) -> List[_Rpc]:
+        with self._futures_lock:
+            return list(self._pending.values())
+
+    def _register(self, op: str, payload: dict, deadline, mode: str) -> _Rpc:
+        with self._futures_lock:
+            if self._closed:
+                raise exc.RayTpuError("connection closed")
+            self._msg_counter += 1
+            msg_id = self._msg_counter
+            key = self._key_prefix + msg_id.to_bytes(8, "little")
+            frame = {"type": "request", "msg_id": msg_id, "op": op,
+                     "payload": payload, "rpc_key": key}
+            rec = _Rpc(Future(), op, frame, key, deadline, mode)
+            self._pending[msg_id] = rec
+        self._ensure_keeper()
+        return rec
+
+    def _deregister(self, rec: _Rpc) -> None:
+        with self._futures_lock:
+            self._pending.pop(rec.frame["msg_id"], None)
 
     def request(self, op: str, payload: dict, timeout: Optional[float] = None):
         import time as _time
 
-        with self._futures_lock:
-            self._msg_counter += 1
-            msg_id = self._msg_counter
-            fut: Future = Future()
-            self._futures[msg_id] = fut
-        start = _time.monotonic()
-        self.send({"type": "request", "msg_id": msg_id, "op": op,
-                   "payload": payload})
+        from ray_tpu._private import retry as retry_mod
+
+        default_total, attempt_iv = retry_mod.rpc_defaults()
+        deadline = retry_mod.Deadline(
+            timeout if timeout is not None else default_total)
+        rec = self._register(op, payload, deadline, "call")
+        fut = rec.fut
+        attempt_wait = attempt_iv
         try:
-            return fut.result(timeout=timeout)
-        except FuturesTimeoutError:
-            with self._futures_lock:
-                self._futures.pop(msg_id, None)
-            if fut.done():  # reply raced the timeout sweep: deliver it
-                return fut.result()
-            raise exc.RpcTimeoutError(
-                op=op, elapsed=_time.monotonic() - start, timeout=timeout)
+            while True:
+                # Held only during a reconnect handshake; set otherwise.
+                self._resume_evt.wait(timeout=deadline.bound(attempt_iv))
+                try:
+                    self.send(rec.frame)
+                except (OSError, EOFError, BrokenPipeError):
+                    pass  # conn breaking/being replaced: paced retry below
+                rec.attempts += 1
+                rec.last_send = _time.monotonic()
+                try:
+                    return fut.result(
+                        timeout=max(0.001, deadline.bound(attempt_wait)))
+                except FuturesTimeoutError:
+                    pass
+                if self._closed:
+                    raise exc.RayTpuError("connection closed")
+                if deadline.expired():
+                    retry_mod.note("timeouts")
+                    raise exc.RpcTimeoutError(
+                        op=op, elapsed=deadline.elapsed(),
+                        timeout=deadline.timeout, attempts=rec.attempts)
+                retry_mod.note("retries")
+                attempt_wait = min(attempt_wait * 1.5, max(attempt_iv, 60.0))
+        finally:
+            self._deregister(rec)
+
+    def _request_async(self, op: str, payload: dict) -> None:
+        """Acked one-way op: one keyed request frame, no blocked thread.
+        The keeper thread resends it until the reply lands (or a bounded
+        deadline passes); the key makes resends exactly-once."""
+        from ray_tpu._private import retry as retry_mod
+
+        default_total, _ = retry_mod.rpc_defaults()
+        deadline = retry_mod.Deadline(
+            default_total if default_total is not None else 60.0)
+        try:
+            rec = self._register(op, payload, deadline, "async")
+        except exc.RayTpuError:
+            return  # closed: matches one-way best-effort semantics
+        try:
+            self.send(rec.frame)
+            rec.attempts += 1
+        except (OSError, EOFError, BrokenPipeError):
+            pass  # keeper resends
 
     def on_reply(self, msg: dict):
         with self._futures_lock:
-            fut = self._futures.pop(msg["msg_id"], None)
-        if fut is None:
+            rec = self._pending.pop(msg["msg_id"], None)
+        if rec is None:
+            return
+        fut = rec.fut
+        if fut.done():
             return
         if msg["ok"]:
             fut.set_result(msg["value"])
@@ -167,46 +369,127 @@ class ConnTransport:
             fut.set_exception(msg["error"])
 
     def notify(self, msg: dict):
-        self.send(msg)
+        if self._acked_ops():
+            self._request_async("notify_msg", {"msg": msg})
+        else:
+            self.send(msg)
 
     def request_oneway(self, op: str, payload: dict):
         """Fire-and-forget request: one send, no reply frame, no round
-        trip.  Used for acked-only ops on the submission hot path."""
-        self.send({"type": "notify", "op": op, "payload": payload})
+        trip.  Used for acked-only ops on the submission hot path.  In
+        acked mode (chaos / rpc_acked_ops) the frame is keyed and
+        keeper-retried instead, so a dropped submit cannot strand its
+        caller."""
+        if self._acked_ops():
+            self._request_async(op, payload)
+        else:
+            self.send({"type": "notify", "op": op, "payload": payload})
 
     def send(self, msg: dict):
         with self._send_lock:
             self.conn.send(msg)
 
-    def replace_conn(self, conn):
-        """Head failover: swap in a fresh control connection.  Requests
-        in flight on the dead conn fail (their callers retry or surface
-        the error); new traffic rides the new conn.  Swap and sweep are
-        atomic under both locks so a request can't send on the new conn
-        yet have its future swept (request() never nests these locks)."""
+    # ---- keeper: async resends + hung-call watchdog ----
+    def _ensure_keeper(self):
+        if self._keeper is not None:
+            return
+        with self._futures_lock:
+            if self._keeper is not None or self._closed:
+                return
+            t = threading.Thread(target=self._keeper_loop,
+                                 name="rtpu-rpc-keeper", daemon=True)
+            self._keeper = t
+        t.start()
+
+    def _keeper_loop(self):
+        import time as _time
+
+        from ray_tpu._private import retry as retry_mod
+        from ray_tpu._private.config import CONFIG
+
+        while not self._closed:
+            _, attempt_iv = retry_mod.rpc_defaults()
+            interval = min(CONFIG.rpc_watchdog_interval_s,
+                           max(attempt_iv / 3.0, 0.02))
+            _time.sleep(max(0.02, interval))
+            hang_s = CONFIG.rpc_hang_dump_s
+            now = _time.monotonic()
+            with self._futures_lock:
+                recs = list(self._pending.items())
+            for msg_id, rec in recs:
+                if rec.mode == "async":
+                    if rec.deadline.expired():
+                        with self._futures_lock:
+                            self._pending.pop(msg_id, None)
+                        retry_mod.note("async_dropped")
+                        continue
+                    if (now - rec.last_send >= attempt_iv
+                            and self._resume_evt.is_set()):
+                        try:
+                            self.send(rec.frame)
+                        except Exception:
+                            continue
+                        rec.attempts += 1
+                        rec.last_send = _time.monotonic()
+                        retry_mod.note("async_retries")
+                if hang_s and not rec.dumped and now - rec.started > hang_s:
+                    rec.dumped = True
+                    retry_mod.dump_blocked_rpc(
+                        rec, reason=f"in flight > {hang_s:.0f}s")
+
+    # ---- failover ----
+    def replace_conn(self, conn, hold_resend: bool = False):
+        """Head failover: swap in a fresh control connection.  Unacked
+        requests STAY registered — their idempotency keys make a resend
+        exactly-once, so in-flight calls ride the new conn (resent by
+        their blocked caller / the keeper) instead of erroring.  With
+        ``hold_resend`` resends are gated until :meth:`release_resend`,
+        so the re-registration handshake goes first on the new conn.
+        Swap is atomic under both locks (request() never nests them)."""
+        from ray_tpu._private.chaos import wrap_net_faults
+
+        conn = wrap_net_faults(conn)
         with self._send_lock:
             with self._futures_lock:
-                futs, self._futures = list(self._futures.values()), {}
+                if hold_resend:
+                    self._resume_evt.clear()
                 old, self.conn = self.conn, conn
         try:
             old.close()
         except Exception:
             pass
-        for fut in futs:
-            if not fut.done():
-                fut.set_exception(
-                    exc.RayTpuError("head connection lost (reconnected)"))
+
+    def release_resend(self):
+        """Reconnect handshake done: resume (and immediately perform) the
+        resend of every still-pending request on the new conn."""
+        import time as _time
+
+        self._resume_evt.set()
+        with self._futures_lock:
+            recs = list(self._pending.values())
+        for rec in recs:
+            try:
+                self.send(rec.frame)
+                rec.attempts += 1
+                rec.last_send = _time.monotonic()
+            except Exception:
+                break
 
     def close(self):
+        with self._futures_lock:
+            self._closed = True
+            pending, self._pending = dict(self._pending), {}
         try:
             self.conn.close()
         except Exception:
             pass
-        with self._futures_lock:
-            for fut in self._futures.values():
-                if not fut.done():
-                    fut.set_exception(exc.RayTpuError("connection closed"))
-            self._futures.clear()
+        err = exc.RayTpuError("connection closed")
+        for rec in pending.values():
+            if not rec.fut.done():
+                rec.fut.set_exception(err)
+        # Release any caller gated on a reconnect handshake so it can
+        # observe _closed instead of sleeping out its deadline.
+        self._resume_evt.set()
 
 
 class _EnvOverlay:

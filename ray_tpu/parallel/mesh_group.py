@@ -113,8 +113,14 @@ from ray_tpu import exceptions as exc
 
 # Errors that poison the gang (vs. a user exception raised by fn, which is
 # re-raised as-is: the worker is alive and a restart would not help).
+# RpcTimeoutError counts: a rank whose control-plane edge blew its
+# deadline is indistinguishable from a hung rank — the supervisor must
+# treat it as failed (restart path) rather than assume the reply will
+# eventually arrive (replies either arrive or the process died is no
+# longer the plane's contract; deadlines are).
 _GANG_ERRORS = (exc.ActorDiedError, exc.ActorUnavailableError,
-                exc.WorkerCrashedError, exc.ObjectLostError)
+                exc.WorkerCrashedError, exc.ObjectLostError,
+                exc.RpcTimeoutError)
 
 # Driver-side sync counter: every blocking per-step driver↔worker round
 # trip on a dispatch path (the lockstep run*/health_check calls) bumps it.

@@ -200,7 +200,11 @@ class SampleStream:
             except ray_tpu.exceptions.RayTpuError:
                 # Feed the existing FT manager (strike counting, actor
                 # replacement past the budget, weight restore), abandon
-                # the dead handle's window, and keep streaming.
+                # the dead handle's window, and keep streaming.  This
+                # includes RpcTimeoutError: a worker whose RPC edge blew
+                # its deadline is treated exactly like a dead worker —
+                # struck and replaced — instead of stalling the stream
+                # waiting on a reply that may never come.
                 self.failures_seen += 1
                 self._drop_window(pend.worker_index)
                 self.workers.report_failure_index(pend.worker_index)

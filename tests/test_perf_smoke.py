@@ -8,6 +8,7 @@ from tools.perf_smoke import (
     run_checkpoint_smoke,
     run_object_plane_smoke,
     run_rollout_smoke,
+    run_rpc_chaos_smoke,
     run_smoke,
 )
 
@@ -43,6 +44,19 @@ def test_rollout_plane_smoke(shutdown_only):
     assert out["one_put_per_version"], f"broadcast fan-out regressed: {out}"
     assert out["inflight_ok"], f"stream drained at consume time: {out}"
     assert out["produce_consume_overlap"], f"lockstep sampling: {out}"
+    assert out["ok"], out
+
+
+def test_rpc_chaos_smoke(shutdown_only):
+    """One dropped reply on the submit path must be invisible to the
+    workload: the call times out its attempt, retries under the same
+    idempotency key, and completes with exact results — the tier-1 guard
+    for ISSUE 6's deadline-enforced RPC plane (no call may hang)."""
+    out = run_rpc_chaos_smoke()
+    assert out["exact_results"], out
+    assert out["net_faults_injected"] >= 1, f"no fault injected: {out}"
+    assert out["retries"] >= 1, f"dropped reply never retried: {out}"
+    assert out["no_hang"], f"no-hang invariant violated: {out}"
     assert out["ok"], out
 
 

@@ -352,6 +352,62 @@ def run_rollout_smoke(fragments: int = 6, k: int = 2,
         ray_tpu.shutdown()
 
 
+def run_rpc_chaos_smoke(tasks: int = 8) -> dict:
+    """RPC-plane robustness invariant (tier-1 guard for ISSUE 6):
+
+    Exactly ONE submit-path reply is dropped on the wire.  The call must
+    time out its attempt, retry with the same idempotency key, and the
+    workload must complete with exact results — zero hangs (bounded wall
+    clock), zero double-applied submits (exact result set).
+    """
+    import os as _os
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private import retry as retry_mod
+    from ray_tpu._private.chaos import NET_SCHEDULE_ENV
+    from ray_tpu._private.config import CONFIG
+
+    # One dropped reply on the submit path (times=1), then the link heals.
+    _os.environ[NET_SCHEDULE_ENV] = "reply:submit:drop:1.0:3:1"
+    CONFIG.reset()
+    retry_mod.reset_rpc_stats()
+    t0 = _time.monotonic()
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024**2,
+                 ignore_reinit_error=True,
+                 _system_config={"rpc_attempt_timeout": 0.3,
+                                 "direct_transport": False})
+    try:
+        @ray_tpu.remote
+        def double(i):
+            return i * 2
+
+        vals = ray_tpu.get([double.remote(i) for i in range(tasks)],
+                           timeout=60.0)
+        elapsed = _time.monotonic() - t0
+        stats = retry_mod.rpc_stats()
+        out = {
+            "tasks": tasks,
+            "exact_results": vals == [i * 2 for i in range(tasks)],
+            "net_faults_injected": stats["net_faults"],
+            "retries": stats["retries"] + stats["async_retries"],
+            "timeouts_raised": stats["timeouts"],
+            "elapsed_s": round(elapsed, 3),
+            # Generous bound: the dropped reply costs ~1 attempt timeout;
+            # anything near the 60s get() deadline means a hang.
+            "no_hang": elapsed < 30.0,
+        }
+        out["ok"] = bool(out["exact_results"]
+                         and out["net_faults_injected"] >= 1
+                         and out["retries"] >= 1
+                         and out["no_hang"])
+        return out
+    finally:
+        ray_tpu.shutdown()
+        _os.environ.pop(NET_SCHEDULE_ENV, None)
+        CONFIG.reset()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
@@ -361,7 +417,10 @@ def main() -> int:
     out["checkpoint"] = ckpt
     roll = run_rollout_smoke()
     out["rollout"] = roll
-    out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"])
+    rpc = run_rpc_chaos_smoke()
+    out["rpc_chaos"] = rpc
+    out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
+                     and rpc["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
