@@ -16,10 +16,16 @@ for the ``nth`` time (1-based, counted per process) at ``rank`` in gang
 mid-collective.  ``rank`` and ``generation`` accept ``*`` (any); generation
 defaults to ``0`` so a restarted gang (which re-exports
 RTPU_MESH_GENERATION) survives by default, making restart-then-succeed
-loops deterministic.  Kill sites: ``mesh_run`` (MeshWorker.run entry) and
-``train_report`` (TrainWorker result reporting).  Driver-side,
+loops deterministic.  Kill sites: ``mesh_run`` (MeshWorker.run entry),
+``train_report`` (TrainWorker result reporting), and the node-agent
+sites ``node_agent_spawn`` (counted per spawn_worker command),
+``node_agent_msg`` (per handled head message) and ``node_agent_tick``
+(per 0.5s reap tick) — a node-agent match SIGKILLs the agent AND all of
+its worker children, simulating whole-node loss.  Driver-side,
 ``kill_mesh_rank`` murders a specific (or seeded-random) rank of a live
-MeshGroup/WorkerGroup by killing its hosting worker process.
+MeshGroup/WorkerGroup by killing its hosting worker process, and
+``kill_node`` SIGKILLs a node-agent subprocess with its whole process
+group.
 
 Message-level transport faults (drop/duplicate/delay/sever individual
 control- and data-plane messages, deterministic and seeded): set
@@ -340,18 +346,25 @@ _schedule: Optional[ChaosSchedule] = None
 _schedule_spec: Optional[str] = None
 
 
+def check_die(op: str, rank: Optional[int] = None) -> bool:
+    """Consult the env kill schedule for this kill site; True means the
+    process is scheduled to die NOW (the caller decides how — plain
+    SIGKILL for workers, children-then-self for node agents)."""
+    global _schedule, _schedule_spec
+    spec = os.environ.get(KILL_SCHEDULE_ENV)
+    if not spec:
+        return False
+    if _schedule is None or spec != _schedule_spec:
+        _schedule = ChaosSchedule.from_spec(spec)
+        _schedule_spec = spec
+    return _schedule.should_die(op, rank)
+
+
 def maybe_die(op: str, rank: Optional[int] = None) -> None:
     """Worker-side kill site: consult the env schedule and SIGKILL the
     current process on a match (a hard crash — no atexit, no cleanup —
     exactly what a preempted TPU host looks like to the gang)."""
-    global _schedule, _schedule_spec
-    spec = os.environ.get(KILL_SCHEDULE_ENV)
-    if not spec:
-        return
-    if _schedule is None or spec != _schedule_spec:
-        _schedule = ChaosSchedule.from_spec(spec)
-        _schedule_spec = spec
-    if _schedule.should_die(op, rank):
+    if check_die(op, rank):
         import signal
 
         os.kill(os.getpid(), signal.SIGKILL)
@@ -394,6 +407,30 @@ def kill_mesh_rank(group, rank: Optional[int] = None,
         rng = rng or random.Random()
         rank = rng.randrange(len(workers))
     return rank if _kill_actor_process(workers[rank], head=head) else None
+
+
+def kill_node(proc) -> bool:
+    """SIGKILL an entire node: the agent subprocess AND every worker it
+    spawned, atomically via its process group (start the agent with
+    start_new_session=True — util.testing.start_node_agent does).  Falls
+    back to killing just the agent when it shares our group.  This is the
+    driver-side node-killer for chaos tests (reference:
+    python/ray/_private/test_utils.py:1337 node killer)."""
+    import signal
+
+    pid = getattr(proc, "pid", proc)
+    try:
+        pgid = os.getpgid(pid)
+    except OSError:
+        return False
+    try:
+        if pgid != os.getpgid(0):
+            os.killpg(pgid, signal.SIGKILL)
+        else:
+            os.kill(pid, signal.SIGKILL)
+        return True
+    except OSError:
+        return False
 
 
 def kill_random_worker(head=None, rng: Optional[random.Random] = None) -> bool:

@@ -222,4 +222,23 @@ CONFIG \
              "Per-chunk progress deadline on cross-host object pulls "
              "(0 = wait forever, the pre-deadline behavior).") \
     .declare("transfer_retries", int, 2,
-             "Extra pull attempts after a transfer connection failure.")
+             "Extra pull attempts after a transfer connection failure.") \
+    .declare("object_durability", str, "off",
+             "Durability policy for non-reconstructable (put) objects: "
+             "'off' (hot path untouched), 'replicate:K' (async replicas "
+             "on K holder nodes), 'spill' (async backup copy on disk).  "
+             "Gives node-loss survivability to objects lineage cannot "
+             "rebuild.") \
+    .declare("object_durability_min_bytes", int, 0,
+             "Only puts at least this large enter the durability plane "
+             "(inline puts below inline_object_threshold are head-"
+             "resident and already survive node loss).") \
+    .declare("node_lease_timeout_s", float, 15.0,
+             "A remote node agent whose heartbeat is silent this long is "
+             "declared dead (exactly once): its object locations are "
+             "discarded, leased/queued work is requeued, and its workers "
+             "are struck.  0 disables lease expiry (conn EOF remains the "
+             "only death signal).") \
+    .declare("node_heartbeat_period_s", float, 1.0,
+             "Node-agent liveness heartbeat period (any agent message "
+             "also refreshes the lease).")

@@ -308,9 +308,34 @@ class OwnedStore:
 # ---------------------------------------------------------------------------
 # Endpoint helpers
 # ---------------------------------------------------------------------------
+_machine_id_cache: Optional[str] = None
+
+
+def machine_id() -> str:
+    """Identity of the physical machine (NOT the logical ray_tpu "host":
+    several node agents with distinct host keys may share one box — the
+    virtual multi-host test substrate, and co-located agents in prod).
+    Used to decide whether an advertised loopback TCP endpoint is
+    actually reachable."""
+    global _machine_id_cache
+    if _machine_id_cache is None:
+        try:
+            with open("/proc/sys/kernel/random/boot_id") as f:
+                _machine_id_cache = f.read().strip()
+        except OSError:
+            import uuid
+
+            _machine_id_cache = f"node-{uuid.getnode():x}"
+    return _machine_id_cache
+
+
 def pick_endpoint(addr: Optional[dict], my_host_key: str) -> Optional[tuple]:
     """Choose a reachable endpoint from an advertised address dict
-    {"hk": host_key, "unix": path|None, "tcp": (host, port)|None}."""
+    {"hk": host_key, "mid": machine id, "unix": path|None,
+    "tcp": (host, port)|None}.  A loopback TCP endpoint is reachable
+    from a different logical host only when both live on the same
+    physical machine (owner fetches from co-located node agents — e.g.
+    weight-broadcast refs consumed by rollout actors on sibling nodes)."""
     if not addr:
         return None
     same_host = addr.get("hk") == my_host_key
@@ -320,7 +345,8 @@ def pick_endpoint(addr: Optional[dict], my_host_key: str) -> Optional[tuple]:
     if tcp is not None:
         host = tcp[0]
         loopback = host.startswith("127.") or host in ("localhost", "::1")
-        if same_host or not loopback:
+        if same_host or not loopback \
+                or addr.get("mid") == machine_id():
             return ("tcp", (host, int(tcp[1])))
     return None
 
@@ -356,7 +382,7 @@ class DirectServer:
         self.cancelled: set = set()
         self._shutdown = False
         self._listeners = []
-        addr: Dict[str, Any] = {"hk": host_key}
+        addr: Dict[str, Any] = {"hk": host_key, "mid": machine_id()}
         if session_dir:
             os.makedirs(session_dir, exist_ok=True)
             path = os.path.join(session_dir,
@@ -1038,7 +1064,16 @@ class DirectSubmitter:
         for oid_b, owner, prepinned in contained:
             oid = ObjectID(oid_b)
             try:
-                if self._is_self(owner):
+                if owner is None:
+                    # Head-counted nested ref: swap the returner's ret:
+                    # head ref for a res: ref tied to the result entry.
+                    # Both ride OUR head conn in order, so the add lands
+                    # before the release.
+                    self.core.transport.request_oneway(
+                        "add_ref", {"oid": oid, "holder": token})
+                    self.core.transport.request_oneway(
+                        "remove_ref", {"oid": oid, "holder": ret_tok})
+                elif self._is_self(owner):
                     self.owned.pin(oid, token)
                     if prepinned:
                         self.owned.unpin(oid, ret_tok)
@@ -1063,7 +1098,10 @@ class DirectSubmitter:
             for oid_b, owner, _prepinned in contained:
                 oid = ObjectID(oid_b)
                 try:
-                    if self._is_self(owner):
+                    if owner is None:
+                        self.core.transport.request_oneway(
+                            "remove_ref", {"oid": oid, "holder": token})
+                    elif self._is_self(owner):
                         self.owned.unpin(oid, token)
                     else:
                         self.unpin_at_owner(oid, owner, token)

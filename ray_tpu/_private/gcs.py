@@ -100,7 +100,8 @@ class ObjectEntry:
 
     __slots__ = (
         "object_id", "locations", "inline", "holders", "lineage_task",
-        "size", "meta", "spilled_path", "lost", "segment",
+        "size", "meta", "spilled_path", "lost", "segments",
+        "spill", "spill_host", "contained",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -115,9 +116,25 @@ class ObjectEntry:
         self.meta: Optional[bytes] = None
         self.spilled_path: Optional[str] = None
         self.lost = False
-        # Non-canonical shm segment name (pooled segments, SegmentPool);
-        # None means readers derive the name from the object id.
-        self.segment: Optional[str] = None
+        # Non-canonical shm segment name PER LOCATION (pooled segments,
+        # replica segments); a node absent here means readers on its host
+        # derive the name from the object id.  Per-node because a replica
+        # never shares the primary's segment name.
+        self.segments: Dict[NodeID, str] = {}
+        # Directory-side spill record: (path, meta, size) of an on-disk
+        # copy that outlives its store (eager durability backup or an
+        # eviction spill the head was told about).  ``spill_host`` is the
+        # host key owning the file; None = the head's own host — the form
+        # that stays valid across a head restart (host keys are per-
+        # process-random, the head host is not).
+        self.spill: Optional[Tuple[str, bytes, int]] = None
+        self.spill_host: Optional[str] = None
+        # Head-counted refs nested inside this object's value: each holds
+        # a ``res:<this id>`` holder ref for as long as THIS entry lives,
+        # released (cascading) when it is freed — a nested object must
+        # never die while something can still reach it through the outer
+        # ref (reference: contained-ref handover, reference_count.h:543).
+        self.contained: Optional[List[ObjectID]] = None
 
 
 class TaskEvent:
@@ -177,11 +194,25 @@ class GCS:
                                   if info.worker_id else None),
                     "num_restarts": info.num_restarts,
                 }
+            # Durable spill records: on-disk object copies outlive both
+            # their store AND the head process — a restarted head must be
+            # able to serve restores for them (spill-record survival
+            # across head kill9, the node-loss durability contract).
+            # Only head-host records (spill_host None) persist: a remote
+            # host's files are reachable only through its agent, which
+            # re-registers and re-reports its own spill state.
+            spills = {}
+            for oid, e in self.objects.items():
+                if e.spill is not None and e.spill_host is None:
+                    spills[oid.binary()] = {
+                        "path": e.spill[0], "meta": e.spill[1],
+                        "size": e.spill[2]}
             return {
                 "kv": {ns: dict(t) for ns, t in self.kv.items()},
                 "jobs": dict(self.jobs),
                 "named_actors": dict(self.named_actors),
                 "actors": actors,
+                "object_spills": spills,
             }
 
     def restore(self, snap: dict):
@@ -210,6 +241,18 @@ class GCS:
             for key, actor_id in snap.get("named_actors", {}).items():
                 if actor_id in self.actors:
                     self.named_actors.setdefault(key, actor_id)
+            import os as _os
+
+            from ray_tpu._private.ids import ObjectID as _ObjectID
+
+            for oid_bin, rec in snap.get("object_spills", {}).items():
+                if not _os.path.exists(rec["path"]):
+                    continue  # the file died with the old session dir
+                e = self._entry(_ObjectID(oid_bin))
+                e.spill = (rec["path"], rec["meta"], rec["size"])
+                e.spill_host = None
+                e.meta = e.meta or rec["meta"]
+                e.size = e.size or rec["size"]
 
     def save_snapshot(self, path: str):
         import os
@@ -383,7 +426,7 @@ class GCS:
             if meta is not None:
                 e.meta = meta
             if segment is not None:
-                e.segment = segment
+                e.segments[node_id] = segment
             if lineage_task is not None:
                 e.lineage_task = lineage_task
 
@@ -396,6 +439,20 @@ class GCS:
             e.lost = False
             if lineage_task is not None:
                 e.lineage_task = lineage_task
+
+    def object_spill_recorded(self, oid: ObjectID, path: str, meta: bytes,
+                              size: int, host: Optional[str] = None):
+        """Record a directory-side spill/backup copy: the bytes live at
+        ``path`` on ``host`` (None = the head host) and survive the owning
+        store's death.  The restore path is head._try_reconstruct."""
+        with self._lock:
+            e = self._entry(oid)
+            e.spill = (path, meta, size)
+            e.spill_host = host
+            if meta is not None and e.meta is None:
+                e.meta = meta
+            if size and not e.size:
+                e.size = size
 
     def object_lookup(self, oid: ObjectID) -> Optional[ObjectEntry]:
         with self._lock:
@@ -479,6 +536,13 @@ class GCS:
                 }
                 for a in self.actors.values()
             ]
+
+    def touch_node(self, node_id: NodeID):
+        """Refresh a node's liveness lease (any agent traffic counts)."""
+        with self._lock:
+            info = self.nodes.get(node_id)
+            if info is not None:
+                info.last_heartbeat = time.monotonic()
 
     def update_node_stats(self, node_id: NodeID, stats: dict):
         """Per-node usage snapshot from the monitor loop / node agent

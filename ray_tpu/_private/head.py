@@ -103,7 +103,15 @@ class Head:
         self._arena_leases: Dict[ObjectID, Dict[bytes, int]] = defaultdict(dict)
         self._arena_pending_free: set = set()
         self._cancelled: set = set()  # task ids cancelled while running
-        self._oom_killed: set = set()  # task ids killed by the memory monitor
+        # task id -> host usage fraction at kill time (memory-monitor
+        # victims, head- or agent-side): the death handler surfaces a
+        # typed OutOfMemoryError carrying the usage once retries run out.
+        self._oom_killed: Dict[TaskID, float] = {}
+        # Nodes declared dead exactly once: conn EOF, lease expiry, and
+        # explicit kills all funnel through remove_node, which must not
+        # double-run death processing (reference: the GCS node manager's
+        # single DEAD transition, gcs_node_manager.h).
+        self._dead_nodes: set = set()
         self._shutdown = False
         # Idempotency-key reply cache: retried/duplicated request frames
         # (client resends after a lost reply, chaos dup injection,
@@ -188,6 +196,33 @@ class Head:
                     self._schedule(info.creation_spec)
         self._boot_time = __import__("time").monotonic()
         self._reconnect_reaped = False
+        # ---- object durability plane (node-loss survivability) ----
+        # Puts have no lineage: without a second copy they die with their
+        # node.  object_durability=replicate:K keeps K async replicas on
+        # distinct holder nodes; =spill keeps an on-disk backup the head
+        # can restore from.  Off by default — the fault-free hot path
+        # pays only one predicate check per seal.
+        self._durability: Optional[tuple] = None
+        spec = (CONFIG.object_durability or "off").strip().lower()
+        if spec.startswith("replicate"):
+            k = 2
+            if ":" in spec:
+                try:
+                    k = max(2, int(spec.split(":", 1)[1]))
+                except ValueError:
+                    pass
+            self._durability = ("replicate", k)
+        elif spec == "spill":
+            self._durability = ("spill",)
+        self._durability_min = CONFIG.object_durability_min_bytes
+        self._durability_q = None
+        self._repl_client = None  # lazy TransferClient for replica pulls
+        if self._durability is not None:
+            import queue as _queue
+
+            self._durability_q = _queue.Queue()
+            threading.Thread(target=self._durability_loop,
+                             name="rtpu-durability", daemon=True).start()
         period = CONFIG.gcs_snapshot_period_s
         if period > 0:
             def snapshot_loop():
@@ -233,16 +268,46 @@ class Head:
                 base = host_snapshot()  # ONE cpu/mem read per tick —
                 # local raylets share this host (per-raylet cpu_percent
                 # calls would measure microsecond intervals)
+                from ray_tpu._private.recovery import recovery_stats
+
+                rec = recovery_stats()  # cluster-level recovery counters:
+                # exported on the head's own node row so chaos runs can
+                # assert recovery happened from node_stats/dashboard
                 with self._lock:
+                    first_local = True
                     for raylet in self.raylets.values():
                         if isinstance(raylet, RemoteRaylet):
                             continue
-                        self.gcs.update_node_stats(
-                            raylet.node_id,
-                            collect_node_stats(
-                                store=raylet.store,
-                                num_workers=len(raylet.workers),
-                                host_base=base))
+                        stats = collect_node_stats(
+                            store=raylet.store,
+                            num_workers=len(raylet.workers),
+                            host_base=base)
+                        if first_local:
+                            first_local = False
+                            stats.update(rec)
+                        self.gcs.update_node_stats(raylet.node_id, stats)
+            # Agent lease expiry: a remote node whose heartbeat went
+            # silent past the lease is declared dead exactly once — its
+            # locations are discarded (recovery paths take over), its
+            # leased/queued work is requeued, its workers struck
+            # (reference: gcs_health_check_manager.h node failure).
+            lease = CONFIG.node_lease_timeout_s
+            if lease > 0:
+                expired = []
+                now = _time.monotonic()
+                with self._lock:
+                    for nid, raylet in self.raylets.items():
+                        if not isinstance(raylet, RemoteRaylet) \
+                                or raylet.max_workers <= 0:
+                            continue  # local nodes + driver pseudo-nodes
+                        info = self.gcs.nodes.get(nid)
+                        if info is not None \
+                                and now - info.last_heartbeat > lease:
+                            expired.append(nid)
+                for nid in expired:
+                    self.remove_node(
+                        nid, cause=f"agent lease expired (no heartbeat "
+                                   f"for {lease:.0f}s)")
             with self._lock:
                 self._reap_unreconnected_actors()
                 self.memory_monitor.tick()
@@ -292,6 +357,12 @@ class Head:
             # LocalObjectManager spills pinned/referenced objects,
             # local_object_manager.h:41).
             raylet.store.should_spill = self._object_is_referenced
+            # Directory-side spill records: the head must know about every
+            # on-disk copy so it can serve restores after the owning
+            # store (node) dies — and so the record survives a head
+            # restart via the GCS snapshot.
+            raylet.store.spill_callback = (
+                lambda oid, nid=node_id: self._on_local_spill(oid, nid))
             self.raylets[node_id] = raylet
             self.node_host[node_id] = self.host_key
             self.scheduler.add_node(node_id, resources, labels)
@@ -315,6 +386,10 @@ class Head:
         resources = dict(msg["resources"])
         labels = msg.get("labels") or {}
         with self._lock:
+            # A healed partition may re-register a node the lease expiry
+            # already declared dead: it rejoins as a live node and must be
+            # removable again.
+            self._dead_nodes.discard(node_id)
             raylet = RemoteRaylet(
                 node_id, self, conn, msg["host_key"], msg["transfer_addr"],
                 labels, msg.get("max_workers", 64),
@@ -401,10 +476,21 @@ class Head:
         self._local_xfer[node_id] = srv
         self.node_xfer[node_id] = srv.address
 
-    def remove_node(self, node_id: NodeID):
-        """Node failure/departure (simulated for virtual nodes, real for
-        remote agents whose connection dropped)."""
+    def remove_node(self, node_id: NodeID, cause: str = "node removed"):
+        """Node-death protocol — one authority for every death signal
+        (agent conn EOF, lease expiry, chaos kill, explicit removal).
+        Exactly once per node: discard its object locations (surviving
+        replicas / spill records / lineage take over), requeue work that
+        was queued-but-never-started there, run worker-death processing
+        for every worker (running-task retries, lease reclaim, actor FSM,
+        rollout-worker strikes via ActorDiedError), and fail objects with
+        no recovery path so waiters error instead of hanging forever."""
+        from ray_tpu._private.recovery import note
+
         with self._lock:
+            if node_id in self._dead_nodes:
+                return
+            self._dead_nodes.add(node_id)
             raylet = self.raylets.pop(node_id, None)
             self.scheduler.remove_node(node_id)
             self.gcs.remove_node(node_id)
@@ -415,16 +501,90 @@ class Head:
                 srv.shutdown()
             if raylet is None:
                 return
-            # All workers on the node die.
+            if raylet.max_workers > 0:  # driver pseudo-nodes don't count
+                note("node_deaths")
+            # Queued-but-never-started specs: their node (and its held
+            # resources) died with them — reschedule cluster-wide with no
+            # attempt charged, they never ran.
+            queued, raylet.queued = list(raylet.queued), deque()
+            # All workers on the node die.  Their conns are left to the
+            # EOF teardown path (on_conn_closed), which reclaims each
+            # worker's held references and leases exactly as for a lone
+            # worker death.
             for h in list(raylet.workers.values()):
-                self._handle_worker_death(h, f"node {node_id} removed")
-            # Objects on the node are lost.
+                self._handle_worker_death(h, f"{cause}: node is dead")
+            for spec in queued:
+                self._schedule(spec)
+            # Tear the store down BEFORE reconstruction: a reconstructed
+            # task re-creating an output must not collide with (or be
+            # resolved against) the dead store's still-linked segments.
+            # Spill files survive — they are the durability plane's
+            # restore source.
+            raylet.shutdown(keep_spilled=True)
+            # Objects on the node are lost; recovery order: surviving
+            # replica location > lineage reconstruction > spill restore >
+            # typed ObjectLostError (never a silent hang).
             for oid, entry in list(self.gcs.objects.items()):
-                if node_id in entry.locations:
-                    entry.locations.discard(node_id)
-                    if not entry.locations and entry.inline is None:
-                        self._try_reconstruct(oid, entry)
-            raylet.shutdown()
+                if node_id not in entry.locations:
+                    continue
+                entry.locations.discard(node_id)
+                entry.segments.pop(node_id, None)
+                if entry.inline is not None:
+                    continue
+                if entry.locations:
+                    note("objects_restored")  # a replica carries it
+                    continue
+                # Mark lost BEFORE recovery: recovery paths that complete
+                # (restore, output reconstruct) clear it; an in-flight
+                # put re-run leaves it set so _fail_task can fail the put
+                # typed if the re-run can never schedule, and get-side
+                # probes keep re-entering _try_reconstruct meanwhile.
+                entry.lost = True
+                if not self._try_reconstruct(oid, entry):
+                    self._fail_object_locked(oid, exc.ObjectLostError(
+                        f"object {oid} was lost with its node ({cause}) "
+                        "and has no lineage, replica, or spill copy to "
+                        "recover from"))
+            self._drain_pending()
+            self._drive_pending_pgs()
+
+    def kill_node(self, node_id: NodeID):
+        """Chaos: SIGKILL every worker process on the node, then run the
+        node-death protocol — the in-process equivalent of SIGKILLing a
+        node agent and its children (no graceful store drain, no worker
+        shutdown handshake)."""
+        with self._lock:
+            raylet = self.raylets.get(node_id)
+            if raylet is None:
+                return
+            for h in list(raylet.workers.values()):
+                try:
+                    h.proc.kill()
+                except Exception:
+                    pass
+        self.remove_node(node_id, cause="node killed (chaos)")
+
+    def _fail_object_locked(self, oid: ObjectID, error: BaseException):
+        """No recovery path: record the error as the object's value so
+        every current waiter and future get raises it (reference: owner
+        failure => ObjectLostError, never an indefinite hang)."""
+        from ray_tpu._private.recovery import note
+
+        note("objects_lost")
+        meta, data = _serialize_error(error)
+        self._record_error_result(oid, (meta, data))
+
+    def _on_local_spill(self, oid: ObjectID, node_id: NodeID):
+        """A local raylet store wrote a spill/backup file: mirror the
+        record into the directory so it can outlive the store (node
+        death restore) and the head process (GCS snapshot)."""
+        raylet = self.raylets.get(node_id)
+        if raylet is None:
+            return
+        rec = raylet.store.spilled_lookup(oid)
+        if rec is not None:
+            self.gcs.object_spill_recorded(oid, rec["path"], rec["meta"],
+                                           rec["size"], host=None)
 
     # ================= worker connections =================
     def _accept_loop(self, listener=None, thread_name: str = "rtpu-conn"):
@@ -464,6 +624,11 @@ class Head:
             while True:
                 msg = conn.recv()
                 mtype = msg.get("type")
+                if agent_node is not None:
+                    # Any traffic from an agent refreshes its liveness
+                    # lease; the dedicated "heartbeat" frames just bound
+                    # the silence of an otherwise-idle node.
+                    self.gcs.touch_node(agent_node)
                 if mtype == "register":
                     worker_id = WorkerID(msg["worker_id"])
                     self._on_register(worker_id, NodeID(msg["node_id"]), conn,
@@ -481,6 +646,15 @@ class Head:
                     if agent_node is not None:
                         self.gcs.update_node_stats(agent_node,
                                                    msg.get("stats") or {})
+                elif mtype == "heartbeat":
+                    pass  # touch_node above already refreshed the lease
+                elif mtype == "worker_oom":
+                    if agent_node is not None:
+                        self.on_worker_oom(WorkerID(msg["worker_id"]),
+                                           float(msg.get("usage", 0.0)))
+                elif mtype == "object_replicated":
+                    if agent_node is not None:
+                        self.on_object_replicated(agent_node, msg)
                 elif mtype == "object_evicted":
                     nid = agent_node or (driver_wid and
                                          self._driver_nodes.get(driver_wid))
@@ -498,6 +672,13 @@ class Head:
                                 raylet.store.note_spilled(
                                     ObjectID(msg["oid"]), msg["path"],
                                     msg["meta"], msg["size"])
+                            # Directory-side copy of the record, tagged
+                            # with the owning host: same-host restores
+                            # survive the proxy (and the node row) dying.
+                            self.gcs.object_spill_recorded(
+                                ObjectID(msg["oid"]), msg["path"],
+                                msg["meta"], msg["size"],
+                                host=self.node_host.get(nid))
                 elif mtype == "task_done":
                     self.on_task_done(msg)
                 elif mtype == "worker_blocked":
@@ -564,6 +745,36 @@ class Head:
             raylet.on_worker_lost(h.worker_id)
             self._conns.pop(h.worker_id, None)
             raylet.try_dispatch()
+
+    def on_worker_oom(self, worker_id: WorkerID, usage: float):
+        """A node agent's memory monitor is about to kill (or just killed)
+        one of its workers: mark the victim's running task so its death
+        surfaces as a typed, retryable OutOfMemoryError instead of a
+        generic WorkerCrashedError (the head-side monitor marks its own
+        victims the same way in memory_monitor.tick)."""
+        from ray_tpu._private.recovery import note
+
+        with self._lock:
+            _, h = self._find_worker(worker_id)
+            if h is None or h.current_task is None:
+                return
+            note("oom_worker_kills")
+            self._oom_killed[h.current_task.task_id] = usage
+
+    def on_object_replicated(self, node_id: NodeID, msg: dict):
+        """An agent finished pulling a durability replica into its store:
+        register the new location (readers on that host resolve the
+        replica's own segment name, never the primary's)."""
+        from ray_tpu._private.recovery import note
+
+        oid = ObjectID(msg["oid"])
+        with self._lock:
+            if node_id not in self.raylets:
+                return  # replica landed after the node died: useless
+            self.gcs.object_sealed(oid, node_id, msg["size"],
+                                   meta=msg.get("meta"),
+                                   segment=msg.get("segment"))
+            note("objects_replicated")
 
     def on_driver_disconnected(self, driver_wid: bytes):
         with self._lock:
@@ -788,6 +999,15 @@ class Head:
             if entry is not None and entry.lost:
                 if not self._try_reconstruct(oid, entry):
                     reply(error=exc.ObjectLostError(f"{oid} lost and not reconstructable"))
+                    return
+                # A spill restore completes synchronously (its notify ran
+                # before this waiter registered): re-resolve now instead
+                # of parking a callback nothing will ever fire.
+                resolved = self._resolve_object(oid, caller_host=caller_host)
+                if resolved is not None:
+                    if resolved.get("kind") == "arena":
+                        self._grant_arena_lease(oid, caller)
+                    reply(resolved)
                     return
             cb_list = self._object_waiters[oid]
             record = {"done": False}
@@ -1276,8 +1496,8 @@ class Head:
             spec_worker = self.running.pop(task_id, None)
             # Completion can race an OOM kill decision (the monitor marked the
             # task just as its result message arrived) — drop the mark so the
-            # set can't grow unboundedly.
-            self._oom_killed.discard(task_id)
+            # map can't grow unboundedly.
+            self._oom_killed.pop(task_id, None)
             worker_id = WorkerID(msg["worker_id"])
             raylet, handle = self._find_worker(worker_id)
             spec: Optional[TaskSpec] = msg.get("spec") or (
@@ -1332,6 +1552,16 @@ class Head:
         if res.inline is not None:
             self.gcs.object_inline(res.object_id, res.inline[0], res.inline[1],
                                    lineage_task=task_id)
+            if res.contained:
+                # Head-counted refs nested in the result value: pin them
+                # under the result entry's lifetime.  This runs while
+                # processing task_done, which the returner's connection
+                # ordered BEFORE its own ref-gc drops — so the nested
+                # object cannot be freed in the caller-registration
+                # window.  (Owner-resident items carry an owner address
+                # and are handled by the direct handover instead.)
+                self._link_contained(res.object_id, [
+                    c[0] for c in res.contained if c[1] is None])
         elif res.in_store and node_id is not None:
             self.gcs.object_sealed(res.object_id, node_id, res.size,
                                    lineage_task=task_id, meta=res.meta)
@@ -1366,6 +1596,14 @@ class Head:
             self._record_error_result(oid, (meta, data))
         self.gcs.update_task_status(spec.task_id, TaskStatus.FAILED,
                                     error=str(error))
+        # Lost puts waiting on this task's re-execution (put
+        # reconstruction, _try_reconstruct) can never recover now: fail
+        # them typed so their waiters error instead of hanging.
+        for oid, e in list(self.gcs.objects.items()):
+            if e.lost and oid.is_put() and oid.task_id() == spec.task_id:
+                self._fail_object_locked(oid, exc.ObjectLostError(
+                    f"put {oid} was lost and its creating task could "
+                    f"not be re-executed: {error}"))
         if spec.task_type == TaskType.ACTOR_CREATION:
             info = self.gcs.get_actor_info(spec.actor_id)
             if info is not None:
@@ -1480,18 +1718,18 @@ class Head:
                 self.scheduler.return_resources(handle.node_id, spec)
             self.running.pop(spec.task_id, None)
             cancelled = spec.task_id in self._cancelled
-            oom = spec.task_id in self._oom_killed
-            self._oom_killed.discard(spec.task_id)
+            oom = self._oom_killed.pop(spec.task_id, None)
             if cancelled:
                 self._cancelled.discard(spec.task_id)
                 self._fail_task(spec, exc.RayTpuError("task cancelled"))
             elif spec.attempt < spec.max_retries:
                 spec.attempt += 1
                 self._schedule(spec)
-            elif oom:
+            elif oom is not None:
                 self._fail_task(spec, exc.OutOfMemoryError(
-                    "task was killed by the memory monitor under host "
-                    "memory pressure and exhausted its retries"))
+                    f"task was killed by the memory monitor under host "
+                    f"memory pressure (usage {oom:.0%} at kill time) and "
+                    f"exhausted its retries"))
             else:
                 self._fail_task(spec, exc.WorkerCrashedError(cause))
         # Collect in-flight actor tasks bound to this worker: the actor FSM
@@ -1648,8 +1886,33 @@ class Head:
                                lineage_task=msg.get("lineage_task"),
                                meta=msg.get("meta"),
                                segment=msg.get("segment"))
+        self._link_contained(oid, msg.get("contained"))
+        self._maybe_make_durable(oid, msg["size"])
         self._notify_object(oid)
         return oid
+
+    def _link_contained(self, oid: ObjectID, contained) -> None:
+        """Pin head-counted refs nested in an object's value under the
+        object's own lifetime (res:<oid> holders), released cascading in
+        _free_object.  Ordering makes this race-free: the seal/result
+        message carrying the nested ids rides the creator's connection
+        BEFORE its own ref-gc drop, so the nested object can never be
+        freed in the handoff window between the creator's drop and the
+        consumer's register (reference: reference_count.h:543)."""
+        if not contained:
+            return
+        entry = self.gcs.object_lookup(oid)
+        if entry is None:
+            return
+        holder = b"res:" + oid.binary()
+        linked = entry.contained or []
+        for coid_bin in contained:
+            coid = ObjectID(coid_bin)
+            if coid == oid or coid in linked:
+                continue  # duplicate seal frame (chaos dup / resend)
+            self.gcs.add_reference(coid, holder)
+            linked.append(coid)
+        entry.contained = linked
 
     def on_seal_batch(self, msg: dict):
         """Coalesced seal burst (put_many): adopt + register every object
@@ -1669,6 +1932,7 @@ class Head:
                 oid = ObjectID(item["oid"])
                 self.gcs.object_inline(oid, item["meta"], item["data"],
                                        lineage_task=item.get("lineage_task"))
+                self._link_contained(oid, item.get("contained"))
                 self._notify_object(oid)
 
     def on_arena_sealed(self, msg: dict):
@@ -1677,6 +1941,8 @@ class Head:
         with self._lock:
             self.gcs.object_sealed(oid, NodeID(msg["node_id"]), msg["size"],
                                    lineage_task=msg.get("lineage_task"))
+            self._link_contained(oid, msg.get("contained"))
+            self._maybe_make_durable(oid, msg["size"])
             self._notify_object(oid)
 
     def on_put_inline(self, msg: dict):
@@ -1684,6 +1950,7 @@ class Head:
         with self._lock:
             self.gcs.object_inline(oid, msg["meta"], msg["data"],
                                    lineage_task=msg.get("lineage_task"))
+            self._link_contained(oid, msg.get("contained"))
             self._notify_object(oid)
 
     def _caller_host(self, caller: Optional[WorkerID]) -> str:
@@ -1715,8 +1982,6 @@ class Head:
             if meta.startswith(ERROR_META):
                 return {"kind": "error", "meta": meta[len(ERROR_META):], "data": data}
             return {"kind": "inline", "meta": meta, "data": data}
-        if not entry.locations:
-            return None
         ch = caller_host or self.host_key
         local_misses = 0
         # Same-host locations first: direct segment attach.
@@ -1736,7 +2001,7 @@ class Head:
                     return hit
                 if entry.meta is not None:
                     return {"kind": "store", "oid": oid, "meta": entry.meta,
-                            "segment": entry.segment}
+                            "segment": entry.segments.get(node_id)}
             else:
                 hit = raylet.store.arena_lookup(oid)
                 if hit is not None:
@@ -1749,17 +2014,32 @@ class Head:
                 if hit is not None:
                     return hit
                 local_misses += 1
-        # Cross-host: hand out a pull resolution against any owning store.
+        # Cross-host: hand out a pull resolution against the owning
+        # stores.  ALL live holder addresses ride along so the puller can
+        # fail over to an alternate replica when the serving node dies
+        # mid-pull (location failover, reference: pull_manager retries
+        # against updated object directory locations).
+        addrs = []
         for node_id in entry.locations:
             if self.node_host.get(node_id, self.host_key) == ch:
                 continue
             addr = self.node_xfer.get(node_id)
             if addr is not None:
-                return {"kind": "pull", "oid": oid, "addr": list(addr),
-                        "size": entry.size}
-        if local_misses == len(entry.locations):
+                addrs.append(list(addr))
+        if addrs:
+            return {"kind": "pull", "oid": oid, "addr": addrs[0],
+                    "addrs": addrs, "size": entry.size}
+        # Directory-side spill record readable on the caller's host: the
+        # owning store (node) is gone but its file survives.
+        if entry.spill is not None \
+                and (entry.spill_host or self.host_key) == ch:
+            path, meta, size = entry.spill
+            return {"kind": "spilled", "path": path, "meta": meta,
+                    "size": size}
+        if entry.locations and local_misses == len(entry.locations):
             # Every location was a local store that no longer has the bytes.
             entry.locations.clear()
+            entry.segments.clear()
             entry.lost = True
         return None
 
@@ -1782,18 +2062,90 @@ class Head:
         entry = self.gcs.object_lookup(oid)
         if entry is not None:
             entry.locations.discard(node_id)
+            entry.segments.pop(node_id, None)
             if not entry.locations and entry.inline is None:
                 entry.lost = True
 
     def _try_reconstruct(self, oid: ObjectID, entry) -> bool:
-        """Lineage reconstruction: resubmit the creating task
-        (reference: object_recovery_manager.h:41)."""
+        """Recovery for an object with no readable copy: lineage
+        reconstruction first (reference: object_recovery_manager.h:41),
+        then the durability plane's spill/backup record.
+
+        Puts reconstruct too, when made INSIDE a task: a put id embeds
+        its creating task id, so while that task's lineage is retained
+        (its returns are still referenced) a deterministic re-execution
+        re-seals the same put ids — this closes the async-durability
+        window where a node dies between a put's seal and its replica
+        landing.  Driver puts and actor-task puts have no retained
+        lineage and fall through to the spill record."""
+        from ray_tpu._private.recovery import note
+
         task = self.gcs.get_lineage(oid.task_id())
-        if task is None or oid.is_put():
+        if task is not None and not oid.is_put():
+            task.attempt += 1
+            entry.lost = False
+            note("objects_reconstructed")
+            self._schedule(task)
+            return True
+        # Puts: a spill/backup record restores deterministically without
+        # recompute — prefer it over re-running the creating task.
+        if self._restore_from_spill(oid, entry):
+            return True
+        if task is None:
             return False
+        ev = self.gcs.task_events.get(task.task_id)
+        if ev is not None and ev.status in (
+                TaskStatus.PENDING, TaskStatus.SCHEDULED,
+                TaskStatus.RUNNING):
+            # A live attempt (worker-death retry, or the re-run a
+            # sibling put of the same task already triggered) will
+            # re-seal this put: don't resubmit again.
+            return True
+        note("objects_reconstructed")
         task.attempt += 1
-        entry.lost = False
+        # lost stays True until the re-run re-seals the put
+        # (object_sealed clears it); if the re-run can never schedule,
+        # _fail_task fails this entry typed.
         self._schedule(task)
+        return True
+
+    def _restore_from_spill(self, oid: ObjectID, entry) -> bool:
+        """Re-materialize an object from its directory-side spill record
+        into a surviving local store, so every caller (any host) resolves
+        it again.  Only head-host files are readable here; remote spill
+        files are served by their (surviving) agent instead."""
+        from ray_tpu._private.recovery import note
+
+        if entry.spill is None:
+            return False
+        if (entry.spill_host or self.host_key) != self.host_key:
+            return False  # the file lives on a host we cannot read
+        path, meta, _size = entry.spill
+        target_nid = target = None
+        for nid, raylet in self.raylets.items():
+            if not isinstance(raylet.store, RemoteStoreProxy) \
+                    and not raylet.dead:
+                target_nid, target = nid, raylet
+                break
+        if target is None:
+            # No live local store to land it in: same-host readers are
+            # still served straight off the file (resolution "spilled").
+            entry.lost = False
+            return True
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        try:
+            seg = target.store.put_replica(oid, meta, data)
+        except Exception:
+            return False
+        self.gcs.object_sealed(oid, target_nid, len(data), meta=meta,
+                               segment=seg)
+        entry.lost = False
+        note("objects_restored")
+        self._notify_object(oid)
         return True
 
     def _free_object(self, oid: ObjectID):
@@ -1813,7 +2165,15 @@ class Head:
             raylet = self.raylets.get(node_id)
             if raylet is not None:
                 raylet.store.delete(oid)
+        contained = entry.contained
         self.gcs.free_object(oid)
+        if contained:
+            # Cascade: the outer object's death releases its res: pins on
+            # nested refs — freeing them too when nothing else holds them.
+            holder = b"res:" + oid.binary()
+            for coid in contained:
+                if self.gcs.remove_reference(coid, holder):
+                    self._free_object(coid)
 
     # ----- arena reader leases -----
     def _grant_arena_lease(self, oid: ObjectID, caller: Optional[WorkerID]):
@@ -1853,11 +2213,189 @@ class Head:
             self._arena_pending_free.discard(oid)
             self._free_object(oid)
 
+    # ================= object durability =================
+    def _maybe_make_durable(self, oid: ObjectID, size: int):
+        """Seal-time hook (under the head lock): puts are non-
+        reconstructable — queue them for async replication/backup.  One
+        predicate when durability is off; never blocks the seal path."""
+        if self._durability_q is not None and size >= self._durability_min \
+                and oid.is_put():
+            self._durability_q.put(oid)
+
+    def _durability_loop(self):
+        while not self._shutdown:
+            oid = self._durability_q.get()
+            if oid is None:
+                return
+            try:
+                if self._durability[0] == "replicate":
+                    self._replicate_one(oid, self._durability[1])
+                else:
+                    self._backup_one(oid)
+            except Exception:
+                traceback.print_exc()
+
+    @staticmethod
+    def _read_store_bytes(store) -> "Callable[[ObjectID], tuple]":
+        """Reader over a local store covering all three residences a
+        sealed object can have: shm segment, native arena, spill file."""
+        def read(oid: ObjectID):
+            got = store.get(oid)
+            if got is not None:
+                meta, view = got
+                return meta, bytes(view)
+            lock = getattr(store, "_lock", None)
+            if lock is not None:
+                with lock:
+                    hit = store.arena_lookup(oid)
+                    if hit is not None:
+                        from ray_tpu._native import ArenaReader
+
+                        view = ArenaReader.view(hit["store"], hit["offset"],
+                                                hit["size"],
+                                                hit["capacity"])
+                        return hit["meta"], bytes(view)
+            rec = store.read_spilled(oid)
+            if rec is not None:
+                return rec
+            return None, None
+
+        return read
+
+    def _replicate_one(self, oid: ObjectID, k: int):
+        """Bring a put up to K holder locations: copy its bytes into
+        surviving stores (direct store-to-store for in-process raylets,
+        agent-side pulls for remote nodes).  Best-effort and async — a
+        node dying mid-replication just leaves fewer copies."""
+        from ray_tpu._private.recovery import note
+
+        with self._lock:
+            entry = self.gcs.object_lookup(oid)
+            if entry is None or entry.inline is not None or entry.lost:
+                return
+            have = set(entry.locations)
+            need = k - len(have)
+            if need <= 0:
+                return
+            size = entry.size
+            # Source preference: a local store (zero-copy read) over a
+            # remote pull.
+            src_nid = src_raylet = None
+            for nid in have:
+                raylet = self.raylets.get(nid)
+                if raylet is not None and not isinstance(
+                        raylet.store, RemoteStoreProxy):
+                    src_nid, src_raylet = nid, raylet
+                    break
+            src_addr = None
+            if src_raylet is None:
+                for nid in have:
+                    addr = self.node_xfer.get(nid)
+                    if addr is not None:
+                        src_nid, src_addr = nid, addr
+                        break
+                if src_addr is None:
+                    return  # no readable source
+            # Targets: local stores first (replicas there survive any
+            # agent death and cost no network), then remote agents.
+            local_t, remote_t = [], []
+            for nid, raylet in self.raylets.items():
+                if nid in have or raylet.dead or raylet.max_workers <= 0:
+                    continue
+                if isinstance(raylet.store, RemoteStoreProxy):
+                    remote_t.append((nid, raylet))
+                else:
+                    local_t.append((nid, raylet))
+            if src_raylet is not None:
+                src_raylet.store.pin(oid)  # survive eviction mid-copy
+        meta = data = None
+        try:
+            if src_raylet is not None:
+                meta, data = self._read_store_bytes(src_raylet.store)(oid)
+            else:
+                try:
+                    meta, data = self._repl_pull(src_addr, oid)
+                except Exception:
+                    return
+        finally:
+            if src_raylet is not None:
+                src_raylet.store.unpin(oid)
+        if data is None:
+            return
+        for nid, raylet in local_t:
+            if need <= 0:
+                break
+            try:
+                seg = raylet.store.put_replica(oid, meta, data)
+            except Exception:
+                continue  # store full/racing shutdown: try the next node
+            with self._lock:
+                if nid not in self.raylets:
+                    continue  # died while we copied
+                self.gcs.object_sealed(oid, nid, len(data), meta=meta,
+                                       segment=seg)
+            note("objects_replicated")
+            need -= 1
+        if need > 0:
+            # Remote targets pull from the source's transfer server and
+            # ack with "object_replicated" (location registered there).
+            pull_addr = self.node_xfer.get(src_nid) if src_addr is None \
+                else src_addr
+            if pull_addr is None:
+                return
+            for nid, raylet in remote_t:
+                if need <= 0:
+                    break
+                raylet.send_agent({"type": "store_pull",
+                                   "oid": oid.binary(),
+                                   "addr": list(pull_addr),
+                                   "size": size, "meta": meta})
+                need -= 1
+
+    def _repl_pull(self, addr, oid: ObjectID):
+        if self._repl_client is None:
+            from ray_tpu._private.transfer import TransferClient
+
+            self._repl_client = TransferClient(self.authkey)
+        return self._repl_client.pull(tuple(addr), oid)
+
+    def _backup_one(self, oid: ObjectID):
+        """Durability spill: ensure an on-disk copy exists somewhere (the
+        owning store keeps serving from memory; only loss reads the
+        file).  The spill callback / object_spilled report mirrors the
+        record into the directory, where it survives node death and —
+        via the GCS snapshot — head restarts."""
+        with self._lock:
+            entry = self.gcs.object_lookup(oid)
+            if entry is None or entry.inline is not None \
+                    or entry.spill is not None:
+                return
+            target = None
+            for nid in entry.locations:
+                raylet = self.raylets.get(nid)
+                if raylet is None:
+                    continue
+                if isinstance(raylet.store, RemoteStoreProxy):
+                    raylet.send_agent({"type": "store_backup",
+                                       "oid": oid.binary()})
+                    return
+                target = raylet
+                break
+        if target is not None:
+            target.store.backup(oid)  # spill_callback records it
+
     # ================= shutdown =================
     def shutdown(self):
         self.log_monitor.stop()
         with self._lock:
             self._shutdown = True
+            if self._durability_q is not None:
+                self._durability_q.put(None)
+            if self._repl_client is not None:
+                try:
+                    self._repl_client.close()
+                except Exception:
+                    pass
             for raylet in self.raylets.values():
                 raylet.shutdown()
             self.raylets.clear()

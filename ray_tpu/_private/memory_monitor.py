@@ -189,9 +189,13 @@ class MemoryMonitor:
              "task": spec.name if spec else None,
              "usage": usage})
         # Mark so the death handler reports OutOfMemoryError (not a generic
-        # crash) when the retry budget is exhausted.
+        # crash, and with the usage at kill time) when the retry budget is
+        # exhausted.
         if spec is not None:
-            self.head._oom_killed.add(spec.task_id)
+            from ray_tpu._private.recovery import note
+
+            note("oom_worker_kills")
+            self.head._oom_killed[spec.task_id] = usage
         try:
             victim.proc.kill()
         except Exception:

@@ -68,6 +68,7 @@ class NodeAgent:
         self._shutdown = threading.Event()
         self.node_id = None  # assigned by head in register reply
         self._stats_period = None  # head-resolved, set in register reply
+        self._xfer_client = None  # lazy: durability replica pulls
 
     def send(self, msg: dict):
         with self._send_lock:
@@ -136,6 +137,8 @@ class NodeAgent:
                          daemon=True).start()
         threading.Thread(target=self._stats_loop, name="rtpu-agent-stats",
                          daemon=True).start()
+        threading.Thread(target=self._heartbeat_loop, name="rtpu-agent-hb",
+                         daemon=True).start()
         try:
             while not self._shutdown.is_set():
                 try:
@@ -148,14 +151,35 @@ class NodeAgent:
         finally:
             self.shutdown()
 
+    def _chaos_site(self, op: str):
+        """Node-level kill site: a schedule match SIGKILLs the agent AND
+        every worker child — whole-node loss, no cleanup, exactly what a
+        preempted/OOM-killed host looks like to the head."""
+        from ray_tpu._private.chaos import check_die
+
+        if not check_die(op):
+            return
+        import signal
+
+        with self._children_lock:
+            procs = list(self._children.values())
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        os.kill(os.getpid(), signal.SIGKILL)
+
     def _handle(self, msg: dict):
         t = msg.get("type")
+        self._chaos_site("node_agent_msg")
         try:
             if t == "node_registered":
                 self.node_id = NodeID(msg["node_id"])
                 if "node_stats_period_s" in msg:
                     self._stats_period = float(msg["node_stats_period_s"])
             elif t == "spawn_worker":
+                self._chaos_site("node_agent_spawn")
                 self._spawn_worker(msg)
             elif t == "kill_worker":
                 self._kill_worker(msg["worker_id"])
@@ -164,10 +188,49 @@ class NodeAgent:
                                  msg["meta"], segment=msg.get("segment"))
             elif t == "store_delete":
                 self.store.delete(ObjectID(msg["oid"]))
+            elif t == "store_pull":
+                # Durability replica: pull the object from the named
+                # holder into OUR store (off the reader thread — a pull
+                # can move gigabytes) and ack with the replica's segment.
+                threading.Thread(target=self._store_pull, args=(msg,),
+                                 name="rtpu-agent-pull",
+                                 daemon=True).start()
+            elif t == "store_backup":
+                oid = ObjectID(msg["oid"])
+                self.store.backup(oid)  # spill_callback reports the record
             elif t == "shutdown":
                 self._shutdown.set()
         except Exception:
             traceback.print_exc()
+
+    def _store_pull(self, msg: dict):
+        oid = ObjectID(msg["oid"])
+        try:
+            if self._xfer_client is None:
+                from ray_tpu._private.transfer import TransferClient
+
+                self._xfer_client = TransferClient(self.authkey)
+            meta, data = self._xfer_client.pull(tuple(msg["addr"]), oid)
+            seg = self.store.put_replica(oid, meta, data)
+            self.send({"type": "object_replicated", "oid": oid.binary(),
+                       "size": len(data), "meta": meta, "segment": seg})
+        except Exception:
+            traceback.print_exc()
+
+    def _heartbeat_loop(self):
+        """Liveness lease renewal: the head declares this node dead when
+        heartbeats go silent past node_lease_timeout_s (any other agent
+        message also renews — this just bounds the idle silence)."""
+        from ray_tpu._private.config import CONFIG
+
+        period = max(0.1, CONFIG.node_heartbeat_period_s)
+        while not self._shutdown.is_set():
+            time.sleep(period)
+            self._chaos_site("node_agent_tick")
+            try:
+                self.send({"type": "heartbeat"})
+            except Exception:
+                pass  # head restarting: reconnect loop handles it
 
     def _spawn_worker(self, msg: dict):
         env = dict(os.environ)
@@ -262,6 +325,15 @@ class NodeAgent:
                     victim = (wid, proc)  # dict order: newest spawn last
             if victim is None:
                 continue
+            try:
+                # Mark BEFORE the kill on the same ordered conn the exit
+                # report rides, so the head types the death as an OOM
+                # (OutOfMemoryError w/ usage, retryable) instead of a
+                # generic worker crash.
+                self.send({"type": "worker_oom",
+                           "worker_id": victim[0], "usage": usage})
+            except Exception:
+                pass
             try:
                 victim[1].kill()
             except Exception:

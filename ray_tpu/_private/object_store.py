@@ -33,6 +33,10 @@ INLINE_OBJECT_THRESHOLD = 100 * 1024
 
 _PREFIX = "rtpu_"
 
+# Monotonic suffix for replica segment names (put_replica): replicas of the
+# same object on different stores of one process must not collide.
+_replica_counter = 0
+
 
 def _segment_name(object_id: ObjectID) -> str:
     return _PREFIX + object_id.hex()
@@ -186,6 +190,10 @@ class SegmentPool:
         self.free_bytes = 0
         self._lock = threading.Lock()
         self._counter = 0
+        # Per-pool uniquifier: several stores (each with its own pool) can
+        # live in ONE process — virtual multi-node clusters, a restarted
+        # in-process head — and per-pid naming alone would collide.
+        self._uid = os.urandom(3).hex()
         self._closed = False
         self._prewarm_thread: Optional[threading.Thread] = None
         self.hits = 0
@@ -206,7 +214,7 @@ class SegmentPool:
             self._counter += 1
             n = self._counter
         shm = shared_memory.SharedMemory(
-            name=f"{_PREFIX}pool_{os.getpid()}_{n}", create=True,
+            name=f"{_PREFIX}pool_{os.getpid()}_{self._uid}_{n}", create=True,
             size=cls_size)
         note_owned(shm)
         track_for_exit(shm)
@@ -444,13 +452,19 @@ class SharedMemoryStore:
 
     # -- create/seal ------------------------------------------------------
     def create(self, object_id: ObjectID, data_size: int,
-               overcommit: bool = False) -> memoryview:
+               overcommit: bool = False,
+               segment: Optional[str] = None) -> memoryview:
         """Allocate a writable segment for a new object.
 
         ``overcommit=True`` keeps the zero-round-trip in-process put path
         lossless under pressure: after eviction/spill the create proceeds
         even above capacity (the same contract adopt() gives worker-
-        written segments) instead of raising."""
+        written segments) instead of raising.
+
+        ``segment`` forces a dedicated shm segment with that name instead
+        of the canonical per-object one — required for replica writes,
+        where the canonical name may already exist on this machine (the
+        primary copy in a sibling virtual node's store)."""
         with self._lock:
             if object_id in self._objects:
                 raise ObjectExistsError(object_id)
@@ -472,13 +486,14 @@ class SharedMemoryStore:
                     self.used, data_size, self.capacity)
             pool_class = None
             shm = None
-            if self.pool is not None and data_size >= SegmentPool.MIN_CLASS:
+            if segment is None and self.pool is not None \
+                    and data_size >= SegmentPool.MIN_CLASS:
                 acq = self.pool.acquire(data_size)
                 if acq is not None:
                     shm, pool_class = acq
             if shm is None:
                 shm = shared_memory.SharedMemory(
-                    name=_segment_name(object_id), create=True,
+                    name=segment or _segment_name(object_id), create=True,
                     size=max(1, data_size))
                 note_owned(shm)
                 track_for_exit(shm)
@@ -489,12 +504,14 @@ class SharedMemoryStore:
 
     def segment_of(self, object_id: ObjectID) -> Optional[str]:
         """Segment name when it differs from the canonical per-object name
-        (pooled segments); None means readers derive it from the id."""
+        (pooled segments, replica segments); None means readers derive it
+        from the id."""
         with self._lock:
             obj = self._objects.get(object_id)
-            if obj is None or obj.pool_class is None:
+            if obj is None:
                 return None
-            return obj.shm.name
+            name = obj.shm.name
+            return name if name != _segment_name(object_id) else None
 
     def seal(self, object_id: ObjectID, metadata: bytes = b""):
         with self._lock:
@@ -508,6 +525,30 @@ class SharedMemoryStore:
         if len(data):
             buf[:] = data
         self.seal(object_id, metadata)
+
+    def put_replica(self, object_id: ObjectID, metadata: bytes,
+                    data) -> Optional[str]:
+        """Store a durability replica of an object owned by another node.
+
+        Always lands in a uniquely-named segment: on a multi-virtual-node
+        machine the primary's canonical segment already exists host-wide,
+        so a canonical-name create would collide.  Returns the segment
+        name (readers resolve it via ``segment_of``), or None when the
+        object is already present here."""
+        global _replica_counter
+        with self._lock:
+            if object_id in self._objects:
+                return self.segment_of(object_id)
+            _replica_counter += 1
+            seg = f"{_PREFIX}rep_{os.getpid()}_{_replica_counter}"
+        try:
+            buf = self.create(object_id, len(data), segment=seg)
+        except ObjectExistsError:
+            return self.segment_of(object_id)
+        if len(data):
+            buf[:] = data
+        self.seal(object_id, metadata)
+        return seg
 
     # -- read -------------------------------------------------------------
     def contains(self, object_id: ObjectID) -> bool:
@@ -661,6 +702,33 @@ class SharedMemoryStore:
             except Exception:
                 pass
 
+    def backup(self, oid: ObjectID) -> Optional[Tuple[str, bytes, int]]:
+        """Durability spill: copy a sealed object's bytes to the spill dir
+        WITHOUT evicting it — the in-memory copy keeps serving zero-copy
+        reads, the disk copy survives this node's death (restore path:
+        head-side spill records, see head._try_reconstruct).  Returns the
+        (path, meta, size) record, or None when the object is gone or the
+        store has no spill dir."""
+        with self._lock:
+            obj = self._objects.get(oid)
+            if obj is None or not obj.sealed or self.spill_dir is None:
+                return self._spilled.get(oid)
+            rec = self._spilled.get(oid)
+            if rec is not None:
+                return rec  # already on disk (spilled or backed up)
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, oid.hex() + ".bin")
+            with open(path, "wb") as f:
+                f.write(obj.shm.buf[: obj.data_size])
+            rec = (path, obj.metadata, obj.data_size)
+            self._spilled[oid] = rec
+        if self.spill_callback is not None:
+            try:
+                self.spill_callback(oid)
+            except Exception:
+                pass
+        return rec
+
     def spilled_lookup(self, oid: ObjectID):
         with self._lock:
             rec = self._spilled.get(oid)
@@ -709,10 +777,13 @@ class SharedMemoryStore:
         return {"kind": "arena", "store": self.arena.name, "offset": offset,
                 "size": size, "meta": meta, "capacity": self.arena.capacity}
 
-    def shutdown(self):
+    def shutdown(self, keep_spilled: bool = False):
+        """``keep_spilled=True`` is the node-death teardown: in-memory
+        objects die with the store, but on-disk spill/backup copies are
+        the durability plane's restore source and must survive."""
         with self._lock:
             for oid in list(self._objects.keys()):
-                self.delete(oid)
+                self.delete(oid, keep_spilled=keep_spilled)
             if self.arena is not None:
                 self.arena.close()
                 self.arena = None

@@ -303,7 +303,7 @@ class Raylet:
             worker.idle_since = time.monotonic()
         self.try_dispatch()
 
-    def shutdown(self):
+    def shutdown(self, keep_spilled: bool = False):
         self.dead = True
         for h in list(self.workers.values()):
             try:
@@ -322,7 +322,7 @@ class Raylet:
                     h.proc.kill()
                 except Exception:
                     pass
-        self.store.shutdown()
+        self.store.shutdown(keep_spilled=keep_spilled)
 
 
 # ---------------------------------------------------------------------------
@@ -464,7 +464,7 @@ class RemoteRaylet(Raylet):
         self.num_starting += 1
         return worker_id
 
-    def shutdown(self):
+    def shutdown(self, keep_spilled: bool = False):
         self.dead = True
         self.send_agent({"type": "shutdown"})
         try:

@@ -290,6 +290,58 @@ def _file_chunks(f, chunk: int):
         f.close()
 
 
+def _client_with_deadline(addr: Tuple[str, int], authkey: bytes,
+                          timeout: float):
+    """Client() with a bounded connect+handshake.
+
+    A SIGSTOPped/hung peer ACCEPTS the TCP connection (kernel backlog)
+    and then never answers the HMAC challenge — a plain Client() blocks
+    forever inside answer_challenge, before any per-chunk deadline can
+    apply.  The handshake runs on a helper thread; past the deadline the
+    attempt is abandoned (the thread closes the socket if it ever
+    completes) and the caller's retry/failover takes over."""
+    if not timeout or timeout <= 0:
+        return Client(tuple(addr), family="AF_INET", authkey=authkey)
+    box: dict = {}
+    lock = threading.Lock()
+    done = threading.Event()
+
+    def run():
+        try:
+            c = Client(tuple(addr), family="AF_INET", authkey=authkey)
+        except BaseException as e:  # noqa: BLE001 — forwarded to caller
+            with lock:
+                box["err"] = e
+            done.set()
+            return
+        with lock:
+            if box.get("abandoned"):
+                abandoned = True
+            else:
+                box["conn"] = c
+                abandoned = False
+        done.set()
+        if abandoned:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    threading.Thread(target=run, name="rtpu-xfer-conn", daemon=True).start()
+    if not done.wait(timeout):
+        with lock:
+            conn = box.get("conn")
+            if conn is None:
+                box["abandoned"] = True
+        if box.get("abandoned"):
+            raise OSError(
+                f"transfer connect to {addr} stalled past {timeout}s")
+        return conn
+    if "err" in box:
+        raise box["err"]
+    return box["conn"]
+
+
 class TransferClient:
     """Pulls objects from remote transfer servers; caches connections."""
 
@@ -306,7 +358,10 @@ class TransferClient:
             lock = self._conn_locks.setdefault(addr, threading.Lock())
         if conn is not None:
             return conn, lock
-        conn = Client(tuple(addr), family="AF_INET", authkey=self.authkey)
+        from ray_tpu._private.config import CONFIG
+
+        conn = _client_with_deadline(addr, self.authkey,
+                                     float(CONFIG.transfer_timeout_s))
         with self._lock:
             old = self._conns.setdefault(addr, conn)
         if old is not conn:

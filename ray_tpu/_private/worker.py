@@ -1030,6 +1030,12 @@ class CoreWorker:
         inside the same store lock (returns _OWNED_WITH_REF)."""
         s = ser.serialize(value)
         size = ser.packed_size(s)
+        # Refs nested in the put value must outlive this process's own
+        # refs to them: the head pins them under the put's lifetime
+        # (res:<oid> holders).  The notify rides this conn BEFORE any
+        # later ref-gc drop, so the pin can never lose the race.
+        contained = ([c.binary() for c in s.contained_refs]
+                     if s.contained_refs else None)
         if size <= INLINE_OBJECT_THRESHOLD:
             meta, data = ser.pack(s)
             if self._direct is not None:
@@ -1045,7 +1051,7 @@ class CoreWorker:
                 return None
             self._cache_value(oid, value)
             return {"type": "put_inline", "oid": oid.binary(),
-                    "meta": meta, "data": data,
+                    "meta": meta, "data": data, "contained": contained,
                     "lineage_task": lineage_task}
         store = getattr(self.transport, "store_for",
                         lambda n: None)(self.node_id)
@@ -1060,6 +1066,7 @@ class CoreWorker:
                 self._cache_value(oid, value)
                 return {"type": "arena_sealed", "oid": oid.binary(),
                         "node_id": self.node_id.binary(), "size": size,
+                        "contained": contained,
                         "lineage_task": lineage_task}
             # In-process pooled path: allocate from the node store (a
             # recycled, already-faulted pool segment in steady state —
@@ -1075,22 +1082,40 @@ class CoreWorker:
             return {"type": "seal", "oid": oid.binary(),
                     "node_id": self.node_id.binary(), "size": size,
                     "meta": meta, "segment": store.segment_of(oid),
+                    "contained": contained,
                     "lineage_task": lineage_task}
-        meta = self._write_to_store(oid, s, size)
+        meta, segment = self._write_to_store(oid, s, size)
         self._cache_value(oid, value)
         return {"type": "seal", "oid": oid.binary(),
                 "node_id": self.node_id.binary(),
-                "size": size, "meta": meta,
+                "size": size, "meta": meta, "segment": segment,
+                "contained": contained,
                 "lineage_task": lineage_task}
 
     def _write_to_store(self, oid: ObjectID, s: ser.SerializedObject,
-                        size: int) -> bytes:
+                        size: int) -> Tuple[bytes, Optional[str]]:
         """Create the shared-memory segment directly (zero round trips) and
-        hand ownership to the raylet via the seal notification."""
+        hand ownership to the raylet via the seal notification.  Returns
+        (meta, segment): segment is None for the canonical per-object
+        name, or the unique fallback name used when the canonical one is
+        taken on this machine — a retried/reconstructed task re-creating
+        an output whose original segment still exists (dead virtual node
+        mid-teardown, co-hosted agent) must not fail or unlink a segment
+        another store may still serve."""
+        import os as _os
+
         from multiprocessing import shared_memory
 
-        shm = shared_memory.SharedMemory(
-            name=store_mod._segment_name(oid), create=True, size=max(1, size))
+        segment = None
+        try:
+            shm = shared_memory.SharedMemory(
+                name=store_mod._segment_name(oid), create=True,
+                size=max(1, size))
+        except FileExistsError:
+            segment = (store_mod._segment_name(oid) + "_r"
+                       + _os.urandom(4).hex())
+            shm = shared_memory.SharedMemory(
+                name=segment, create=True, size=max(1, size))
         store_mod.untrack(shm)
         store_mod.track_for_exit(shm)
         view = shm.buf[:size]
@@ -1099,7 +1124,7 @@ class CoreWorker:
         finally:
             view.release()
         shm.close()
-        return meta
+        return meta, segment
 
     # ---- get ----
     def get(self, refs, timeout: Optional[float] = None):
@@ -1322,7 +1347,8 @@ class CoreWorker:
                                          {"oid": oid, "timeout": timeout})
         return self._materialize(oid, msg)
 
-    def _materialize(self, oid: ObjectID, msg: dict):
+    def _materialize(self, oid: ObjectID, msg: dict,
+                     pull_failovers: int = 2):
         kind = msg["kind"]
         if kind == "inline":
             value, _ = ser.unpack(msg["meta"], memoryview(msg["data"]))
@@ -1393,7 +1419,8 @@ class CoreWorker:
             self._shm_registry[oid] = buf  # keep the mapping alive
             return value
         if kind == "pull":
-            return self._pull_and_materialize(oid, msg)
+            return self._pull_and_materialize(oid, msg,
+                                              _failovers=pull_failovers)
         if kind == "error":
             err, _ = ser.unpack(msg["meta"], memoryview(msg["data"]))
             if isinstance(err, BaseException):
@@ -1408,14 +1435,39 @@ class CoreWorker:
             self._xfer_client = TransferClient(self.transport.authkey)
         return self._xfer_client
 
-    def _pull_and_materialize(self, oid: ObjectID, msg: dict):
-        """Cross-host read: stream the object from the owning store's
-        transfer server into THIS node's store, seal the local replica (so
-        the directory learns the new location and neighbors read locally),
-        then materialize zero-copy from the local segment.  Reference:
+    def _pull_and_materialize(self, oid: ObjectID, msg: dict,
+                              _failovers: int = 2):
+        """Cross-host read with location failover: try every holder the
+        directory named; when ALL of them fail (the serving node died
+        mid-pull), re-resolve through the head — which by then has run
+        its node-death protocol and points at a replica, a spill restore,
+        or a reconstruction — instead of erroring on the first sever.
+        Reference: pull_manager.h:52 retrying against updated locations."""
+        last_err: Optional[BaseException] = None
+        for addr in (msg.get("addrs") or [msg["addr"]]):
+            try:
+                return self._pull_once(oid, tuple(addr), msg["size"])
+            except (KeyError, EOFError, OSError, BrokenPipeError) as e:
+                last_err = e  # dead/stale holder: try the next one
+        if _failovers <= 0:
+            raise exc.ObjectLostError(
+                f"object {oid} could not be pulled from any holder: "
+                f"{last_err}")
+        # Every named holder failed.  Give the head a beat to notice the
+        # death, then re-resolve (blocking like get): the reply is the
+        # recovered resolution or the object's typed loss error.
+        import time as _time
+
+        _time.sleep(0.2)
+        fresh = self.transport.request("get_locations", {"oid": oid})
+        return self._materialize(oid, fresh, pull_failovers=_failovers - 1)
+
+    def _pull_once(self, oid: ObjectID, addr: tuple, size: int):
+        """One pull attempt against one holder: stream the object into
+        THIS node's store, seal the local replica (so the directory
+        learns the new location and neighbors read locally), then
+        materialize zero-copy from the local segment.  Reference:
         pull_manager.h:52 + chunked push push_manager.h:29."""
-        addr = tuple(msg["addr"])
-        size = msg["size"]
         client = self._transfer_client()
         shm = None
         try:
@@ -1451,7 +1503,7 @@ class CoreWorker:
             value, _ = ser.unpack(meta, memoryview(data))
             self._cache_value(oid, value)
             return value
-        except BaseException as e:
+        except BaseException:
             # ANY failure before the seal (missing object, transport death
             # mid-stream, unpack error) must unlink the pre-created segment:
             # nothing owns it yet, and a leaked name permanently poisons the
@@ -1462,9 +1514,8 @@ class CoreWorker:
                     shm.close()
                 except Exception:
                     pass
-            if isinstance(e, KeyError):
-                raise exc.ObjectLostError(
-                    f"object {oid} vanished from the remote store: {e}")
+            # KeyError ("not in this store") propagates as-is: the caller
+            # fails over to the next holder / a fresh head resolution.
             raise
 
     def _release_arena_lease(self, oid: ObjectID):
@@ -1909,21 +1960,41 @@ class CoreWorker:
                             if owner is not None and self._direct is not None:
                                 contained.append((coid.binary(), owner,
                                                   False))
+                            else:
+                                # Head-counted nested ref (e.g. a shm-
+                                # sealed put): hold a head-side ret: ref,
+                                # ordered on this conn BEFORE our own
+                                # ref-gc drop can arrive; the caller
+                                # swaps it for a res: ref tied to the
+                                # result entry (_take_contained_pins).
+                                self.transport.request_oneway(
+                                    "add_ref", {"oid": coid,
+                                                "holder": token})
+                                contained.append((coid.binary(), None,
+                                                  False))
                 elif s.contained_refs:
-                    # Classic-path result: no handover protocol runs, so
-                    # nested owner-resident refs must outlive this worker's
-                    # local refs — promote them into the head directory.
+                    # Classic-path result: nested owner-resident refs must
+                    # outlive this worker's local refs — promote them into
+                    # the head directory, then let the head pin every
+                    # nested ref under the result entry's lifetime
+                    # (res:<result oid> holders, added when it records
+                    # this result — ordered before our ref-gc drop).
+                    contained = []
                     for coid in s.contained_refs:
                         if self._owned.contains(coid):
                             self.promote_owned_to_head(coid)
+                        contained.append((coid.binary(), None, False))
                 results.append(TaskResult(oid, inline=ser.pack(s),
                                           contained=contained))
             else:
-                meta = self._write_to_store(oid, s, size)
+                meta, segment = self._write_to_store(oid, s, size)
                 self.transport.notify({
                     "type": "seal", "oid": oid.binary(),
                     "node_id": self.node_id.binary(), "size": size,
-                    "meta": meta, "lineage_task": spec.task_id})
+                    "meta": meta, "segment": segment,
+                    "lineage_task": spec.task_id,
+                    "contained": ([c.binary() for c in s.contained_refs]
+                                  if s.contained_refs else None)})
                 results.append(TaskResult(oid, in_store=True, size=size, meta=meta))
         return results
 

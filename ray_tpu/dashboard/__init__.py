@@ -219,6 +219,12 @@ class Dashboard:
                 val = node.get("stats", {}).get(key)
                 if val is not None:
                     buf.write(f'{metric}{{node="{nid}"}} {float(val)}\n')
+        # Cluster-level recovery counters (node-loss plane): chaos runs
+        # scrape these to assert recovery HAPPENED rather than infer it.
+        from ray_tpu._private.recovery import recovery_stats
+
+        for key, val in recovery_stats().items():
+            buf.write(f"recovery_{key} {float(val)}\n")
         return buf.getvalue()
 
     def _log_index(self):

@@ -161,7 +161,10 @@ def run_actor_replay_iter(algo, explore_arg, batch_size, do_updates):
     if getattr(algo, "_ep_reward_ema", None) is not None:
         metrics["episode_reward_mean"] = algo._ep_reward_ema
     if algo._rb.size >= cfg.learning_starts:
-        U = cfg.num_updates_per_iter
+        # Algorithms may pin an actor-mode update count (e.g. DQN's
+        # replay-ratio-derived default) — num_updates_per_iter's default
+        # is tuned for the anakin path's huge batches.
+        U = getattr(algo, "_actor_updates", None) or cfg.num_updates_per_iter
         stacked = algo._rb.sample_stacked(algo._host_rng, U, batch_size)
         keys = jax.random.split(jax.random.PRNGKey(algo._env_steps), U)
         metrics.update(do_updates(stacked, keys))
@@ -429,6 +432,16 @@ class DQN(Algorithm):
 
         self.workers = WorkerSet(cfg, None, worker_factory=factory)
         self.workers.sync_weights(jax.device_get(self._params))
+        # Actor-mode update count: keep a replay ratio of ~4 gradient
+        # samples per env step (the classic DQN regime: batch 32 every 4
+        # steps).  num_updates_per_iter's default (8) is the anakin
+        # path's; at actor-mode throughput (workers*envs*fragment steps
+        # per iter) it under-trains — the CartPole gate plateaued at
+        # ~98 with 8 updates/iter and clears 100 at the derived 16.
+        steps_per_iter = (cfg.num_rollout_workers * cfg.num_envs_per_worker
+                          * cfg.rollout_fragment_length)
+        self._actor_updates = max(cfg.num_updates_per_iter,
+                                  (4 * steps_per_iter) // cfg.dqn_batch_size)
 
         def td_loss(params, target_params, batch):
             q = net.apply(params, batch["obs"])

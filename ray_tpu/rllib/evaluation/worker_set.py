@@ -422,7 +422,10 @@ class WorkerSet:
             self._weights_ref, self._weights_version)) for i in indices]
         for i, f in futures:
             try:
-                ray_tpu.get(f)
+                # Bounded: a replacement stuck starting (e.g. rescheduled
+                # off a dead node) must strike out, not hang the sampler
+                # forever (GetTimeoutError is a RayTpuError).
+                ray_tpu.get(f, timeout=60.0)
                 self._failures[i] = 0
             except ray_tpu.exceptions.RayTpuError:
                 self._count_failure(i)

@@ -86,7 +86,9 @@ def start_node_agent(head, num_cpus: int = 2,
     # The spawning process may have ray_tpu importable only via sys.path
     # (e.g. a driver script outside the repo) — make it explicit.
     inject_pkg_pythonpath(env)
-    return subprocess.Popen(args, env=env)
+    # Own session/process group: chaos.kill_node can SIGKILL the agent
+    # AND every worker it spawned in one killpg (whole-node loss).
+    return subprocess.Popen(args, env=env, start_new_session=True)
 
 
 @contextlib.contextmanager

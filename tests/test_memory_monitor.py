@@ -211,3 +211,44 @@ def test_remote_agent_relieves_own_pressure(tmp_path, monkeypatch):
     finally:
         ray_tpu.shutdown()
         CONFIG.reset()
+
+
+def test_agent_oom_kill_is_typed_and_carries_usage(tmp_path, monkeypatch):
+    """ISSUE 7 satellite: a worker killed by the node agent's memory loop
+    must surface as OutOfMemoryError with the host usage fraction in the
+    message (not a generic WorkerCrashedError) once retries run out —
+    the agent marks the victim over its ordered head conn BEFORE the
+    kill, so the death handler can type it."""
+    gauge = tmp_path / "agent_oom_gauge"
+    gauge.write_text("0.1")
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_TEST_FILE", str(gauge))
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_REFRESH_MS", "100")
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.9")
+    from ray_tpu._private.config import CONFIG
+
+    CONFIG.reset()
+    ray_tpu.init(num_cpus=0, object_store_memory=64 * 1024**2)
+    try:
+        from ray_tpu.util.testing import (remote_node_agents,
+                                          wait_for_condition)
+
+        with remote_node_agents(ray_tpu._head, n=1, num_cpus=2):
+            @ray_tpu.remote(max_retries=0)
+            def hog(marker_path):
+                import time as _t
+
+                open(marker_path, "w").write("1")
+                _t.sleep(120)
+
+            marker = tmp_path / "started"
+            ref = hog.remote(str(marker))
+            wait_for_condition(marker.exists, timeout=60)
+            time.sleep(0.3)
+            gauge.write_text("0.99")
+            with pytest.raises(OutOfMemoryError) as ei:
+                ray_tpu.get(ref, timeout=90)
+            msg = str(ei.value)
+            assert "memory" in msg and "99%" in msg, msg
+    finally:
+        ray_tpu.shutdown()
+        CONFIG.reset()

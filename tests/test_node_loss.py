@@ -1,0 +1,483 @@
+"""Node-loss survivability (ISSUE 7): SIGKILL an entire node (store +
+all its workers) mid-run and the job finishes with correct results.
+
+Layers under test:
+- object durability (``object_durability=replicate:K|spill``): puts have
+  no lineage, so without a second copy they die with their node;
+- the head-side node-death protocol (exactly-once declaration from conn
+  EOF / lease expiry / chaos kill; location discard; queued-work
+  requeue; typed ObjectLostError instead of hangs);
+- transfer location failover (a pull that loses its serving node re-
+  resolves and recovers);
+- recovery counters proving recovery HAPPENED (objects_reconstructed /
+  objects_replicated / objects_restored / node_deaths).
+
+Reference: Ray's whole-node fault tolerance (arxiv 1712.05889) — lineage
+reconstruction plus object directory failover; the node-killer chaos
+pattern from python/ray/_private/test_utils.py:1337.
+"""
+import os
+import random
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private.recovery import recovery_stats, reset_recovery_stats
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+from ray_tpu.util.testing import start_node_agent, wait_for_condition
+
+MB = 1024 * 1024
+
+
+def _durability_cluster(monkeypatch, policy: str, num_cpus: int = 2):
+    from ray_tpu._private.config import CONFIG
+
+    monkeypatch.setenv("RAY_TPU_OBJECT_DURABILITY", policy)
+    CONFIG.reset()
+    ray_tpu.init(num_cpus=num_cpus, object_store_memory=256 * MB)
+    return ray_tpu._head
+
+
+@pytest.fixture
+def durability_off(monkeypatch):
+    reset_recovery_stats()
+    head = _durability_cluster(monkeypatch, "off")
+    yield head
+    ray_tpu.shutdown()
+    _reset_config()
+
+
+@pytest.fixture
+def replicate2(monkeypatch):
+    reset_recovery_stats()
+    head = _durability_cluster(monkeypatch, "replicate:2")
+    yield head
+    ray_tpu.shutdown()
+    _reset_config()
+
+
+@pytest.fixture
+def spill_durability(monkeypatch):
+    reset_recovery_stats()
+    head = _durability_cluster(monkeypatch, "spill")
+    yield head
+    ray_tpu.shutdown()
+    _reset_config()
+
+
+def _reset_config():
+    from ray_tpu._private.config import CONFIG
+
+    CONFIG.reset()
+
+
+def _second_node(head, store=256 * MB):
+    cluster = Cluster(initialize_head=False)
+    node_id = cluster.add_node(num_cpus=2, object_store_memory=store)
+    return node_id, NodeAffinitySchedulingStrategy(node_id, soft=True)
+
+
+@ray_tpu.remote
+def _make_put(i):
+    import numpy as np
+
+    import ray_tpu
+
+    return ray_tpu.put(np.full(400_000, i, dtype=np.int64))  # 3.2 MB
+
+
+@ray_tpu.remote
+def _make_out(i):
+    import numpy as np
+
+    return np.full(300_000, i, dtype=np.int64)  # 2.4 MB, store-sealed
+
+
+def _wait_replicated(n, timeout=30.0):
+    wait_for_condition(
+        lambda: recovery_stats()["objects_replicated"] >= n, timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Virtual-node gates (fast): the death protocol + each recovery path
+# ---------------------------------------------------------------------------
+def test_replicated_puts_survive_node_kill(replicate2):
+    """replicate:2 keeps a second copy of every put on another holder
+    node: killing the primary's node must be a blip, not ObjectLostError
+    (the PR 5 weight-broadcast / replay-shard scenario)."""
+    head = replicate2
+    node2, aff = _second_node(head)
+    put_refs = ray_tpu.get(
+        [_make_put.options(scheduling_strategy=aff).remote(i)
+         for i in range(3)], timeout=60)
+    _wait_replicated(3)
+    head.kill_node(node2)
+    for i, ref in enumerate(put_refs):
+        got = ray_tpu.get(ref, timeout=30)
+        assert got[0] == i and got[-1] == i and len(got) == 400_000
+    st = recovery_stats()
+    assert st["node_deaths"] == 1
+    assert st["objects_replicated"] >= 3
+    assert st["objects_restored"] >= 1, st
+
+
+def test_sealed_outputs_reconstruct_after_node_kill(durability_off):
+    """Lineage-reconstructable task outputs sealed on a dead node are
+    recomputed (reference: object_recovery_manager.h:41) — and the
+    counter proves a reconstruction actually ran."""
+    reset_recovery_stats()
+    head = durability_off
+    node2, aff = _second_node(head)
+    out_refs = [_make_out.options(scheduling_strategy=aff).remote(10 + i)
+                for i in range(3)]
+    ray_tpu.wait(out_refs, num_returns=3, timeout=60)  # sealed, unread
+    head.kill_node(node2)
+    for i, ref in enumerate(out_refs):
+        got = ray_tpu.get(ref, timeout=60)
+        assert got[0] == 10 + i and len(got) == 300_000
+    st = recovery_stats()
+    assert st["objects_reconstructed"] >= 1, st
+
+
+def test_spill_durability_restores_after_node_kill(spill_durability):
+    """object_durability=spill keeps an on-disk backup the owning store
+    serves no reads from — until the node dies, when the head restores
+    the bytes from the spill file, byte-exact."""
+    head = spill_durability
+    node2, aff = _second_node(head)
+    arrs = [np.arange(400_000, dtype=np.int64) * (i + 1) for i in range(2)]
+
+    @ray_tpu.remote
+    def put_arr(a):
+        import ray_tpu
+
+        return ray_tpu.put(a)
+
+    refs = ray_tpu.get(
+        [put_arr.options(scheduling_strategy=aff).remote(a) for a in arrs],
+        timeout=60)
+    # Wait for the async backup records to land in the directory.
+    def backed_up():
+        with head._lock:
+            return all(
+                (e := head.gcs.object_lookup(r.id)) is not None
+                and e.spill is not None for r in refs)
+    wait_for_condition(backed_up, timeout=30)
+    head.kill_node(node2)
+    for a, ref in zip(arrs, refs):
+        got = ray_tpu.get(ref, timeout=30)
+        np.testing.assert_array_equal(got, a)
+    st = recovery_stats()
+    assert st["objects_restored"] >= 1, st
+    assert st["objects_lost"] == 0, st
+
+
+def test_unrecoverable_put_raises_typed_error_not_hang(durability_off):
+    """With durability off, a put whose only copy died with its node must
+    fail every reader with ObjectLostError — including readers already
+    BLOCKED in get() when the node died (no silent hang, the rule every
+    death path in this runtime follows)."""
+    head = durability_off
+    node2, aff = _second_node(head)
+    ref = ray_tpu.get(_make_put.options(scheduling_strategy=aff).remote(1),
+                      timeout=60)
+    # Drop the outer result ref's lineage first: while it is retained, a
+    # lost put legitimately recovers by re-running its creating task (put
+    # reconstruction) — this test is about the NO-recovery-path case.
+    wait_for_condition(
+        lambda: head.gcs.get_lineage(ref.id.task_id()) is None, timeout=15)
+    blocked_err = []
+
+    # A reader that makes it INTO the blocking wait before the kill: the
+    # store still has the bytes but we park the waiter first by asking
+    # for an unrelated unready object? No — park on the real ref via a
+    # second thread racing the kill; the head must answer it either way.
+    def blocked_reader():
+        try:
+            ray_tpu.get(ref, timeout=60)
+            blocked_err.append(None)
+        except Exception as e:  # noqa: BLE001 — recording the outcome
+            blocked_err.append(e)
+
+    head.kill_node(node2)
+    t = threading.Thread(target=blocked_reader, daemon=True)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive(), "reader hung on a lost object"
+    err = blocked_err[0]
+    assert isinstance(err, ObjectLostError), err
+    assert recovery_stats()["objects_lost"] >= 1
+
+
+def test_inflight_and_queued_work_survives_node_kill(durability_off):
+    """Tasks running or queued on the dying node complete elsewhere:
+    running attempts retry through worker-death handling, queued specs
+    are requeued cluster-wide with no attempt charged."""
+    head = durability_off
+    node2, aff = _second_node(head)
+
+    @ray_tpu.remote(max_retries=2)
+    def slow_square(i):
+        import time as _t
+
+        _t.sleep(0.4)
+        return i * i
+
+    # More tasks than the node has workers: some run, some queue.
+    refs = [slow_square.options(scheduling_strategy=aff).remote(i)
+            for i in range(8)]
+    time.sleep(0.5)  # let dispatch/spawn begin on node2
+    head.kill_node(node2)
+    assert ray_tpu.get(refs, timeout=90) == [i * i for i in range(8)]
+
+
+# ---------------------------------------------------------------------------
+# Real node-agent gates: SIGKILL the agent process group mid-run
+# ---------------------------------------------------------------------------
+def _agent_cluster(head, num_cpus=2):
+    agent = start_node_agent(head, num_cpus=num_cpus,
+                             resources={"agent": 1.0})
+    wait_for_condition(lambda: len(head.raylets) >= 2, timeout=30)
+    with head._lock:
+        agent_node = next(nid for nid, r in head.raylets.items()
+                          if head.node_host.get(nid) != head.host_key)
+    return agent, agent_node
+
+
+@ray_tpu.remote(max_retries=4)
+def _grad_step(step, base):
+    import numpy as np
+
+    # Deterministic "gradient": a pure function of (step, base weights).
+    return np.full(150_000, step + base, dtype=np.int64)
+
+
+@ray_tpu.remote(max_retries=4)
+def _put_version(step):
+    import numpy as np
+
+    import ray_tpu
+
+    return ray_tpu.put(np.full(200_000, step, dtype=np.int64))
+
+
+def test_training_survives_node_agent_sigkill(replicate2):
+    """THE tentpole gate: a seeded chaos schedule SIGKILLs a node agent
+    (and every worker child, via its process group) mid-training; the
+    run completes with exact results.  Lineage-reconstructable outputs
+    are recomputed, replicated puts restore from the surviving holder,
+    and the recovery counters prove >= 1 reconstruction and >= 1
+    replica restore happened rather than inferring it."""
+    head = replicate2
+    agent, agent_node = _agent_cluster(head)
+    aff = NodeAffinitySchedulingStrategy(agent_node, soft=True)
+    rng = random.Random(0xC0FFEE)  # seeded, deterministic schedule
+    kill_at = rng.randrange(4, 7)
+    steps, window_k = 12, 3
+    t0 = time.monotonic()
+
+    window = []  # (step, grad_ref) — consumed window_k steps later
+    version_puts = {}  # step -> nested put ref, read 2 steps later
+    total = 0
+    expect_total = 0
+    w0 = 1
+    killed = False
+    # A long-lived durable put (a "current weights version") held across
+    # the kill: its replica on the surviving node is what the
+    # objects_restored counter must prove was used.
+    keep_vref = ray_tpu.get(
+        _put_version.options(scheduling_strategy=aff).remote(999),
+        timeout=90)
+    for step in range(steps):
+        if step == kill_at:
+            # Make sure at least one output is sealed-but-unread so the
+            # kill forces a real lineage reconstruction, and that the
+            # long-lived put has its replica (the async durability
+            # window is otherwise covered by put reconstruction).
+            ray_tpu.wait([window[-1][1]], num_returns=1, timeout=60)
+
+            def keep_replicated():
+                with head._lock:
+                    e = head.gcs.object_lookup(keep_vref.id)
+                    return e is not None and len(e.locations) >= 2
+            wait_for_condition(keep_replicated, timeout=30)
+            assert chaos.kill_node(agent)
+            killed = True
+        window.append(
+            (step, _grad_step.options(scheduling_strategy=aff)
+             .remote(step, w0)))
+        version_puts[step] = _put_version.options(
+            scheduling_strategy=aff).remote(step)
+        if step >= 2:
+            vref = ray_tpu.get(version_puts.pop(step - 2), timeout=90)
+            v = ray_tpu.get(vref, timeout=90)
+            assert v[0] == step - 2 and len(v) == 200_000
+        while len(window) > window_k:
+            s, ref = window.pop(0)
+            g = ray_tpu.get(ref, timeout=120)
+            assert len(g) == 150_000
+            total += int(g[0]) + int(g[-1])
+            expect_total += 2 * (s + w0)
+    for s, ref in window:
+        g = ray_tpu.get(ref, timeout=120)
+        total += int(g[0]) + int(g[-1])
+        expect_total += 2 * (s + w0)
+    v = ray_tpu.get(keep_vref, timeout=90)  # served by the replica
+    assert v[0] == 999 and len(v) == 200_000
+    assert killed
+    assert total == expect_total, "training results diverged after node kill"
+    wait_for_condition(lambda: recovery_stats()["node_deaths"] >= 1,
+                       timeout=30)
+    st = recovery_stats()
+    assert st["objects_reconstructed"] >= 1, st
+    assert st["objects_replicated"] >= 1, st
+    assert st["objects_restored"] >= 1, st
+    elapsed = time.monotonic() - t0
+    assert elapsed < 150, f"node-loss recovery took {elapsed:.0f}s"
+    agent.wait(timeout=10)
+
+
+def test_rollout_plane_survives_node_agent_sigkill(durability_off):
+    """The PR 5 streaming sampler keeps flowing through a whole-node
+    SIGKILL: dead rollout workers strike out and are replaced on the
+    surviving node (soft affinity), fragment accounting stays exact
+    (sum(dones) == len(episode_returns) on every consumed fragment)."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.env.py_envs import make_py_env
+    from ray_tpu.rllib.evaluation.sample_stream import SampleStream
+    from ray_tpu.rllib.evaluation.worker_set import (RolloutWorker,
+                                                     WorkerSet)
+
+    head = durability_off
+    agent, agent_node = _agent_cluster(head)
+    aff = NodeAffinitySchedulingStrategy(agent_node, soft=True)
+    config = (PPOConfig().environment("CartPole-v1")
+              .rollouts(num_rollout_workers=2, num_envs_per_worker=2,
+                        rollout_fragment_length=8, mode="actor")
+              .training(model={"fcnet_hiddens": [16]}))
+    spec = RLModuleSpec.for_env(make_py_env("CartPole-v1"),
+                                tuple(config.hiddens))
+
+    def factory(i):
+        return RolloutWorker.options(
+            max_restarts=1, scheduling_strategy=aff).remote(
+            config.env, spec, i, config.num_envs_per_worker,
+            config.rollout_fragment_length, config.gamma, config.lambda_,
+            config.seed)
+
+    workers = WorkerSet(config, spec, worker_factory=factory)
+    stream = SampleStream(workers, kind="gae", max_in_flight_per_worker=2)
+    try:
+        import jax
+
+        module = spec.build()
+        params = module.init(jax.random.PRNGKey(0), spec.example_obs())
+        stream.publish_weights(params)
+        for _ in range(2):
+            frag = stream.next_fragment(timeout=120.0)
+            assert frag is not None
+            assert int(frag.batch["dones"].sum()) == \
+                len(frag.episode_returns)
+        assert chaos.kill_node(agent)
+        consumed = 0
+        deadline = time.monotonic() + 180.0
+        while consumed < 6 and time.monotonic() < deadline:
+            frag = stream.next_fragment(timeout=120.0)
+            if frag is None:
+                break
+            assert int(frag.batch["dones"].sum()) == \
+                len(frag.episode_returns)
+            consumed += 1
+        assert consumed >= 6, (
+            f"stream stalled after node kill: {consumed} fragments, "
+            f"stats={stream.stats()}")
+        assert stream.failures_seen >= 1
+        wait_for_condition(lambda: recovery_stats()["node_deaths"] >= 1,
+                           timeout=30)
+    finally:
+        stream.close()
+        workers.stop()
+        agent.wait(timeout=10)
+
+
+def test_stalled_node_lease_expiry_recovers_pull(monkeypatch):
+    """A SIGSTOPped agent (socket open, heartbeats silent — the hung-host
+    shape conn EOF can never catch): the caller's pull stalls past the
+    transfer deadline, fails over through a fresh head resolution, and
+    the head — whose lease on the node expired — has already declared
+    the node dead and reconstructed the object elsewhere."""
+    from ray_tpu._private.config import CONFIG
+
+    monkeypatch.setenv("RAY_TPU_NODE_LEASE_TIMEOUT_S", "3")
+    monkeypatch.setenv("RAY_TPU_TRANSFER_TIMEOUT_S", "2")
+    monkeypatch.setenv("RAY_TPU_TRANSFER_RETRIES", "0")
+    CONFIG.reset()
+    reset_recovery_stats()
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * MB)
+    head = ray_tpu._head
+    agent = None
+    try:
+        agent, agent_node = _agent_cluster(head)
+        aff = NodeAffinitySchedulingStrategy(agent_node, soft=True)
+        ref = _make_out.options(scheduling_strategy=aff).remote(42)
+        ray_tpu.wait([ref], num_returns=1, timeout=60)
+        os.kill(agent.pid, signal.SIGSTOP)  # node hangs, socket survives
+        got = ray_tpu.get(ref, timeout=120)
+        assert got[0] == 42 and len(got) == 300_000
+        st = recovery_stats()
+        assert st["node_deaths"] >= 1, st
+        assert st["objects_reconstructed"] >= 1, st
+    finally:
+        if agent is not None:
+            try:
+                os.kill(agent.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            agent.kill()
+            agent.wait(timeout=10)
+        ray_tpu.shutdown()
+        CONFIG.reset()
+
+
+# ---------------------------------------------------------------------------
+# Nightly chaos matrix: seeded node-kill sweep at varying schedule points
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_chaos_node_kill_matrix(replicate2, seed):
+    """Seeded sweep: the agent dies at a schedule-chosen worker spawn
+    (agent-side kill site node_agent_spawn — SIGKILL agent + children),
+    at a different point per seed; the workload must still finish with
+    exact results."""
+    head = replicate2
+    rng = random.Random(seed)
+    nth = rng.randrange(1, 3)  # the 2-CPU node spawns 2 workers
+    os.environ[chaos.KILL_SCHEDULE_ENV] = f"node_agent_spawn:*:{nth}"
+    agent = None
+    try:
+        agent, agent_node = _agent_cluster(head)
+        aff = NodeAffinitySchedulingStrategy(agent_node, soft=True)
+
+        @ray_tpu.remote(max_retries=4)
+        def square(i):
+            return i * i
+
+        refs = [square.options(scheduling_strategy=aff).remote(i)
+                for i in range(12)]
+        assert ray_tpu.get(refs, timeout=180) == [i * i for i in range(12)]
+        wait_for_condition(lambda: recovery_stats()["node_deaths"] >= 1,
+                           timeout=60)
+    finally:
+        os.environ.pop(chaos.KILL_SCHEDULE_ENV, None)
+        if agent is not None:
+            agent.kill()
+            agent.wait(timeout=10)

@@ -6,6 +6,7 @@ import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 
 from tools.perf_smoke import (
     run_checkpoint_smoke,
+    run_node_loss_smoke,
     run_object_plane_smoke,
     run_rollout_smoke,
     run_rpc_chaos_smoke,
@@ -70,3 +71,20 @@ def test_object_plane_smoke(shutdown_only):
     assert out["batching_ok"], f"notify batching regression: {out}"
     assert out["roundtrip_ok"], out
     assert out["ok"]
+
+
+def test_node_loss_smoke(shutdown_only):
+    """One scheduled node kill mid-run must be survivable: the job
+    completes with exact results in bounded wall clock, replicated puts
+    restore from a surviving holder, sealed outputs reconstruct from
+    lineage — and the recovery counters prove it (the tier-1 guard for
+    ISSUE 7's node-loss survivability plane)."""
+    out = run_node_loss_smoke()
+    assert out["killed"], out
+    assert out["exact_results"], out
+    assert out["node_deaths"] >= 1, out
+    assert out["objects_restored"] >= 1, f"no replica restore: {out}"
+    assert out["objects_reconstructed"] >= 1, f"no reconstruction: {out}"
+    assert out["objects_lost"] == 0, out
+    assert out["no_hang"], f"node-loss recovery hung: {out}"
+    assert out["ok"], out

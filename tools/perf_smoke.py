@@ -408,6 +408,102 @@ def run_rpc_chaos_smoke(tasks: int = 8) -> dict:
         CONFIG.reset()
 
 
+def run_node_loss_smoke(steps: int = 8, kill_at: int = 3) -> dict:
+    """Node-loss survivability invariant (tier-1 guard for ISSUE 7):
+
+    One scheduled node kill mid-run (SIGKILL the node's workers + drop
+    its store, the in-process equivalent of killing a node agent).  The
+    job must complete with exact results inside a bounded wall clock:
+    replicated puts restore from the surviving holder, sealed outputs
+    reconstruct from lineage, and the recovery counters prove both
+    actually happened (>= 1 replica restore, >= 1 reconstruction).
+    """
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu._private.recovery import (recovery_stats,
+                                           reset_recovery_stats)
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    reset_recovery_stats()
+    t0 = _time.monotonic()
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True,
+                 _system_config={"object_durability": "replicate:2"})
+    try:
+        head = ray_tpu._head
+        cluster = Cluster(initialize_head=False)
+        node2 = cluster.add_node(num_cpus=2,
+                                 object_store_memory=256 * 1024**2)
+        aff = NodeAffinitySchedulingStrategy(node2, soft=True)
+
+        @ray_tpu.remote(max_retries=4)
+        def make_put(i):
+            return ray_tpu.put(np.full(300_000, i, dtype=np.int64))
+
+        @ray_tpu.remote(max_retries=4)
+        def make_out(i):
+            return np.full(200_000, i, dtype=np.int64)
+
+        put_refs, out_refs = [], []
+        killed = False
+        for step in range(steps):
+            if step == kill_at:
+                # Outputs so far are sealed-but-unread: the kill forces
+                # real reconstructions, not in-flight retries only.
+                ray_tpu.wait(out_refs, num_returns=len(out_refs),
+                             timeout=60)
+                deadline = _time.monotonic() + 20
+                while _time.monotonic() < deadline and \
+                        recovery_stats()["objects_replicated"] < step:
+                    _time.sleep(0.1)
+                head.kill_node(node2)
+                killed = True
+            put_refs.append(
+                make_put.options(scheduling_strategy=aff).remote(step))
+            out_refs.append(
+                make_out.options(scheduling_strategy=aff).remote(step))
+        exact = True
+        for i, r in enumerate(ray_tpu.get(put_refs, timeout=120)):
+            v = ray_tpu.get(r, timeout=120)
+            exact = exact and v[0] == i and v[-1] == i \
+                and len(v) == 300_000
+        for i, v in enumerate(ray_tpu.get(out_refs, timeout=120)):
+            exact = exact and v[0] == i and len(v) == 200_000
+        elapsed = _time.monotonic() - t0
+        st = recovery_stats()
+        out = {
+            "steps": steps,
+            "killed": killed,
+            "exact_results": exact,
+            "node_deaths": st["node_deaths"],
+            "objects_replicated": st["objects_replicated"],
+            "objects_restored": st["objects_restored"],
+            "objects_reconstructed": st["objects_reconstructed"],
+            "objects_lost": st["objects_lost"],
+            "elapsed_s": round(elapsed, 3),
+            # Recovery is worth ~a few task re-runs; anything near the
+            # get() deadlines means a hang.
+            "no_hang": elapsed < 60.0,
+        }
+        out["ok"] = bool(out["killed"] and out["exact_results"]
+                         and out["node_deaths"] >= 1
+                         and out["objects_restored"] >= 1
+                         and out["objects_reconstructed"] >= 1
+                         and out["objects_lost"] == 0
+                         and out["no_hang"])
+        return out
+    finally:
+        ray_tpu.shutdown()
+        CONFIG.reset()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
@@ -419,8 +515,10 @@ def main() -> int:
     out["rollout"] = roll
     rpc = run_rpc_chaos_smoke()
     out["rpc_chaos"] = rpc
+    nl = run_node_loss_smoke()
+    out["node_loss"] = nl
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
-                     and rpc["ok"])
+                     and rpc["ok"] and nl["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
