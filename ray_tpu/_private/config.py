@@ -150,6 +150,11 @@ CONFIG \
              "Actor-collective rendezvous timeout.") \
     .declare("serve_control_interval_s", float, 1.0,
              "Serve controller reconcile period.") \
+    .declare("serve_max_slots", int, 8,
+             "LLM engine decode-batch slots per replica (the compiled "
+             "decode step's fixed batch dimension).") \
+    .declare("serve_page_size", int, 16,
+             "Tokens per KV-cache page in the LLM engine's paged pool.") \
     .declare("tcp_host", str, "127.0.0.1",
              "Head TCP bind host (0.0.0.0 to accept remote nodes).") \
     .declare("chaos_delay_us", int, 0,

@@ -120,6 +120,24 @@ class PlacementGroupSchedulingError(RayTpuError):
     """Placement group could not be reserved (infeasible or timeout)."""
 
 
+class BatcherClosedError(RayTpuError):
+    """A @serve.batch batcher was closed (deployment teardown /
+    serve.shutdown) while this call was queued or before it was
+    submitted — the request was never handed to the handler."""
+
+
+class EngineClosedError(RayTpuError):
+    """The serve LLM decode engine was closed (replica drain / fatal
+    engine error) with this request still pending or in flight."""
+
+
+class KVPoolExhaustedError(RayTpuError):
+    """The engine's paged KV cache cannot hold this request: it needs
+    more pages than the pool's capacity (or the pool is exhausted with
+    nothing left to preempt).  Raise max_ctx/num_pages or shorten the
+    request."""
+
+
 class CrossMeshTransferError(RayTpuError):
     """Device-array transfer between meshes failed (ray_tpu.parallel)."""
 

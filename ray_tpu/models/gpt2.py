@@ -24,7 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.attention import mha_attention
+from ray_tpu.ops.attention import cached_attention, mha_attention
 from ray_tpu.ops.layers import gelu
 
 
@@ -79,7 +79,12 @@ class Block(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv=None):
+        """kv = (k_cache, v_cache, lengths) switches the block to the
+        incremental-decode path: attention runs against the cached prefix
+        and the block ALSO returns this step's (k, v) projections so the
+        caller (serve/llm_engine.py) can write them into its page pool —
+        the cache layout is the engine's concern, not the model's."""
         c = self.config
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
         qkv = nn.Dense(3 * c.hidden_size, dtype=c.dtype, name="attn_qkv")(h)
@@ -88,7 +93,10 @@ class Block(nn.Module):
         q = q.reshape(b, l, c.num_heads, c.head_dim)
         k = k.reshape(b, l, c.num_heads, c.head_dim)
         v = v.reshape(b, l, c.num_heads, c.head_dim)
-        if self.attn_fn is not None:
+        if kv is not None:
+            k_cache, v_cache, lengths = kv
+            attn = cached_attention(q, k, v, k_cache, v_cache, lengths)
+        elif self.attn_fn is not None:
             attn = self.attn_fn(q, k, v)
         else:
             attn = mha_attention(q, k, v, causal=True, use_flash=c.use_flash)
@@ -116,6 +124,8 @@ class Block(nn.Module):
                          name="mlp_fc")(h)
             h = gelu(h)
             x = x + nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_proj")(h)
+        if kv is not None:
+            return x, (k, v)
         return x
 
 
@@ -124,16 +134,32 @@ class GPT2(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array) -> jax.Array:
-        """input_ids: [B, L] int32 → logits [B, L, vocab]."""
+    def __call__(self, input_ids: jax.Array, positions: jax.Array = None,
+                 kv_caches=None, kv_lengths: jax.Array = None):
+        """Training/full-context: input_ids [B, L] int32 → logits
+        [B, L, vocab] (unchanged contract).
+
+        Incremental decode (``kv_caches`` given): ``positions`` [B, L]
+        are the absolute positions of the new tokens, ``kv_caches`` is a
+        per-layer list of (k, v) each [B, S, H, D] of which the first
+        ``kv_lengths[b]`` rows are valid; returns (logits, new_kvs) where
+        new_kvs is the per-layer list of this call's (k, v) projections
+        [B, L, H, D] for the caller to append to its cache."""
         c = self.config
         b, l = input_ids.shape
+        decode = kv_caches is not None
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (c.vocab_size, c.hidden_size), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (c.max_position_embeddings, c.hidden_size), jnp.float32)
-        x = wte[input_ids].astype(c.dtype) + wpe[None, :l].astype(c.dtype)
+        pos = wpe[None, :l] if positions is None else wpe[positions]
+        x = wte[input_ids].astype(c.dtype) + pos.astype(c.dtype)
+        new_kvs = []
         if c.num_layers >= c.scan_layers_threshold:
+            if decode:
+                raise NotImplementedError(
+                    "incremental decode is unrolled-layers only; lower "
+                    "scan_layers_threshold applies to training compiles")
             block = nn.remat(Block)
             ScanBlocks = nn.scan(
                 block, variable_axes={"params": 0}, split_rngs={"params": True},
@@ -141,7 +167,12 @@ class GPT2(nn.Module):
             x, _ = ScanBlocks(c, self.attn_fn, name="h_scan")(x, None)
         else:
             for i in range(c.num_layers):
-                x = Block(c, self.attn_fn, name=f"h_{i}")(x)
+                if decode:
+                    x, nkv = Block(c, self.attn_fn, name=f"h_{i}")(
+                        x, kv=(kv_caches[i][0], kv_caches[i][1], kv_lengths))
+                    new_kvs.append(nkv)
+                else:
+                    x = Block(c, self.attn_fn, name=f"h_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied LM head: the matmul runs at the compute dtype (bf16 doubles
         # MXU rate on the single biggest matmul in the model); the logits
@@ -149,7 +180,10 @@ class GPT2(nn.Module):
         # precision where it matters.
         logits = jnp.einsum("bld,vd->blv", x.astype(c.dtype),
                             wte.astype(c.dtype))
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if decode:
+            return logits, new_kvs
+        return logits
 
 
 def gpt2_loss_fn(params, apply_fn, batch) -> jax.Array:

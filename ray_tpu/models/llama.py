@@ -23,7 +23,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.attention import mha_attention
+from ray_tpu.ops.attention import cached_attention, mha_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,10 +87,14 @@ def rope_tables(length: int, head_dim: int, theta: float):
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """Rotate pairs of channels; x: [B, L, H, D]."""
+    """Rotate pairs of channels; x: [B, L, H, D].  cos/sin are either
+    [L, D/2] (contiguous-from-zero, the training path) or [B, L, D/2]
+    (per-token absolute positions, the decode path)."""
     x1, x2 = jnp.split(x, 2, axis=-1)
-    cos = cos[None, :, None, :].astype(x.dtype)
-    sin = sin[None, :, None, :].astype(x.dtype)
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    cos = cos[:, :, None, :].astype(x.dtype)
+    sin = sin[:, :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x2 * cos + x1 * sin], axis=-1)
 
@@ -99,7 +103,12 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv=None, positions=None):
+        """kv = (k_cache, v_cache, lengths) → incremental decode: rope is
+        applied at the tokens' absolute ``positions``, the cache stays at
+        num_kv_heads (the GQA memory win carries into the KV pages;
+        cached_attention expands heads after concat), and the layer also
+        returns this step's post-rope (k, v) for the caller's cache."""
         c = self.config
         B, L, _ = x.shape
         hd = c.head_dim
@@ -111,6 +120,15 @@ class LlamaAttention(nn.Module):
             B, L, c.num_kv_heads, hd)
         v = dense(c.num_kv_heads * hd, "v_proj")(x).reshape(
             B, L, c.num_kv_heads, hd)
+        if kv is not None:
+            cos, sin = rope_tables(c.max_position_embeddings, hd,
+                                   c.rope_theta)
+            q = apply_rope(q, cos[positions], sin[positions])
+            k = apply_rope(k, cos[positions], sin[positions])
+            k_cache, v_cache, lengths = kv
+            out = cached_attention(q, k, v, k_cache, v_cache, lengths)
+            out = out.reshape(B, L, c.num_heads * hd)
+            return dense(c.hidden_size, "o_proj")(out), (k, v)
         cos, sin = rope_tables(L, hd, c.rope_theta)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -143,8 +161,16 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv=None, positions=None):
         c = self.config
+        if kv is not None:
+            attn, new_kv = LlamaAttention(c, name="attn")(
+                RMSNorm(c.rms_eps, c.dtype, name="attn_norm")(x),
+                kv=kv, positions=positions)
+            x = x + attn
+            x = x + LlamaMLP(c, name="mlp")(
+                RMSNorm(c.rms_eps, c.dtype, name="mlp_norm")(x))
+            return x, new_kv
         x = x + LlamaAttention(c, name="attn")(
             RMSNorm(c.rms_eps, c.dtype, name="attn_norm")(x))
         x = x + LlamaMLP(c, name="mlp")(
@@ -156,17 +182,32 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array) -> jax.Array:
+    def __call__(self, input_ids: jax.Array, positions: jax.Array = None,
+                 kv_caches=None, kv_lengths: jax.Array = None):
+        """Full-context: input_ids [B, L] → logits [B, L, vocab].  With
+        ``kv_caches`` (per-layer (k, v) at num_kv_heads, valid rows per
+        ``kv_lengths``) and absolute ``positions``: incremental decode,
+        returning (logits, new_kvs) — the same contract as GPT2."""
         c = self.config
         emb = nn.Embed(c.vocab_size, c.hidden_size,
                        dtype=c.dtype, name="embed")
         x = emb(input_ids)
+        decode = kv_caches is not None
+        new_kvs = []
         for i in range(c.num_layers):
-            x = LlamaBlock(c, name=f"layer_{i}")(x)
+            if decode:
+                x, nkv = LlamaBlock(c, name=f"layer_{i}")(
+                    x, kv=(kv_caches[i][0], kv_caches[i][1], kv_lengths),
+                    positions=positions)
+                new_kvs.append(nkv)
+            else:
+                x = LlamaBlock(c, name=f"layer_{i}")(x)
         x = RMSNorm(c.rms_eps, c.dtype, name="final_norm")(x)
         # Untied LM head (llama convention), fp32 logits for the softmax.
         logits = nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x.astype(jnp.float32))
+        if decode:
+            return logits, new_kvs
         return logits
 
 

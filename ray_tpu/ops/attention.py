@@ -81,6 +81,47 @@ def _xla_attention(q, k, v, causal, sm_scale):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def cached_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                     k_cache: jax.Array, v_cache: jax.Array,
+                     cache_lengths: jax.Array,
+                     sm_scale: Optional[float] = None) -> jax.Array:
+    """Attention for the incremental-decode path: T new tokens attend to a
+    per-sequence cached prefix plus themselves (causally).
+
+    q, k_new, v_new: [B, T, H(q/kv), D] projections of the new tokens,
+    occupying absolute positions ``cache_lengths[b] + t``.
+    k_cache, v_cache: [B, S, Hkv, D]; only the first ``cache_lengths[b]``
+    rows of each sequence are valid — the rest (pool pages past the
+    write head) is masked out, so callers can pass padded/gathered
+    caches without zeroing them.  With Hkv < H the key/value heads are
+    expanded GQA-style after concatenation.  S == 0 degenerates to plain
+    causal self-attention (the prefill case).  Numerics match
+    ``_xla_attention`` (fp32 softmax over masked scores), so greedy
+    decode through a cache is token-identical to a full-context forward
+    pass in fp32.
+    """
+    b, t, h, d = q.shape
+    s = k_cache.shape[1]
+    k = jnp.concatenate([k_cache, k_new], axis=1) if s else k_new
+    v = jnp.concatenate([v_cache, v_new], axis=1) if s else v_new
+    if k.shape[2] != h:  # GQA: expand kv heads to query heads
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, T, S+T]
+    j = jnp.arange(s + t)
+    i = jnp.arange(t)
+    # Key j is visible to query i when it's a valid cache row (j < len[b])
+    # or a causally-earlier new token (j - S <= i).
+    mask = jnp.where(j[None, None, :] < s,
+                     j[None, None, :] < cache_lengths[:, None, None],
+                     (j[None, None, :] - s) <= i[None, :, None])
+    scores = jnp.where(mask[:, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
 # ---------------------------------------------------------------------------
 # Online-softmax block update (the flash recurrence), shared by ring
 # attention: numerically safe when a block is fully masked.

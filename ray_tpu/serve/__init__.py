@@ -27,3 +27,16 @@ from ray_tpu.serve.api import (  # noqa: F401
 from ray_tpu.serve.autoscaling import calculate_desired_num_replicas  # noqa: F401
 from ray_tpu.serve.asgi import ASGIAdapter, ingress  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
+
+# The LLM decode engine pulls in jax/flax — load it lazily so importing
+# ray_tpu.serve stays cheap for deployments that never touch a model.
+_LLM_EXPORTS = ("LLMEngine", "LLMServer", "NaiveLM", "PagePool",
+                "build_model", "generate_many")
+
+
+def __getattr__(name):
+    if name in _LLM_EXPORTS:
+        from ray_tpu.serve import llm_engine
+
+        return getattr(llm_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

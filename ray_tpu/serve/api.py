@@ -35,6 +35,22 @@ class _Replica:
     def queue_len(self) -> int:
         return self._queued
 
+    def drain(self) -> bool:
+        """Teardown hook: close the callable's batchers (waking blocked
+        submitters with a typed error) and, if the callable exposes its
+        own drain (e.g. llm_engine.LLMServer), run it — so killing the
+        replica never strands callers mid-queue."""
+        from ray_tpu.serve import batching
+
+        fn = getattr(self._callable, "drain", None)
+        if callable(fn):
+            try:
+                fn()
+            except Exception:
+                pass
+        batching.close_instance_batchers(self._callable)
+        return True
+
     def reconfigure(self, user_config):
         fn = getattr(self._callable, "reconfigure", None)
         if fn is not None:
@@ -224,6 +240,20 @@ class Deployment:
         from ray_tpu.serve.controller import get_controller
 
         get_controller().unwatch(self)
+        # Drain before kill: close each replica's batchers so submitters
+        # blocked on a batcher future get a typed BatcherClosedError
+        # instead of hanging on a killed actor forever.
+        acks = []
+        for r in self._replicas:
+            try:
+                acks.append(r.drain.remote())
+            except Exception:
+                pass
+        for a in acks:
+            try:
+                ray_tpu.get(a, timeout=5)
+            except Exception:
+                pass
         for r in self._replicas:
             try:
                 ray_tpu.kill(r)
@@ -294,6 +324,12 @@ def shutdown():
     global _proxy
     for name in list(_deployments):
         delete(name)
+    # Driver-process batchers (plain-function @serve.batch, local-mode
+    # replicas): close them here — their daemon threads and any blocked
+    # submitters don't die with a remote actor.
+    from ray_tpu.serve import batching
+
+    batching.shutdown_batchers()
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None

@@ -9,17 +9,34 @@ batch dimension from a request/response workload.
 Mechanics: requests enqueue (item, Future) and block on the future; a
 lazily-started batcher thread drains the queue — first item blocking, then
 up to max_batch_size or until batch_wait_timeout_s passes — and calls the
-wrapped function once with the list of items, distributing results (or the
-exception) back.  Works on plain functions and methods (descriptor
-protocol keeps one batcher per bound instance).
+wrapped function once with the list of items, distributing results back.
+
+Failure semantics: an exception from the batched handler is ISOLATED —
+each item of the failed batch is retried alone, so only the item whose
+handler actually raises sees the exception; its batchmates still get
+results (at the cost of re-running their handler calls, so batched
+handlers should be idempotent per item).  ``close()`` stops the batcher
+thread and wakes queued submitters with a typed
+:class:`~ray_tpu.exceptions.BatcherClosedError` — deployment teardown and
+``serve.shutdown()`` drain every batcher instead of leaking daemon
+threads and permanently-blocked callers.
 """
 from __future__ import annotations
 
 import functools
 import queue
 import threading
+import weakref
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional
+
+from ray_tpu.exceptions import BatcherClosedError
+
+_CLOSE = object()  # queue sentinel: wake the loop for shutdown
+
+# Every live batcher in this process, so teardown paths (serve.shutdown,
+# replica drain) can close them without holding the decorated objects.
+_BATCHERS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class _Batcher:
@@ -31,9 +48,15 @@ class _Batcher:
         self._queue: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._closed = False
+        _BATCHERS.add(self)
 
     def _ensure_thread(self):
         with self._lock:
+            if self._closed:
+                raise BatcherClosedError(
+                    f"batcher for {getattr(self.fn, '__name__', self.fn)!r} "
+                    f"is closed")
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._loop, name="rtpu-serve-batcher", daemon=True)
@@ -41,39 +64,125 @@ class _Batcher:
 
     def submit(self, item) -> Any:
         fut: Future = Future()
-        self._queue.put((item, fut))
         self._ensure_thread()
+        self._queue.put((item, fut))
+        if self._closed:
+            # close() raced our put: its drain may already have run, so
+            # this future could block forever — fail it here (idempotent
+            # if the drain got it first).
+            if not fut.done():
+                fut.set_exception(BatcherClosedError("batcher closed"))
         return fut.result()
+
+    def close(self, timeout: float = 5.0):
+        """Stop the batcher thread and fail queued submitters with a
+        typed error.  The batch currently executing finishes and its
+        callers get their results."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            t = self._thread
+        self._queue.put((_CLOSE, None))
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        err = BatcherClosedError(
+            f"batcher for {getattr(self.fn, '__name__', self.fn)!r} was "
+            f"closed before this request ran")
+        while True:
+            try:
+                item, fut = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if fut is not None and not fut.done():
+                fut.set_exception(err)
+
+    def _dispatch(self, batch):
+        items = [b[0] for b in batch]
+        try:
+            results = self.fn(items)
+            if results is None or len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function must return one result per "
+                    f"input ({len(items)} in, "
+                    f"{None if results is None else len(results)} out)")
+            for (_, f), r in zip(batch, results):
+                f.set_result(r)
+        except BaseException as e:  # noqa: BLE001 — delivered to callers
+            if len(batch) == 1:
+                _, f = batch[0]
+                if not f.done():
+                    f.set_exception(e)
+                return
+            # Isolate the offender: one poisoned item must not fail its
+            # batchmates.  Re-run each item alone; whoever raises gets
+            # their own exception, everyone else a result.
+            for it, f in batch:
+                if f.done():
+                    continue
+                try:
+                    r = self.fn([it])
+                    if r is None or len(r) != 1:
+                        raise ValueError(
+                            "@serve.batch function must return one result "
+                            "per input")
+                    f.set_result(r[0])
+                except BaseException as ee:  # noqa: BLE001
+                    f.set_exception(ee)
 
     def _loop(self):
         import time
 
         while True:
             item, fut = self._queue.get()
+            if item is _CLOSE:
+                return
+            if self._closed:
+                # Drain mode: the in-flight batch (if any) already got its
+                # results; everything queued at close time is failed, not
+                # run — callers wake with the typed error.
+                if not fut.done():
+                    fut.set_exception(BatcherClosedError(
+                        "batcher closed before this request ran"))
+                continue
             batch = [(item, fut)]
             deadline = time.monotonic() + self.batch_wait_timeout_s
+            closing = False
             while len(batch) < self.max_batch_size:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 try:
-                    batch.append(self._queue.get(timeout=remaining))
+                    nxt = self._queue.get(timeout=remaining)
                 except queue.Empty:
                     break
-            items = [b[0] for b in batch]
+                if nxt[0] is _CLOSE:
+                    closing = True
+                    break
+                batch.append(nxt)
+            self._dispatch(batch)
+            if closing:
+                return
+
+
+def shutdown_batchers():
+    """Close every live batcher in this process (serve.shutdown)."""
+    for b in list(_BATCHERS):
+        try:
+            b.close()
+        except Exception:
+            pass
+
+
+def close_instance_batchers(obj):
+    """Close the per-instance batchers installed on ``obj`` by the method
+    form of @serve.batch (replica teardown)."""
+    for name, val in list(vars(obj).items()):
+        if name.startswith("__rtpu_batcher_") and isinstance(val, _Batcher):
             try:
-                results = self.fn(items)
-                if results is None or len(results) != len(items):
-                    raise ValueError(
-                        f"@serve.batch function must return one result per "
-                        f"input ({len(items)} in, "
-                        f"{None if results is None else len(results)} out)")
-                for (_, f), r in zip(batch, results):
-                    f.set_result(r)
-            except BaseException as e:  # noqa: BLE001 — delivered to callers
-                for _, f in batch:
-                    if not f.done():
-                        f.set_exception(e)
+                val.close()
+            except Exception:
+                pass
 
 
 class _BatchDescriptor:
@@ -88,7 +197,7 @@ class _BatchDescriptor:
 
     # plain-function use
     def __call__(self, item):
-        if self._free_batcher is None:
+        if self._free_batcher is None or self._free_batcher._closed:
             self._free_batcher = _Batcher(self._fn, self._max, self._wait)
         return self._free_batcher.submit(item)
 
@@ -98,7 +207,7 @@ class _BatchDescriptor:
             return self
         attr = "__rtpu_batcher_" + self._fn.__name__
         batcher = getattr(obj, attr, None)
-        if batcher is None:
+        if batcher is None or batcher._closed:
             bound = self._fn.__get__(obj, objtype)
             batcher = _Batcher(bound, self._max, self._wait)
             try:

@@ -1,0 +1,786 @@
+"""Continuous-batching LLM decode engine with a paged KV cache.
+
+The inference half of the north star: `ray_tpu/serve/` routed and
+wall-clock-batched requests, but had no decode path — this module is the
+replica-resident engine that turns the models we train
+(`ray_tpu/models/gpt2.py`, `llama.py`) into a serving workload
+(reference composition: Ray's latency-oriented serving tier over the
+task/actor/object substrate, arxiv 1712.05889; engine design follows the
+continuous-batching literature — Orca's iteration-level scheduling and
+vLLM's paged KV cache).
+
+Three load-bearing ideas:
+
+1. **Fixed-slot compiled decode step.**  The decode program is compiled
+   ONCE for `[max_slots]`-shaped inputs (token ids, lengths, page table,
+   active mask).  Admitting or retiring a request flips host-side state —
+   it never changes a traced shape, so the steady-state loop never
+   recompiles.  Prefill compiles per power-of-two prompt bucket (bounded:
+   log2(max_ctx) programs).
+
+2. **Token-boundary admission.**  The engine loop runs one decode step
+   for ALL in-flight requests, then admits pending requests into free
+   slots *between* steps (one prefill each) — a new request joins the
+   running batch at the next token boundary instead of waiting for the
+   batch to drain (Orca's iteration-level scheduling).
+
+3. **Paged KV cache.**  K/V live in fixed-size pages allocated from a
+   device-resident pool (`PagePool` — the SegmentPool free-list recycle
+   design from `_private/object_store.py:163`, collapsed to one size
+   class because pages are uniform).  A sequence owns `ceil(len/page)`
+   pages found through a per-slot page table; the decode step gathers
+   pages into the attention view and scatters the new token's K/V back.
+   Long and short sequences share the pool without fragmentation, pages
+   recycle at retirement, and when the pool runs dry the engine preempts
+   the youngest request (its pages free; it restarts later from
+   prompt+generated-so-far — greedy decode is deterministic, so resumed
+   output is identical and already-streamed chunks are never re-sent).
+
+Request/response payloads ride the object plane zero-copy: see
+``generate_many`` (client: ``put_many`` prompts → replica:
+``get_many`` → decode → ``put_many`` outputs → client: ``get_many``).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.exceptions import EngineClosedError, KVPoolExhaustedError
+
+_DEF = object()  # sentinel: constructor arg not given, consult CONFIG
+
+
+def _cfg(name, given, fallback):
+    if given is not _DEF and given is not None:
+        return given
+    try:
+        from ray_tpu._private.config import CONFIG
+
+        v = CONFIG.get(name)
+        return v if v else fallback
+    except Exception:
+        return fallback
+
+
+class PagePool:
+    """Free-list allocator of fixed-size KV-cache pages.
+
+    The SegmentPool design (`_private/object_store.py:163`) applied to
+    device memory: pages are created once (the device arrays are
+    allocated up front) and recycled through a free list instead of
+    re-allocated, so steady-state admission costs a list pop.  Pages are
+    uniform, so SegmentPool's power-of-two size classes collapse to one
+    free list; the accounting (hits/misses, peak, in-use) keeps the same
+    shape so the dashboard reads both pools alike.  Page 0 is the
+    scratch page: masked-out lanes of the compiled scatter (inactive
+    slots, prompt padding) are routed there so they can never corrupt a
+    live sequence."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("PagePool needs >= 2 pages (page 0 is scratch)")
+        self.capacity = num_pages - 1  # page 0 reserved
+        self._free: collections.deque = collections.deque(range(1, num_pages))
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.peak_in_use = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop n pages, all-or-nothing (a partial grant would deadlock the
+        grower against its own reservation)."""
+        with self._lock:
+            if len(self._free) < n:
+                self.misses += 1
+                return None
+            self.hits += 1
+            out = [self._free.popleft() for _ in range(n)]
+            self.peak_in_use = max(self.peak_in_use, self.in_use)
+            return out
+
+    def free(self, pages: Sequence[int]):
+        with self._lock:
+            self._free.extend(pages)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"capacity": self.capacity, "free": len(self._free),
+                    "in_use": self.in_use, "peak_in_use": self.peak_in_use,
+                    "hits": self.hits, "misses": self.misses}
+
+
+@dataclasses.dataclass
+class _Request:
+    id: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int]
+    submitted: float = dataclasses.field(default_factory=time.monotonic)
+    out: List[int] = dataclasses.field(default_factory=list)
+    chunks: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    error: Optional[BaseException] = None
+    streamed: int = 0  # tokens already pushed to the chunk stream
+    admit_seq: int = -1  # preemption picks the youngest (highest seq)
+
+    def context(self) -> List[int]:
+        """Prompt plus generated-so-far — what a (re)admission prefills.
+        Greedy decode is deterministic, so a preempted request resumed
+        from this context produces exactly the tokens it would have."""
+        return self.prompt + self.out
+
+    def finish(self, error: Optional[BaseException] = None):
+        self.error = error
+        if self.streamed < len(self.out):
+            self.chunks.put(self.out[self.streamed:])
+            self.streamed = len(self.out)
+        self.chunks.put(None)
+        self.done.set()
+
+
+class LLMEngine:
+    """Replica-resident continuous-batching decode engine.
+
+    ``submit()`` is thread-safe and returns immediately; a background
+    loop thread owns all device state and serializes prefill/decode.
+    ``result()`` blocks for the full output, ``stream()`` yields token
+    chunks as they are produced (chunks arrive while the request is
+    still decoding).  Greedy (argmax) decoding only — the token-identity
+    contract with the uncached reference is what the correctness gates
+    assert."""
+
+    def __init__(self, model, params, *, max_slots=_DEF, page_size=_DEF,
+                 num_pages: Optional[int] = None,
+                 max_ctx: Optional[int] = None,
+                 chunk_tokens: int = 8, start: bool = True):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax, self._jnp = jax, jnp
+        self._model = model
+        self._params = params
+        c = model.config
+        self.num_layers = c.num_layers
+        self.head_dim = c.head_dim
+        self.kv_heads = getattr(c, "num_kv_heads", c.num_heads)
+        self.dtype = c.dtype
+        self.max_slots = int(_cfg("serve_max_slots", max_slots, 8))
+        self.page_size = int(_cfg("serve_page_size", page_size, 16))
+        self.max_ctx = int(max_ctx or c.max_position_embeddings)
+        self.pages_per_slot = math.ceil(self.max_ctx / self.page_size)
+        self.max_ctx = self.pages_per_slot * self.page_size
+        if self.max_ctx > c.max_position_embeddings:
+            raise ValueError(
+                f"max_ctx {self.max_ctx} (page-rounded) exceeds the model's "
+                f"max_position_embeddings {c.max_position_embeddings}")
+        # Default pool: full provisioning (+1 scratch) — every slot can
+        # reach max_ctx, preemption never fires.  Size it down to share
+        # the pool across more slots than worst-case memory allows.
+        if num_pages is None:
+            num_pages = self.max_slots * self.pages_per_slot + 1
+        self.pool = PagePool(num_pages)
+        self.chunk_tokens = chunk_tokens
+
+        shape = (self.num_layers, num_pages, self.page_size,
+                 self.kv_heads, self.head_dim)
+        self._k_pages = jnp.zeros(shape, self.dtype)
+        self._v_pages = jnp.zeros(shape, self.dtype)
+
+        # Host-side slot state (the loop thread is the only writer).
+        self._table = np.zeros((self.max_slots, self.pages_per_slot),
+                               np.int32)
+        self._lengths = np.zeros((self.max_slots,), np.int32)
+        self._active = np.zeros((self.max_slots,), bool)
+        self._last_tok = np.zeros((self.max_slots,), np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(self.max_slots)]
+        self._slot_req: Dict[int, _Request] = {}
+
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._prefills: Dict[int, Any] = {}
+
+        self._pending: collections.deque = collections.deque()
+        self._requests: Dict[int, _Request] = {}
+        self._next_id = 0
+        self._admit_counter = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        self._stats = collections.Counter()
+        self._occupancy_sum = 0.0
+        self._t0 = time.monotonic()
+        self._metrics = None
+        self._metrics_flush = 0.0
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="rtpu-llm-engine", daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    # public API (any thread)
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> int:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.max_ctx:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_ctx {self.max_ctx}")
+        with self._cond:
+            if self._closed:
+                raise EngineClosedError("engine is closed")
+            rid = self._next_id
+            self._next_id += 1
+            req = _Request(rid, prompt, max_new_tokens, eos_id)
+            self._requests[rid] = req
+            self._pending.append(req)
+            self._cond.notify_all()
+        return rid
+
+    def result(self, rid: int, timeout: Optional[float] = None) -> List[int]:
+        req = self._requests[rid]
+        if not req.done.wait(timeout):
+            raise TimeoutError(f"request {rid} not done within {timeout}s")
+        if req.error is not None:
+            raise req.error
+        return list(req.out)
+
+    def stream(self, rid: int, timeout: float = 120.0):
+        """Yield token chunks (lists) as they are produced; returns when
+        the request retires.  Raises the request's error, if any."""
+        req = self._requests[rid]
+        while True:
+            chunk = req.chunks.get(timeout=timeout)
+            if chunk is None:
+                break
+            yield chunk
+        if req.error is not None:
+            raise req.error
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            n_active = int(self._active.sum())
+            s = dict(self._stats)
+        pool = self.pool.stats()
+        steps = s.get("steps", 0)
+        out = {
+            "active": n_active,
+            "pending": len(self._pending),
+            "admitted": s.get("admitted", 0),
+            "admitted_mid_batch": s.get("admitted_mid_batch", 0),
+            "completed": s.get("completed", 0),
+            "preemptions": s.get("preemptions", 0),
+            "steps": steps,
+            "tokens_generated": s.get("tokens", 0),
+            "avg_batch_occupancy": (self._occupancy_sum / steps
+                                    if steps else 0.0),
+            "pages_in_use": pool["in_use"],
+            "pages_free": pool["free"],
+            "page_pool": pool,
+            "prefill_buckets": len(self._prefills),
+        }
+        cache_size = getattr(self._decode, "_cache_size", None)
+        if callable(cache_size):
+            out["decode_cache_size"] = cache_size()
+        return out
+
+    def close(self, timeout: float = 10.0):
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        err = EngineClosedError("engine closed with requests in flight")
+        for req in list(self._requests.values()):
+            if not req.done.is_set():
+                req.finish(error=err)
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _gather_cache(self, pages, table):
+        """[L, P, ps, Hkv, D] pages + [slots, pp] table → per-slot
+        contiguous [L, slots, max_ctx, Hkv, D] attention view (rows past
+        each slot's length are garbage — masked by cached_attention)."""
+        g = pages[:, table]  # [L, slots, pp, ps, Hkv, D]
+        return g.reshape(self.num_layers, table.shape[0], self.max_ctx,
+                         self.kv_heads, self.head_dim)
+
+    def _decode_impl(self, params, k_pages, v_pages, table, lengths,
+                     tokens, active):
+        """One token for every slot (fixed shapes — compiled once).
+        Inactive lanes compute garbage routed to the scratch page."""
+        jnp = self._jnp
+        L = self.num_layers
+        k_cache = self._gather_cache(k_pages, table)
+        v_cache = self._gather_cache(v_pages, table)
+        kv = [(k_cache[i], v_cache[i]) for i in range(L)]
+        logits, new_kvs = self._model.apply(
+            {"params": params}, tokens[:, None], lengths[:, None], kv,
+            lengths)
+        next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        newk = jnp.stack([nk[0][:, 0] for nk in new_kvs])  # [L,slots,Hkv,D]
+        newv = jnp.stack([nk[1][:, 0] for nk in new_kvs])
+        slot_ix = jnp.arange(table.shape[0])
+        page_col = jnp.minimum(lengths // self.page_size,
+                               self.pages_per_slot - 1)
+        page_idx = jnp.where(active, table[slot_ix, page_col], 0)
+        off = lengths % self.page_size
+        k_pages = k_pages.at[:, page_idx, off].set(newk.astype(self.dtype))
+        v_pages = v_pages.at[:, page_idx, off].set(newv.astype(self.dtype))
+        return k_pages, v_pages, next_tok
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefills.get(bucket)
+        if fn is not None:
+            return fn
+        jax, jnp = self._jax, self._jnp
+        L, ps = self.num_layers, self.page_size
+
+        def prefill(params, k_pages, v_pages, row, tokens, p):
+            """tokens: [bucket] ids padded past p; row: [pp] page table
+            row.  Returns updated pages + the greedy next token."""
+            ids = tokens[None]
+            positions = jnp.arange(bucket)[None]
+            empty = [(jnp.zeros((1, 0, self.kv_heads, self.head_dim),
+                                self.dtype),) * 2 for _ in range(L)]
+            logits, new_kvs = self._model.apply(
+                {"params": params}, ids, positions, empty,
+                jnp.zeros((1,), jnp.int32))
+            next_tok = jnp.argmax(logits[0, p - 1]).astype(jnp.int32)
+            t = jnp.arange(bucket)
+            page_idx = jnp.where(t < p, row[t // ps], 0)
+            off = t % ps
+            newk = jnp.stack([nk[0][0] for nk in new_kvs])  # [L,bkt,Hkv,D]
+            newv = jnp.stack([nk[1][0] for nk in new_kvs])
+            k_pages = k_pages.at[:, page_idx, off].set(
+                newk.astype(self.dtype))
+            v_pages = v_pages.at[:, page_idx, off].set(
+                newv.astype(self.dtype))
+            return k_pages, v_pages, next_tok
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefills[bucket] = fn
+        return fn
+
+    def _bucket_for(self, p: int) -> int:
+        b = 8
+        while b < p:
+            b <<= 1
+        return min(b, self.max_ctx)
+
+    # ------------------------------------------------------------------
+    # engine loop (single thread owns the device state)
+    # ------------------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (not self._closed and not self._pending
+                       and not self._active.any()):
+                    self._cond.wait(0.2)
+                if self._closed:
+                    return
+            try:
+                self._admit()
+                self._grow()
+                if self._active.any():
+                    self._decode_once()
+            except BaseException as e:  # noqa: BLE001 — fail loudly per req
+                self._fail_all(e)
+                return
+            self._flush_metrics()
+
+    def _fail_all(self, e: BaseException):
+        with self._lock:
+            self._closed = True  # a dead loop must reject new submits
+        for req in list(self._requests.values()):
+            if not req.done.is_set():
+                req.finish(error=e)
+        for s in range(self.max_slots):
+            if self._slot_pages[s]:
+                self.pool.free(self._slot_pages[s])
+                self._slot_pages[s] = []
+        self._active[:] = False
+
+    def _admit(self):
+        """Token-boundary admission: fill free slots from the pending
+        queue, one prefill each.  Requires prompt pages + 1 free so the
+        first decode token can't immediately force a preemption."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                free = [s for s in range(self.max_slots)
+                        if not self._active[s]]
+                if not free:
+                    return
+                req = self._pending[0]
+                ctx = req.context()
+                need = math.ceil(len(ctx) / self.page_size)
+                if need + 1 > self.pool.capacity:
+                    # Can never fit, even with the whole pool to itself —
+                    # waiting would busy-spin forever.
+                    self._pending.popleft()
+                    req.finish(error=KVPoolExhaustedError(
+                        f"request {req.id} needs {need + 1} pages but the "
+                        f"pool holds {self.pool.capacity}"))
+                    continue
+                pages = self.pool.alloc(need + 1)
+                if pages is None:
+                    return  # pool too tight right now; retry next boundary
+                self.pool.free(pages[need:])  # only reserve the +1 headroom
+                pages = pages[:need]
+                self._pending.popleft()
+                slot = free[0]
+                mid_batch = bool(self._active.any())
+            self._stats["admitted"] += 1
+            if mid_batch:
+                self._stats["admitted_mid_batch"] += 1
+            self._observe_queue_wait(time.monotonic() - req.submitted)
+            self._slot_pages[slot] = pages
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            row[:need] = pages
+            self._table[slot] = row
+            p = len(ctx)
+            bucket = self._bucket_for(p)
+            toks = np.zeros((bucket,), np.int32)
+            toks[:p] = ctx
+            fn = self._prefill_fn(bucket)
+            self._k_pages, self._v_pages, nxt = fn(
+                self._params, self._k_pages, self._v_pages, row, toks,
+                np.int32(p))
+            tok = int(nxt)
+            self._lengths[slot] = p
+            self._last_tok[slot] = tok
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            with self._lock:
+                self._active[slot] = True
+            self._slot_req[slot] = req
+            self._append_token(slot, req, tok)
+
+    def _grow(self):
+        """Allocate the next page for every active slot whose write head
+        crossed a page boundary; preempt the youngest other request when
+        the pool is dry (vLLM-style recompute preemption)."""
+        for slot in range(self.max_slots):
+            if not self._active[slot]:
+                continue
+            pos = int(self._lengths[slot])
+            page_needed = pos // self.page_size
+            while page_needed >= len(self._slot_pages[slot]):
+                got = self.pool.alloc(1)
+                if got is not None:
+                    self._table[slot, len(self._slot_pages[slot])] = got[0]
+                    self._slot_pages[slot].append(got[0])
+                    continue
+                victim = self._pick_victim(exclude=slot)
+                if victim is None:
+                    req = self._slot_req[slot]
+                    self._retire(slot, req, error=KVPoolExhaustedError(
+                        f"request {req.id} needs page {page_needed + 1} "
+                        f"but the pool ({self.pool.capacity} pages) is "
+                        f"exhausted and no other request can be "
+                        f"preempted"))
+                    break
+                self._preempt(victim)
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        best, best_seq = None, -1
+        for s in range(self.max_slots):
+            if s == exclude or not self._active[s]:
+                continue
+            seq = self._slot_req[s].admit_seq
+            if seq > best_seq:
+                best, best_seq = s, seq
+        return best
+
+    def _preempt(self, slot: int):
+        req = self._slot_req.pop(slot)
+        self.pool.free(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._table[slot] = 0
+        self._lengths[slot] = 0
+        self._stats["preemptions"] += 1
+        with self._lock:
+            self._active[slot] = False
+            self._pending.appendleft(req)  # readmitted first, from context()
+
+    def _decode_once(self):
+        n_active = int(self._active.sum())
+        self._k_pages, self._v_pages, nxt = self._decode(
+            self._params, self._k_pages, self._v_pages, self._table,
+            self._lengths, self._last_tok, self._active)
+        nxt = np.asarray(nxt)
+        self._stats["steps"] += 1
+        self._stats["tokens"] += n_active
+        self._occupancy_sum += n_active / self.max_slots
+        for slot in range(self.max_slots):
+            if not self._active[slot]:
+                continue
+            self._lengths[slot] += 1  # the last token's K/V just landed
+            req = self._slot_req[slot]
+            tok = int(nxt[slot])
+            self._last_tok[slot] = tok
+            self._append_token(slot, req, tok)
+
+    def _append_token(self, slot: int, req: _Request, tok: int):
+        req.out.append(tok)
+        finished = (len(req.out) >= req.max_new_tokens
+                    or (req.eos_id is not None and tok == req.eos_id))
+        if finished:
+            self._retire(slot, req)
+        elif len(req.out) - req.streamed >= self.chunk_tokens:
+            req.chunks.put(req.out[req.streamed:])
+            req.streamed = len(req.out)
+
+    def _retire(self, slot: int, req: _Request,
+                error: Optional[BaseException] = None):
+        self.pool.free(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._table[slot] = 0
+        self._lengths[slot] = 0
+        self._slot_req.pop(slot, None)
+        with self._lock:
+            self._active[slot] = False
+            # Bound the registry: drop the oldest finished requests once
+            # past 4096 entries (a long-lived replica must not leak one
+            # _Request per call).
+            if len(self._requests) > 4096:
+                for rid in list(self._requests):
+                    if len(self._requests) <= 2048:
+                        break
+                    if self._requests[rid].done.is_set():
+                        del self._requests[rid]
+        self._stats["completed"] += 1
+        req.finish(error=error)
+
+    # ------------------------------------------------------------------
+    # metrics (best-effort: the engine also runs without a ray runtime)
+    # ------------------------------------------------------------------
+    def _ensure_metrics(self):
+        if self._metrics is None:
+            from ray_tpu.util import metrics as um
+
+            self._metrics = {
+                "tokens": um.Meter("serve_tokens",
+                                   "Tokens generated by the decode engine"),
+                "requests": um.Meter("serve_requests",
+                                     "Requests completed by the engine"),
+                "inflight": um.Gauge("serve_inflight_requests",
+                                     "Active + queued engine requests"),
+                "occupancy": um.Gauge("serve_batch_occupancy",
+                                      "Active slots / max_slots"),
+                "pages_in_use": um.Gauge("serve_kv_pages_in_use",
+                                         "KV cache pages allocated"),
+                "pages_free": um.Gauge("serve_kv_pages_free",
+                                       "KV cache pages free"),
+                "tokens_per_s": um.Gauge("serve_tokens_per_s",
+                                         "Engine decode throughput"),
+                "queue_wait": um.Histogram(
+                    "serve_queue_wait_s", "Submit-to-admission wait",
+                    boundaries=(0.001, 0.01, 0.1, 1.0, 10.0)),
+            }
+
+    def _observe_queue_wait(self, wait_s: float):
+        try:
+            self._ensure_metrics()
+            self._metrics["queue_wait"].observe(wait_s)
+        except Exception:
+            pass
+
+    def _flush_metrics(self):
+        now = time.monotonic()
+        if now - self._metrics_flush < 2.0:
+            return
+        self._metrics_flush = now
+        try:
+            self._ensure_metrics()
+            m, st = self._metrics, self._stats
+            m["tokens"].mark(st["tokens"] - m["tokens"].total())
+            m["requests"].mark(st["completed"] - m["requests"].total())
+            with self._lock:
+                inflight = int(self._active.sum()) + len(self._pending)
+                occ = float(self._active.sum()) / self.max_slots
+            m["inflight"].set(inflight)
+            m["occupancy"].set(occ)
+            pool = self.pool.stats()
+            m["pages_in_use"].set(pool["in_use"])
+            m["pages_free"].set(pool["free"])
+            m["tokens_per_s"].set(st["tokens"] / max(1e-9,
+                                                     now - self._t0))
+            for meter in (m["tokens"], m["requests"]):
+                meter.flush()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# The naive per-request baseline and shared model builders
+# ---------------------------------------------------------------------------
+class NaiveLM:
+    """Per-request serving baseline: batch-1, no KV cache — every token
+    re-runs the full-context forward pass at a fixed padded width (one
+    compile; padding is exact under the causal mask).  This is the
+    reference the engine must be token-identical to, and the denominator
+    of the continuous-batching speedup in bench.py."""
+
+    def __init__(self, model, params, width: int):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = params
+        self.width = width
+
+        def step(params, ids, n):
+            logits = model.apply({"params": params}, ids)
+            return jnp.argmax(logits[0, n - 1]).astype(jnp.int32)
+
+        self._step = jax.jit(step)
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int,
+                 eos_id: Optional[int] = None) -> List[int]:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        buf = np.zeros((1, self.width), np.int32)
+        buf[0, :len(prompt)] = prompt
+        n = len(prompt)
+        out: List[int] = []
+        for _ in range(max_new_tokens):
+            tok = int(self._step(self.params, buf, np.int32(n)))
+            out.append(tok)
+            if n < self.width:
+                buf[0, n] = tok
+            n += 1
+            if eos_id is not None and tok == eos_id:
+                break
+        return out
+
+
+def build_model(model_kind: str, config_kw: Optional[dict] = None,
+                seed: int = 0):
+    """(model, params) for a serving replica.  Seeded init: every replica
+    of a deployment materializes identical weights without shipping
+    params through init args."""
+    import jax
+    import jax.numpy as jnp
+
+    config_kw = dict(config_kw or {})
+    if model_kind == "gpt2":
+        from ray_tpu.models import GPT2, GPT2Config
+
+        model = GPT2(GPT2Config.tiny(**config_kw) if config_kw.pop(
+            "tiny", True) else GPT2Config(**config_kw))
+    elif model_kind == "llama":
+        from ray_tpu.models import Llama, LlamaConfig
+
+        model = Llama(LlamaConfig.tiny(**config_kw) if config_kw.pop(
+            "tiny", True) else LlamaConfig(**config_kw))
+    else:
+        raise ValueError(f"unknown model_kind {model_kind!r}")
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), ids)["params"]
+    return model, params
+
+
+class LLMServer:
+    """Serve deployment callable hosting one LLMEngine per replica.
+
+    Use with ``@serve.deployment`` / ``serve.run``; autoscaling sees the
+    handle's in-flight count like any deployment, so a saturating client
+    scales replicas up through the normal controller loop.  Three entry
+    points:
+
+    - ``__call__({"tokens": [...], "max_new_tokens": n})`` — JSON/HTTP.
+    - ``generate_batch(refs, ...)`` — the zero-copy object-plane path
+      (prompt refs in via ``get_many``, output refs back via
+      ``put_many``); pair with :func:`generate_many` client-side.
+    - ``submit_stream``/``next_chunk`` — pull-based token streaming.
+    """
+
+    def __init__(self, model_kind: str = "gpt2",
+                 config_kw: Optional[dict] = None, seed: int = 0,
+                 **engine_kw):
+        model, params = build_model(model_kind, config_kw, seed)
+        self.engine = LLMEngine(model, params, **engine_kw)
+
+    def __call__(self, request: dict) -> dict:
+        rid = self.engine.submit(request["tokens"],
+                                 int(request.get("max_new_tokens", 16)),
+                                 request.get("eos_id"))
+        return {"tokens": self.engine.result(rid, timeout=120.0)}
+
+    def generate_batch(self, prompts, max_new_tokens: int = 16,
+                       eos_id: Optional[int] = None, as_refs: bool = True):
+        import ray_tpu
+
+        if prompts and isinstance(prompts[0], ray_tpu.ObjectRef):
+            prompts = ray_tpu.get_many(list(prompts))
+        rids = [self.engine.submit(p, max_new_tokens, eos_id)
+                for p in prompts]
+        outs = [self.engine.result(r, timeout=120.0) for r in rids]
+        if not as_refs:
+            return outs
+        return ray_tpu.put_many([np.asarray(o, np.int32) for o in outs])
+
+    def submit_stream(self, prompt, max_new_tokens: int = 16,
+                      eos_id: Optional[int] = None) -> int:
+        import ray_tpu
+
+        if isinstance(prompt, ray_tpu.ObjectRef):
+            prompt = ray_tpu.get(prompt)
+        return self.engine.submit(prompt, max_new_tokens, eos_id)
+
+    def next_chunk(self, rid: int, timeout: float = 60.0):
+        """Next streamed token chunk, or None when the request retired."""
+        req = self.engine._requests[rid]
+        try:
+            return req.chunks.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(f"no chunk for request {rid} in {timeout}s")
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def drain(self):
+        """Teardown hook: close the engine (fails in-flight requests with
+        a typed error) and any replica-local batchers."""
+        self.engine.close()
+        from ray_tpu.serve import batching
+
+        batching.close_instance_batchers(self)
+        return True
+
+
+def generate_many(handle, prompts, max_new_tokens: int = 16,
+                  eos_id: Optional[int] = None,
+                  timeout: float = 120.0) -> List[List[int]]:
+    """Client half of the zero-copy request path: one ``put_many`` for
+    the prompt batch (one coalesced control-plane notify), one actor call
+    carrying refs, one ``get_many`` gather of the responses."""
+    import ray_tpu
+
+    refs = ray_tpu.put_many([np.asarray(p, np.int32) for p in prompts])
+    out_refs = ray_tpu.get(
+        handle.method("generate_batch").remote(refs, max_new_tokens, eos_id),
+        timeout=timeout)
+    return [[int(t) for t in a] for a in ray_tpu.get_many(out_refs)]

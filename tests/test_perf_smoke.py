@@ -10,6 +10,7 @@ from tools.perf_smoke import (
     run_object_plane_smoke,
     run_rollout_smoke,
     run_rpc_chaos_smoke,
+    run_serving_smoke,
     run_smoke,
 )
 
@@ -71,6 +72,19 @@ def test_object_plane_smoke(shutdown_only):
     assert out["batching_ok"], f"notify batching regression: {out}"
     assert out["roundtrip_ok"], out
     assert out["ok"]
+
+
+def test_serving_smoke():
+    """The continuous-batching engine must decode token-identically to
+    the uncached per-request reference with at least one admission
+    landing mid-batch and the fixed-slot decode step compiled exactly
+    once — the tier-1 guard for ISSUE 8's inference plane."""
+    out = run_serving_smoke()
+    assert out["token_identical"], f"paged decode diverged: {out}"
+    assert out["admitted_mid_batch"] >= 1, f"batch drained to admit: {out}"
+    assert out["decode_cache_size"] == 1, f"decode step recompiled: {out}"
+    assert out["pages_leaked"] == 0, out
+    assert out["ok"], out
 
 
 def test_node_loss_smoke(shutdown_only):

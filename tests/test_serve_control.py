@@ -104,6 +104,119 @@ def test_batch_decorator_plain_function():
     assert max(calls) > 1
 
 
+def test_batch_item_exception_isolated_plain_function():
+    """One poisoned item must fail ONLY its own caller; batchmates still
+    get results (plain-function decorator form)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+    def double(items):
+        if any(x == 3 for x in items):
+            raise ValueError("bad item 3")
+        return [x * 2 for x in items]
+
+    with ThreadPoolExecutor(8) as pool:
+        futs = [pool.submit(double, i) for i in range(8)]
+        results, failed = [], []
+        for i, f in enumerate(futs):
+            try:
+                results.append(f.result(timeout=30))
+            except ValueError:
+                failed.append(i)
+    assert failed == [3], f"wrong/extra items poisoned: {failed}"
+    assert sorted(results) == [0, 2, 4, 8, 10, 12, 14]
+
+
+def test_batch_item_exception_isolated_method_form():
+    """Same isolation through the per-instance method descriptor."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Model:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.1)
+        def predict(self, items):
+            if any(x == 1 for x in items):
+                raise KeyError("one")
+            return [x + 10 for x in items]
+
+    m = Model()
+    with ThreadPoolExecutor(4) as pool:
+        futs = [pool.submit(m.predict, i) for i in range(4)]
+        results, failed = [], []
+        for i, f in enumerate(futs):
+            try:
+                results.append(f.result(timeout=30))
+            except KeyError:
+                failed.append(i)
+    assert failed == [1], f"wrong/extra items poisoned: {failed}"
+    assert sorted(results) == [10, 12, 13]
+
+
+def test_batcher_close_wakes_blocked_waiters():
+    """The teardown-leak regression (ISSUE 8 satellite): closing a
+    batcher must wake queued submitters with a typed BatcherClosedError,
+    let the in-flight batch finish, stop the daemon thread, and leave
+    the decorated function usable again (a fresh batcher) — so
+    serve.shutdown() neither leaks threads nor strands callers."""
+    from ray_tpu.exceptions import BatcherClosedError
+    from ray_tpu.serve import batching
+
+    started = threading.Event()
+
+    @serve.batch(max_batch_size=1, batch_wait_timeout_s=5.0)
+    def slow(items):
+        started.set()
+        time.sleep(0.5)
+        return items
+
+    got, errs = [], []
+
+    def waiter(x):
+        try:
+            got.append(slow(x))
+        except BatcherClosedError:
+            errs.append(x)
+
+    t1 = threading.Thread(target=waiter, args=(1,), daemon=True)
+    t1.start()
+    assert started.wait(10)
+    t2 = threading.Thread(target=waiter, args=(2,), daemon=True)
+    t2.start()
+    time.sleep(0.2)  # let item 2 queue behind the in-flight batch
+    batching.shutdown_batchers()
+    t1.join(30)
+    t2.join(30)
+    assert got == [1], f"in-flight batch lost its result: {got}"
+    assert errs == [2], f"queued waiter not woken with typed error: {errs}"
+    time.sleep(0.2)
+    assert not any(t.name == "rtpu-serve-batcher" and t.is_alive()
+                   for t in threading.enumerate()), "batcher thread leaked"
+    # serve.shutdown must not permanently poison module-level functions.
+    assert slow(9) == 9
+    batching.shutdown_batchers()
+
+
+def test_teardown_drains_replica_batchers(cluster):
+    """Deleting a deployment drains its replicas (drain RPC before kill):
+    a second deployment's batchers are untouched."""
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def predict(self, items):
+            return [x * 2 for x in items]
+
+        def __call__(self, x):
+            return self.predict(x)
+
+    a = serve.run(serve.deployment(Batched, name="drain_a").bind(),
+                  name="drain_a")
+    b = serve.run(serve.deployment(Batched, name="drain_b").bind(),
+                  name="drain_b")
+    assert ray_tpu.get(a.remote(2), timeout=30) == 4
+    assert ray_tpu.get(b.remote(3), timeout=30) == 6
+    serve.delete("drain_a")
+    # b still serves through its own (undrained) batcher.
+    assert ray_tpu.get(b.remote(5), timeout=30) == 10
+
+
 def test_options_copies_do_not_share_replicas(cluster):
     """Deployment.options() must not alias the replica list: tearing one
     deployment down would otherwise kill its sibling's replicas."""

@@ -1,0 +1,375 @@
+"""Continuous-batching inference plane (ISSUE 8).
+
+The load-bearing contract: greedy decode through the paged KV cache is
+TOKEN-IDENTICAL to repeated full-context forward passes (fp32 configs so
+argmax ties cannot mask a cache bug), including requests admitted into
+the in-flight batch mid-decode, EOS retirement, preemption under pool
+pressure, and the serve-plane zero-copy request path.  The engine's
+fixed-slot decode step must compile exactly once regardless of
+admissions/retirements.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import EngineClosedError, KVPoolExhaustedError
+
+
+def _gpt2_tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT2, GPT2Config
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg
+
+
+def _llama_tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def gpt2():
+    return _gpt2_tiny()
+
+
+@pytest.fixture(scope="module")
+def llama():
+    return _llama_tiny()
+
+
+def _prompts(vocab, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, vocab, size=n))) for n in sizes]
+
+
+def test_gpt2_paged_decode_token_identical(gpt2):
+    """Mixed-length prompts through the engine == uncached full-context
+    greedy decode, with the decode step compiled exactly once."""
+    from ray_tpu.serve.llm_engine import LLMEngine, NaiveLM
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=4, page_size=8, max_ctx=64)
+    naive = NaiveLM(model, params, width=64)
+    try:
+        prompts = _prompts(cfg.vocab_size, (5, 11, 19, 30))
+        rids = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        outs = [eng.result(r, timeout=120) for r in rids]
+        assert outs == [naive.generate(p, 10) for p in prompts]
+        st = eng.stats()
+        assert st["completed"] == 4
+        # Fixed-slot invariant: admissions/retirements never recompiled
+        # the decode program.
+        assert st.get("decode_cache_size", 1) == 1, st
+    finally:
+        eng.close()
+
+
+def test_llama_paged_decode_token_identical(llama):
+    """Same contract for the llama family: rope at absolute positions and
+    the GQA cache kept at num_kv_heads must not perturb greedy decode."""
+    from ray_tpu.serve.llm_engine import LLMEngine, NaiveLM
+
+    model, params, cfg = llama
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64)
+    naive = NaiveLM(model, params, width=64)
+    try:
+        prompts = _prompts(cfg.vocab_size, (6, 17), seed=3)
+        rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        outs = [eng.result(r, timeout=120) for r in rids]
+        assert outs == [naive.generate(p, 8) for p in prompts]
+    finally:
+        eng.close()
+
+
+def test_admission_mid_flight_token_identical(gpt2):
+    """A request submitted while another is mid-decode joins the batch at
+    a token boundary — without perturbing either request's tokens."""
+    from ray_tpu.serve.llm_engine import LLMEngine, NaiveLM
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=4, page_size=8, max_ctx=64,
+                    chunk_tokens=2)
+    naive = NaiveLM(model, params, width=64)
+    try:
+        a, b = _prompts(cfg.vocab_size, (7, 13), seed=7)
+        rid_a = eng.submit(a, max_new_tokens=24)
+        stream = eng.stream(rid_a, timeout=60)
+        next(stream)  # a is provably mid-decode now
+        rid_b = eng.submit(b, max_new_tokens=8)
+        out_b = eng.result(rid_b, timeout=120)
+        out_a = list(eng.result(rid_a, timeout=120))
+        assert out_a == naive.generate(a, 24)
+        assert out_b == naive.generate(b, 8)
+        st = eng.stats()
+        assert st["admitted_mid_batch"] >= 1, st
+        assert st.get("decode_cache_size", 1) == 1, st
+    finally:
+        eng.close()
+
+
+def test_eos_retirement_token_identical(gpt2):
+    """A request retires at its FIRST eos token, mid-batch, and the
+    surviving request's tokens are unaffected."""
+    from ray_tpu.serve.llm_engine import LLMEngine, NaiveLM
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64)
+    naive = NaiveLM(model, params, width=64)
+    try:
+        a, b = _prompts(cfg.vocab_size, (9, 12), seed=11)
+        ref_a = naive.generate(a, 16)
+        eos = ref_a[len(ref_a) // 2]
+        cut = ref_a.index(eos) + 1
+        rid_a = eng.submit(a, max_new_tokens=16, eos_id=eos)
+        rid_b = eng.submit(b, max_new_tokens=16)
+        assert eng.result(rid_a, timeout=120) == ref_a[:cut]
+        assert eng.result(rid_b, timeout=120) == naive.generate(b, 16)
+        assert eng.result(rid_a) == naive.generate(a, 16, eos_id=eos)
+    finally:
+        eng.close()
+
+
+def test_streaming_chunks_arrive_mid_flight(gpt2):
+    """Token chunks stream while the request is still decoding, and the
+    concatenation equals the full result."""
+    from ray_tpu.serve.llm_engine import LLMEngine, NaiveLM
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    chunk_tokens=4)
+    naive = NaiveLM(model, params, width=64)
+    try:
+        (p,) = _prompts(cfg.vocab_size, (8,), seed=13)
+        rid = eng.submit(p, max_new_tokens=20)
+        chunks, first_mid_flight = [], None
+        for c in eng.stream(rid, timeout=60):
+            if first_mid_flight is None:
+                first_mid_flight = not eng._requests[rid].done.is_set()
+            chunks.append(c)
+        assert first_mid_flight, "first chunk only arrived at completion"
+        assert [t for c in chunks for t in c] == naive.generate(p, 20)
+    finally:
+        eng.close()
+
+
+def test_preemption_under_pool_pressure_exact(gpt2):
+    """Two long requests over a pool that can't hold both: the engine
+    preempts (recompute-style), both complete, outputs exact."""
+    from ray_tpu.serve.llm_engine import LLMEngine, NaiveLM
+
+    model, params, cfg = gpt2
+    # 9 usable pages of 4 tokens; each request grows to 24 tokens = 6
+    # pages, so two in flight MUST collide and preempt.
+    eng = LLMEngine(model, params, max_slots=2, page_size=4, max_ctx=32,
+                    num_pages=10)
+    naive = NaiveLM(model, params, width=32)
+    try:
+        prompts = _prompts(cfg.vocab_size, (8, 8), seed=17)
+        rids = [eng.submit(p, max_new_tokens=16) for p in prompts]
+        outs = [eng.result(r, timeout=120) for r in rids]
+        assert outs == [naive.generate(p, 16) for p in prompts]
+        st = eng.stats()
+        assert st["preemptions"] >= 1, st
+        assert st["pages_in_use"] == 0, st  # everything recycled
+    finally:
+        eng.close()
+
+
+def test_oversized_request_fails_typed(gpt2):
+    """A request that can never fit the pool fails with
+    KVPoolExhaustedError instead of spinning forever."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=4, max_ctx=32,
+                    num_pages=5)  # 4 usable pages = 16 tokens max
+    try:
+        (p,) = _prompts(cfg.vocab_size, (8,), seed=19)
+        rid = eng.submit(p, max_new_tokens=20)  # needs 28 tokens
+        with pytest.raises(KVPoolExhaustedError):
+            eng.result(rid, timeout=60)
+    finally:
+        eng.close()
+
+
+def test_engine_close_fails_pending_typed(gpt2):
+    """close() wakes pending/in-flight submitters with EngineClosedError
+    and rejects new submissions."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64)
+    (p,) = _prompts(cfg.vocab_size, (8,), seed=23)
+    rid = eng.submit(p, max_new_tokens=32)
+    eng.close()
+    with pytest.raises(EngineClosedError):
+        eng.result(rid, timeout=10)
+    with pytest.raises(EngineClosedError):
+        eng.submit(p, max_new_tokens=4)
+
+
+def test_page_pool_recycles():
+    """PagePool accounting: alloc/free round-trips, all-or-nothing grants,
+    scratch page never handed out."""
+    from ray_tpu.serve.llm_engine import PagePool
+
+    pool = PagePool(8)  # 7 usable
+    a = pool.alloc(3)
+    assert a is not None and 0 not in a
+    assert pool.alloc(5) is None  # only 4 left — all-or-nothing
+    assert pool.in_use == 3
+    pool.free(a)
+    assert pool.free_pages == 7
+    b = pool.alloc(7)
+    assert b is not None and 0 not in b and pool.free_pages == 0
+    st = pool.stats()
+    assert st["peak_in_use"] == 7 and st["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# serve-plane integration (ray runtime)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def serve_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SERVE_CONTROL_INTERVAL_S", "0.2")
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.serve.controller import reset_controller
+
+    CONFIG.reset()
+    reset_controller()
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024**2)
+    from ray_tpu import serve
+
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+    CONFIG.reset()
+
+
+def test_serve_llm_zero_copy_roundtrip(serve_cluster, gpt2):
+    """Prompts ride put_many → replica get_many → decode → put_many →
+    client get_many, token-identical to the uncached reference; teardown
+    drains the replica engine."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm_engine import LLMServer, NaiveLM, generate_many
+
+    model, params, cfg = gpt2
+    dep = serve.deployment(LLMServer, name="llm")
+    handle = serve.run(dep.bind(
+        "gpt2", {"tiny": True, "dtype": "float32"}, 0,
+        max_slots=4, page_size=8, max_ctx=64))
+    prompts = _prompts(cfg.vocab_size, (5, 9, 14, 21), seed=29)
+    outs = generate_many(handle, prompts, max_new_tokens=8)
+    naive = NaiveLM(model, params, width=64)
+    assert outs == [naive.generate(p, 8) for p in prompts]
+    st = ray_tpu.get(handle.method("stats").remote(), timeout=30)
+    assert st["completed"] == 4
+    assert st["admitted_mid_batch"] >= 1, st
+    serve.delete("llm")
+
+
+def test_serve_llm_streaming_chunks(serve_cluster, gpt2):
+    """Pull-based streaming through the replica: chunks arrive before the
+    request completes and concatenate to the exact output."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm_engine import LLMServer, NaiveLM
+
+    model, params, cfg = gpt2
+    dep = serve.deployment(LLMServer, name="llm_stream")
+    handle = serve.run(dep.bind(
+        "gpt2", {"tiny": True, "dtype": "float32"}, 0,
+        max_slots=2, page_size=8, max_ctx=64, chunk_tokens=4))
+    (p,) = _prompts(cfg.vocab_size, (8,), seed=31)
+    rid = ray_tpu.get(handle.method("submit_stream").remote(p, 20),
+                      timeout=60)
+    chunks = []
+    while True:
+        c = ray_tpu.get(handle.method("next_chunk").remote(rid), timeout=60)
+        if c is None:
+            break
+        chunks.append(c)
+    naive = NaiveLM(model, params, width=64)
+    assert [t for c in chunks for t in c] == naive.generate(p, 20)
+    assert len(chunks) >= 2
+    serve.delete("llm_stream")
+
+
+def test_llm_autoscales_up_under_load(serve_cluster):
+    """The acceptance gate's autoscaling half: a saturating synthetic
+    client drives the ServeController to add LLM replicas."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm_engine import LLMServer
+
+    dep = serve.deployment(
+        LLMServer, name="llm_auto",
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_num_ongoing_requests_per_replica": 1.0,
+                            "look_back_polls": 1})
+    handle = serve.run(dep.bind(
+        "gpt2", {"tiny": True, "dtype": "float32"}, 0,
+        max_slots=2, page_size=8, max_ctx=64))
+    assert handle.num_replicas == 1
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            try:
+                ray_tpu.get(handle.remote(
+                    {"tokens": [1, 2, 3, 4], "max_new_tokens": 24}),
+                    timeout=60)
+            except Exception:
+                return
+
+    threads = [threading.Thread(target=pound, daemon=True)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and handle.num_replicas < 2:
+        time.sleep(0.2)
+    scaled_to = handle.num_replicas
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert scaled_to >= 2, "controller never scaled the LLM deployment up"
+    serve.delete("llm_auto")
+
+
+def test_serve_metrics_exported(serve_cluster, gpt2):
+    """serve_* engine metrics surface through util.metrics (the dashboard
+    /metrics endpoint renders the same registry)."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+    from ray_tpu.util.metrics import prometheus_text
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64)
+    try:
+        (p,) = _prompts(cfg.vocab_size, (6,), seed=37)
+        eng.result(eng.submit(p, max_new_tokens=6), timeout=120)
+        eng._metrics_flush = 0.0  # bypass the 2s throttle
+        eng._flush_metrics()
+        text = prometheus_text()
+        for key in ("serve_tokens", "serve_inflight_requests",
+                    "serve_batch_occupancy", "serve_kv_pages_in_use",
+                    "serve_kv_pages_free", "serve_tokens_per_s",
+                    "serve_queue_wait_s"):
+            assert key in text, f"{key} missing from /metrics text"
+    finally:
+        eng.close()

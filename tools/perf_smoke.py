@@ -504,6 +504,69 @@ def run_node_loss_smoke(steps: int = 8, kill_at: int = 3) -> dict:
         CONFIG.reset()
 
 
+def run_serving_smoke(max_new: int = 10) -> dict:
+    """Continuous-batching inference invariants (tier-1 guard for
+    ISSUE 8; one in-process engine "replica", no timing assertions):
+
+    1. **Token identity**: concurrent requests of mixed prompt lengths
+       decoded through the paged KV cache produce EXACTLY the tokens of
+       per-request full-context greedy decode (fp32 tiny GPT-2).
+    2. **Token-boundary admission**: at least one request was admitted
+       while another was mid-decode (``admitted_mid_batch >= 1``) — the
+       batch never drained to let a newcomer in.
+    3. **Fixed-slot compile**: the decode step compiled exactly once
+       across all admissions/retirements.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.serve.llm_engine import LLMEngine, NaiveLM
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = LLMEngine(model, params, max_slots=4, page_size=8, max_ctx=64,
+                    chunk_tokens=2)
+    naive = NaiveLM(model, params, width=64)
+    try:
+        rng = np.random.default_rng(0)
+        # Mixed lengths within ONE prefill bucket (<= 8): the smoke pays
+        # exactly two engine compiles (prefill + decode) — tier-1 cheap.
+        sizes = (4, 6, 8)
+        prompts = [list(map(int, rng.integers(0, cfg.vocab_size, size=n)))
+                   for n in sizes]
+        # Provably-mid-flight admission: start the first request, wait for
+        # a streamed chunk (it is decoding), then submit the rest.
+        rid0 = eng.submit(prompts[0], max_new_tokens=2 * max_new)
+        stream = eng.stream(rid0, timeout=60)
+        next(stream)
+        rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts[1:]]
+        outs = [eng.result(r, timeout=120) for r in rids]
+        out0 = eng.result(rid0, timeout=120)
+        refs = [naive.generate(p, max_new) for p in prompts[1:]]
+        ref0 = naive.generate(prompts[0], 2 * max_new)
+        st = eng.stats()
+        out = {
+            "requests": len(prompts),
+            "prompt_sizes": list(sizes),
+            "token_identical": bool(outs == refs and out0 == ref0),
+            "admitted_mid_batch": st["admitted_mid_batch"],
+            "decode_cache_size": st.get("decode_cache_size", 1),
+            "avg_batch_occupancy": round(st["avg_batch_occupancy"], 3),
+            "pages_leaked": st["pages_in_use"],
+        }
+        out["ok"] = bool(out["token_identical"]
+                         and out["admitted_mid_batch"] >= 1
+                         and out["decode_cache_size"] == 1
+                         and out["pages_leaked"] == 0)
+        return out
+    finally:
+        eng.close()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
@@ -517,8 +580,10 @@ def main() -> int:
     out["rpc_chaos"] = rpc
     nl = run_node_loss_smoke()
     out["node_loss"] = nl
+    sv = run_serving_smoke()
+    out["serving"] = sv
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
-                     and rpc["ok"] and nl["ok"])
+                     and rpc["ok"] and nl["ok"] and sv["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
