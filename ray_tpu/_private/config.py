@@ -246,4 +246,15 @@ CONFIG \
              "only death signal).") \
     .declare("node_heartbeat_period_s", float, 1.0,
              "Node-agent liveness heartbeat period (any agent message "
-             "also refreshes the lease).")
+             "also refreshes the lease).") \
+    .declare("zero_sharding", str, "off",
+             "ZeRO-style data-parallel update sharding for the Train JAX "
+             "loops: 'off' | 'opt' (optimizer state sharded 1/N, grads "
+             "all-reduced) | 'opt+grads' (grads reduce-scattered too).  "
+             "Consumed as the default by the bench GPT-2 loop and "
+             "train.jax.compile_zero_step callers; RLlib uses "
+             "AlgorithmConfig.resources(zero_sharding=...).") \
+    .declare("quantized_collectives", str, "off",
+             "Gradient-reduction wire format for the sharded train "
+             "steps: 'off' (fp32 psum) | 'int8' (block-scaled int8, "
+             "~4x fewer bytes, loss-parity gated).")

@@ -216,6 +216,7 @@ class Head:
             self._durability = ("spill",)
         self._durability_min = CONFIG.object_durability_min_bytes
         self._durability_q = None
+        self._durability_pending = 0  # queued + in-flight (quiesce gate)
         self._repl_client = None  # lazy TransferClient for replica pulls
         if self._durability is not None:
             import queue as _queue
@@ -2220,20 +2221,66 @@ class Head:
         predicate when durability is off; never blocks the seal path."""
         if self._durability_q is not None and size >= self._durability_min \
                 and oid.is_put():
+            # Callers hold self._lock (seal path) — the pending counter is
+            # the quiesce gate's truth, bumped before the queue put so the
+            # worker's decrement can never race it below zero.
+            self._durability_pending += 1
             self._durability_q.put(oid)
 
+    _DURABILITY_ATTEMPTS = 6  # ~3s of exponential backoff, then give up
+
     def _durability_loop(self):
+        import time as _time
+
         while not self._shutdown:
-            oid = self._durability_q.get()
-            if oid is None:
+            item = self._durability_q.get()
+            if item is None:
                 return
+            oid, attempt = item if isinstance(item, tuple) else (item, 0)
+            ok = True
             try:
                 if self._durability[0] == "replicate":
-                    self._replicate_one(oid, self._durability[1])
+                    ok = self._replicate_one(oid, self._durability[1])
                 else:
                     self._backup_one(oid)
             except Exception:
                 traceback.print_exc()
+                ok = False
+            if ok is False and attempt + 1 < self._DURABILITY_ATTEMPTS \
+                    and not self._shutdown:
+                # Transient failure — the canonical case: the pull raced
+                # the agent's async store_adopt of a freshly-sealed
+                # segment, so the source's transfer server doesn't serve
+                # the object YET.  Retry with backoff; the pending count
+                # is NOT released, so durability_quiesce keeps blocking
+                # until the replica truly exists (or attempts exhaust).
+                _time.sleep(0.05 * (2 ** attempt))
+                self._durability_q.put((oid, attempt + 1))
+                continue
+            with self._lock:
+                self._durability_pending -= 1
+
+    def durability_quiesce(self, timeout: float = 30.0) -> bool:
+        """Wait until the async durability worker has replicated/backed up
+        every put sealed so far (queue drained AND the in-flight item
+        finished).  Chaos tests call this before firing a seeded node
+        kill so "the replica exists" is a guarantee, not a race — the
+        deterministic-counters contract of the node-loss gates.  Returns
+        False on timeout; True immediately when durability is off.
+        Best-effort for remote-node replica targets (their store_pull ack
+        is asynchronous); copies into head-colocated stores — what the
+        tier-1 gates assert on — are synchronous and fully covered."""
+        import time as _time
+
+        if self._durability_q is None:
+            return True
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if self._durability_pending <= 0:
+                    return True
+            _time.sleep(0.01)
+        return False
 
     @staticmethod
     def _read_store_bytes(store) -> "Callable[[ObjectID], tuple]":
@@ -2262,21 +2309,25 @@ class Head:
 
         return read
 
-    def _replicate_one(self, oid: ObjectID, k: int):
+    def _replicate_one(self, oid: ObjectID, k: int) -> bool:
         """Bring a put up to K holder locations: copy its bytes into
         surviving stores (direct store-to-store for in-process raylets,
-        agent-side pulls for remote nodes).  Best-effort and async — a
-        node dying mid-replication just leaves fewer copies."""
+        agent-side pulls for remote nodes).  Async — a node dying
+        mid-replication just leaves fewer copies.  Returns False on
+        TRANSIENT failures (source not readable yet — e.g. the pull
+        raced the agent's async store_adopt — or a target store error)
+        so the durability loop retries instead of silently leaving the
+        put with no second copy; True when done or permanently moot."""
         from ray_tpu._private.recovery import note
 
         with self._lock:
             entry = self.gcs.object_lookup(oid)
             if entry is None or entry.inline is not None or entry.lost:
-                return
+                return True
             have = set(entry.locations)
             need = k - len(have)
             if need <= 0:
-                return
+                return True
             size = entry.size
             # Source preference: a local store (zero-copy read) over a
             # remote pull.
@@ -2295,7 +2346,7 @@ class Head:
                         src_nid, src_addr = nid, addr
                         break
                 if src_addr is None:
-                    return  # no readable source
+                    return False  # no readable source (yet) — retry
             # Targets: local stores first (replicas there survive any
             # agent death and cost no network), then remote agents.
             local_t, remote_t = [], []
@@ -2316,18 +2367,22 @@ class Head:
                 try:
                     meta, data = self._repl_pull(src_addr, oid)
                 except Exception:
-                    return
+                    # Usually the seal→store_adopt race on the agent: the
+                    # object exists but its store can't serve it yet.
+                    return False
         finally:
             if src_raylet is not None:
                 src_raylet.store.unpin(oid)
         if data is None:
-            return
+            return False
+        target_errors = 0
         for nid, raylet in local_t:
             if need <= 0:
                 break
             try:
                 seg = raylet.store.put_replica(oid, meta, data)
             except Exception:
+                target_errors += 1
                 continue  # store full/racing shutdown: try the next node
             with self._lock:
                 if nid not in self.raylets:
@@ -2342,7 +2397,7 @@ class Head:
             pull_addr = self.node_xfer.get(src_nid) if src_addr is None \
                 else src_addr
             if pull_addr is None:
-                return
+                return False
             for nid, raylet in remote_t:
                 if need <= 0:
                     break
@@ -2351,6 +2406,9 @@ class Head:
                                    "addr": list(pull_addr),
                                    "size": size, "meta": meta})
                 need -= 1
+        # Fewer holder nodes than K is a permanent topology fact (best
+        # effort, True); an erroring target store is worth another try.
+        return not (need > 0 and target_errors > 0)
 
     def _repl_pull(self, addr, oid: ObjectID):
         if self._repl_client is None:

@@ -71,6 +71,17 @@ class AlgorithmConfig:
         # None = legacy single-device jit; an int (1 is valid) compiles
         # the SPMD program over that many devices.
         self.num_devices: Optional[int] = None
+        # ZeRO-style update sharding over the data mesh (arxiv 2004.13336;
+        # ray_tpu.parallel.zero): "off" replicates the optimizer state on
+        # every device, "opt" shards it 1/N (grads still all-reduced),
+        # "opt+grads" also reduce-scatters the gradients.  Requires
+        # num_devices (the SPMD path).
+        self.zero_sharding: str = "off"
+        # Gradient-reduction wire format (EQuARX, arxiv 2506.17615;
+        # ray_tpu.ops.collectives): "off" = fp32 psum, "int8" =
+        # block-scaled int8 (~4x fewer bytes, loss-parity gated in
+        # tests/test_zero.py).  Requires num_devices.
+        self.quantized_collectives: str = "off"
 
     # ---- fluent sections ----
     def environment(self, env=None, env_config: Optional[dict] = None):
@@ -179,9 +190,21 @@ class AlgorithmConfig:
                              "of user models belong in user space")
         return self
 
-    def resources(self, num_devices: Optional[int] = None, **kw):
+    def resources(self, num_devices: Optional[int] = None,
+                  zero_sharding: Optional[str] = None,
+                  quantized_collectives: Optional[str] = None, **kw):
         if num_devices is not None:
             self.num_devices = num_devices
+        if zero_sharding is not None:
+            if zero_sharding not in ("off", "opt", "opt+grads"):
+                raise ValueError(f"zero_sharding must be off|opt|opt+grads, "
+                                 f"got {zero_sharding!r}")
+            self.zero_sharding = zero_sharding
+        if quantized_collectives is not None:
+            if quantized_collectives not in ("off", "int8"):
+                raise ValueError(f"quantized_collectives must be off|int8, "
+                                 f"got {quantized_collectives!r}")
+            self.quantized_collectives = quantized_collectives
         return self
 
     def debugging(self, seed: Optional[int] = None, **kw):

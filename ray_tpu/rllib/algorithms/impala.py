@@ -84,29 +84,34 @@ class IMPALA(Algorithm):
             else config.env
         spec = RLModuleSpec.for_env(env, tuple(config.hiddens))
         module = self.module = spec.build()
-        tx = optax.chain(
-            optax.clip_by_global_norm(config.grad_clip or 1e9),
-            optax.adam(config.lr))
         N, T = config.num_envs, config.unroll_length
         loss_fn = self._make_loss()
 
         # Data-parallel mesh (same SPMD shape as PPO's: envs sharded on
         # the `data` axis, grads pmean'd — see ppo.make_anakin_ppo).
         D, sharded, mesh = mesh_util.setup_data_mesh(config, N)
+        # Shared gradient-application plan: classic pmean, int8
+        # collectives, or the ZeRO-sharded update — one recipe with PPO.
+        params_tmpl = jax.eval_shape(module.init, jax.random.PRNGKey(0),
+                                     jnp.asarray(spec.example_obs()))
+        update_fn, opt_init, opt_specs = mesh_util.build_update_plan(
+            config, config.lr, config.grad_clip or 1e9, params_tmpl, D,
+            sharded)
+        state_specs = ppo_mod.anakin_state_specs(opt_specs)
 
         def _init(seed):
             rng = jax.random.PRNGKey(seed)
             rng, k_init, k_env = jax.random.split(rng, 3)
             env_states, obs = vector_reset(env, k_env, N)
             params = module.init(k_init, obs)
-            return ppo_mod.AnakinState(params, tx.init(params), env_states,
+            return ppo_mod.AnakinState(params, opt_init(params), env_states,
                                        obs, mesh_util.split_rng(rng, D, sharded),
                                        jnp.zeros(N), jnp.zeros(()),
                                        jnp.zeros(()))
 
         if sharded:
             init_fn = jax.jit(_init, out_shardings=mesh_util.state_sharding(
-                mesh, ppo_mod.anakin_state_specs()))
+                mesh, state_specs))
         else:
             init_fn = _init
 
@@ -138,11 +143,9 @@ class IMPALA(Algorithm):
                      "last_value": last_value}
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, module, batch)
-            grads = mesh_util.pmean_if(grads, sharded)
             loss = mesh_util.pmean_if(loss, sharded)
             aux = mesh_util.pmean_if(aux, sharded)
-            updates, opt_state = tx.update(grads, state.opt_state, params)
-            params = optax.apply_updates(params, updates)
+            params, opt_state = update_fn(grads, state.opt_state, params)
             new_state = ppo_mod.AnakinState(
                 params, opt_state, env_states, obs,
                 mesh_util.wrap_rng(rng, sharded), ep_ret, dsum, dcnt)
@@ -151,9 +154,12 @@ class IMPALA(Algorithm):
             return new_state, metrics
 
         self._anakin_state = init_fn(config.seed)
-        if sharded:
+        if sharded and config.zero_sharding != "off":
+            self._train_step = mesh_util.zero_train_step(
+                train_step, mesh, state_specs)
+        elif sharded:
             self._train_step = mesh_util.shard_train_step(
-                train_step, mesh, ppo_mod.anakin_state_specs())
+                train_step, mesh, state_specs)
         else:
             self._train_step = jax.jit(train_step)
         self._steps_per_iter = N * T

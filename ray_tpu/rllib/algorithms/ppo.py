@@ -68,7 +68,8 @@ def ppo_loss(params, module, batch, *, clip_param, vf_clip_param,
 
 
 def run_ppo_sgd(params, opt_state, rng, loss_fn, make_mb, total, mb_size,
-                num_mb, num_sgd_iter, tx, sharded: bool = False):
+                num_mb, num_sgd_iter, tx, sharded: bool = False,
+                update_fn=None):
     """The shared permute→minibatch→update scaffolding for every PPO
     variant (feedforward, recurrent, attention): `make_mb(idx)` maps an
     index vector over `total` items (steps or env sequences) to a loss
@@ -79,8 +80,19 @@ def run_ppo_sgd(params, opt_state, rng, loss_fn, make_mb, total, mb_size,
     `data` mesh axis: `total`/`mb_size` are per-device, each device
     permutes its own shard, and the gradient (plus loss metrics) is
     pmean'd across the axis before the optimizer update — params stay
-    replicated because every device applies the identical update."""
+    replicated because every device applies the identical update.
+
+    `update_fn(grads, opt_state, params) -> (params, opt_state)` swaps
+    the reduce+apply half (the ZeRO / int8-collective plans from
+    mesh.build_update_plan); it receives the RAW local grads and owns the
+    cross-replica reduction.  None keeps the classic pmean + tx.update."""
     from ray_tpu.rllib.utils.mesh import pmean_if
+
+    if update_fn is None:
+        def update_fn(grads, opt_state, params):
+            updates, opt_state = tx.update(pmean_if(grads, sharded),
+                                           opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
 
     def sgd_epoch(carry, _):
         params, opt_state, rng = carry
@@ -91,11 +103,9 @@ def run_ppo_sgd(params, opt_state, rng, loss_fn, make_mb, total, mb_size,
             params, opt_state = carry
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, make_mb(idx))
-            grads = pmean_if(grads, sharded)
             loss = pmean_if(loss, sharded)
             aux = pmean_if(aux, sharded)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            params, opt_state = update_fn(grads, opt_state, params)
             return (params, opt_state), (loss, aux)
 
         idxs = perm[: num_mb * mb_size].reshape(num_mb, mb_size)
@@ -120,15 +130,19 @@ class AnakinState(NamedTuple):
     done_count: jax.Array
 
 
-def anakin_state_specs():
+def anakin_state_specs(opt_specs=None):
     """PartitionSpec prefix for AnakinState on the `data` mesh: params +
     optimizer replicated, env batch (states/obs/rng/returns) sharded on
-    the axis, episode counters replicated (psum'd deltas)."""
+    the axis, episode counters replicated (psum'd deltas).
+
+    `opt_specs` overrides the optimizer subtree — the ZeRO plane passes
+    `ZeroSharder.opt_specs` so each replica carries a 1/N state block."""
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.rllib.utils.mesh import DATA_AXIS
 
-    return AnakinState(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+    return AnakinState(P(), opt_specs if opt_specs is not None else P(),
+                       P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                        P(DATA_AXIS), P(), P())
 
 
@@ -148,11 +162,6 @@ def make_anakin_ppo(config: AlgorithmConfig):
     obs_shape = getattr(env, "obs_shape", None)
     spec = RLModuleSpec.for_env(env, tuple(config.hiddens))
     module = spec.build()
-    tx_parts = []
-    if config.grad_clip:
-        tx_parts.append(optax.clip_by_global_norm(config.grad_clip))
-    tx_parts.append(optax.adam(config.lr))
-    tx = optax.chain(*tx_parts)
 
     N, T = config.num_envs, config.unroll_length
     batch_total = N * T
@@ -169,17 +178,25 @@ def make_anakin_ppo(config: AlgorithmConfig):
         N_loc, mb_loc = N, mb_size
     batch_loc = N_loc * T
 
+    # The gradient-application plan (pmean / int8 collectives / ZeRO) —
+    # shapes only, so the sharder is built before any init compiles.
+    params_tmpl = jax.eval_shape(module.init, jax.random.PRNGKey(0),
+                                 jnp.asarray(spec.example_obs()))
+    update_fn, opt_init, opt_specs = mesh_util.build_update_plan(
+        config, config.lr, config.grad_clip, params_tmpl, D, sharded)
+    state_specs = anakin_state_specs(opt_specs)
+
     def _init(seed) -> AnakinState:
         rng = jax.random.PRNGKey(seed)
         rng, k_init, k_env = jax.random.split(rng, 3)
         env_states, obs = vector_reset(env, k_env, N)
         params = module.init(k_init, obs)
-        return AnakinState(params, tx.init(params), env_states, obs,
+        return AnakinState(params, opt_init(params), env_states, obs,
                            mesh_util.split_rng(rng, D, sharded),
                            jnp.zeros(N), jnp.zeros(()), jnp.zeros(()))
 
     if sharded:
-        out_sh = mesh_util.state_sharding(mesh, anakin_state_specs())
+        out_sh = mesh_util.state_sharding(mesh, state_specs)
         init_fn = jax.jit(_init, out_shardings=out_sh)
     else:
         init_fn = _init
@@ -236,8 +253,8 @@ def make_anakin_ppo(config: AlgorithmConfig):
             params, state.opt_state, rng,
             lambda p, mb: loss_fn(p, module, mb),
             lambda idx: {k_: v[idx] for k_, v in flat.items()},
-            batch_loc, mb_loc, num_mb, config.num_sgd_iter, tx,
-            sharded=sharded)
+            batch_loc, mb_loc, num_mb, config.num_sgd_iter, None,
+            sharded=sharded, update_fn=update_fn)
 
         new_state = AnakinState(params, opt_state, env_states, obs,
                                 mesh_util.wrap_rng(rng, sharded),
@@ -255,9 +272,10 @@ def make_anakin_ppo(config: AlgorithmConfig):
     # No donate_argnums: freshly-inited zero leaves (opt mu/nu, counters) can
     # share deduped buffers, which XLA rejects as double-donation.  The state
     # here is tiny; donation pays off in the LM train step, not this one.
-    if sharded:
-        step = mesh_util.shard_train_step(train_step, mesh,
-                                          anakin_state_specs())
+    if sharded and config.zero_sharding != "off":
+        step = mesh_util.zero_train_step(train_step, mesh, state_specs)
+    elif sharded:
+        step = mesh_util.shard_train_step(train_step, mesh, state_specs)
     else:
         step = jax.jit(train_step)
     return module, init_fn, step, batch_total

@@ -86,6 +86,85 @@ def shard_train_step(step_fn, mesh: Mesh, state_specs, donate: bool = False):
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+def zero_train_step(step_fn, mesh: Mesh, state_specs, donate: bool = False):
+    """`shard_train_step` for a ZeRO-sharded state (drop-in; see
+    ray_tpu.parallel.zero).
+
+    Identical compilation contract — the difference is carried by
+    `state_specs`: the optimizer-state subtree is the per-leaf spec pytree
+    from `ZeroSharder.opt_specs` (``[world, chunk]`` leaves P(data),
+    scalars replicated) instead of a blanket P(), so each replica's state
+    block is 1/N and the step body's reduce-scatter/all-gather pair (built
+    by `zero.make_update_fn`) is the only cross-replica traffic."""
+    return shard_train_step(step_fn, mesh, state_specs, donate=donate)
+
+
+def build_update_plan(config, lr, grad_clip, params_template, D, sharded):
+    """The gradient-application recipe every anakin algorithm shares,
+    resolved from ``config.zero_sharding`` / ``config.quantized_collectives``
+    — one copy so PPO and IMPALA cannot drift.
+
+    Returns ``(update_fn, opt_init, opt_specs)``:
+    ``update_fn(grads, opt_state, params) -> (params, opt_state)`` runs
+    INSIDE the shard_map body (grads are the local, un-reduced values);
+    ``opt_init(params)`` builds the (possibly globally sharded) optimizer
+    state; ``opt_specs`` is its PartitionSpec pytree (a bare ``P()`` on the
+    replicated paths).
+
+    - default: ``pmean`` grads + replicated optax update (today's math),
+    - ``quantized_collectives=int8``: the block-scaled int8 all-reduce
+      from ``ray_tpu.ops.collectives`` in place of the fp32 pmean,
+    - ``zero_sharding=opt|opt+grads``: the ZeRO plane from
+      ``ray_tpu.parallel.zero`` — 1/N optimizer state per replica,
+      reduce-scattered grads, all-gathered fresh params (grad_clip maps
+      to ``zero_clip_by_global_norm`` so the clip stays exactly global).
+
+    Both knobs require the SPMD path: without ``num_devices`` there is no
+    mesh axis to shard or quantize over, and silently ignoring the
+    request is the worst failure — so it raises."""
+    import optax
+
+    zero_mode = getattr(config, "zero_sharding", "off") or "off"
+    quant = getattr(config, "quantized_collectives", "off") or "off"
+    if zero_mode not in ("off", "opt", "opt+grads"):
+        raise ValueError(f"zero_sharding must be off|opt|opt+grads, "
+                         f"got {zero_mode!r}")
+    if quant not in ("off", "int8"):
+        raise ValueError(f"quantized_collectives must be off|int8, "
+                         f"got {quant!r}")
+    if (zero_mode != "off" or quant != "off") and not sharded:
+        raise ValueError(
+            "zero_sharding/quantized_collectives require the SPMD path: "
+            "set resources(num_devices=...) (1 is valid)")
+
+    if zero_mode == "off":
+        parts = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
+        tx = optax.chain(*parts, optax.adam(lr))
+        if quant == "int8":
+            from ray_tpu.ops import collectives
+
+            def update_fn(grads, opt_state, params):
+                grads = collectives.quantized_pmean(grads, DATA_AXIS, D)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
+        else:
+            def update_fn(grads, opt_state, params):
+                grads = pmean_if(grads, sharded)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
+        return update_fn, tx.init, P()
+
+    from ray_tpu.parallel import zero as zero_mod
+
+    zparts = [zero_mod.zero_clip_by_global_norm(grad_clip, DATA_AXIS)] \
+        if grad_clip else []
+    tx = optax.chain(*zparts, optax.adam(lr))
+    zu = zero_mod.build_zero_update(params_template, tx, D,
+                                    zero_sharding=zero_mode,
+                                    quantized=quant, axis_name=DATA_AXIS)
+    return zu.update, zu.init_opt, zu.opt_specs
+
+
 def resolve_num_devices(config_num_devices: Optional[int]) -> Optional[int]:
     """None → legacy jit path; int → SPMD path.  Validates only; if the
     count exceeds the visible devices, data_mesh raises at build time."""
@@ -120,6 +199,11 @@ def reject_data_mesh(config, path: str) -> None:
             f"resources(num_devices=...) is not implemented for {path}; "
             "the data-parallel anakin step currently covers feedforward "
             "PPO and IMPALA/APPO")
+    if getattr(config, "zero_sharding", "off") != "off" or \
+            getattr(config, "quantized_collectives", "off") != "off":
+        raise NotImplementedError(
+            f"zero_sharding/quantized_collectives are not implemented for "
+            f"{path}; they ride the shard_map data-parallel step")
 
 
 def split_rng(rng, D: Optional[int], sharded: bool):

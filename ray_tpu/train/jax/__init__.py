@@ -4,6 +4,7 @@ from ray_tpu.train.jax.config import JaxConfig  # noqa: F401
 from ray_tpu.train.jax.train_loop_utils import (  # noqa: F401
     AsyncMetrics,
     compile_donated_step,
+    compile_zero_step,
     get_mesh,
     prepare_batch,
     prepare_device_iterator,

@@ -92,6 +92,66 @@ class AsyncMetrics:
         return self.last
 
 
+def compile_zero_step(grad_fn, tx, params, mesh=None, *,
+                      zero_sharding: str = "opt+grads",
+                      quantized_collectives: str = "off",
+                      should_shard=None, donate: bool = True):
+    """Build a ZeRO data-parallel train step for the Train JAX loop
+    (arxiv 2004.13336 + EQuARX int8 collectives; see
+    ray_tpu.parallel.zero and docs/PERFORMANCE.md).
+
+    ``grad_fn(params, batch) -> (loss, grads)`` on a LOCAL batch shard
+    (e.g. ``jax.value_and_grad`` of the model loss).  Returns
+    ``(step, opt_state, info)`` where ``step(params, opt_state, batch) ->
+    (params, opt_state, loss)`` is one jitted shard_map program over the
+    mesh's ``data`` axis: batch sharded, params replicated, optimizer
+    state sharded 1/N per replica, gradients reduce-scattered (int8 when
+    ``quantized_collectives="int8"``), fresh params all-gathered, loss
+    pmean'd.  ``opt_state`` is the globally-sharded initial state
+    (already placed); ``info`` is the memory/wire envelope
+    (``zero_opt_bytes_per_replica``, ``grad_comm_bytes``, ...).
+
+    ``tx`` must be elementwise (adam/adamw/sgd/...); for gradient-norm
+    clipping chain ``zero.zero_clip_by_global_norm`` instead of
+    ``optax.clip_by_global_norm`` — the shard-local norm would otherwise
+    be wrong.  The carry is donated by default (in-place weight update,
+    same contract as ``compile_donated_step``)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel import zero as zero_mod
+    from ray_tpu.rllib.utils.mesh import _shard_map
+
+    if mesh is None:
+        mesh = get_mesh()
+    axis = zero_mod.DATA_AXIS
+    world = dict(mesh.shape).get(axis, 1)
+    zu = zero_mod.build_zero_update(
+        jax.eval_shape(lambda: params), tx, world,
+        zero_sharding=zero_sharding, quantized=quantized_collectives,
+        axis_name=axis, should_shard=should_shard)
+    info = zero_mod.export_zero_metrics(
+        zu.sharder, tx, zero_sharding=zero_sharding,
+        quantized=quantized_collectives)
+
+    def body(params, opt_block, batch):
+        loss, grads = grad_fn(params, batch)
+        loss = jax.lax.pmean(loss, axis) if world > 1 else loss
+        params, opt_block = zu.update(grads, opt_block, params)
+        return params, opt_block, loss
+
+    mapped = _shard_map(body, mesh=mesh,
+                        in_specs=(P(), zu.opt_specs, P(axis)),
+                        out_specs=(P(), zu.opt_specs, P()))
+    step = jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+    opt_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), zu.opt_specs,
+        is_leaf=lambda s: isinstance(s, P))
+    opt_state = jax.jit(zu.init_opt, out_shardings=opt_sh)(params)
+    return step, opt_state, info
+
+
 def prepare_device_iterator(host_batches, mesh=None, sharding=None,
                             prefetch: int = 2):
     """Wrap any host-batch iterable in the background device prefetcher,

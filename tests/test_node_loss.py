@@ -302,6 +302,20 @@ def test_training_survives_node_agent_sigkill(replicate2):
             # long-lived put has its replica (the async durability
             # window is otherwise covered by put reconstruction).
             ray_tpu.wait([window[-1][1]], num_returns=1, timeout=60)
+            # Every outstanding version put must be SEALED before the
+            # quiesce below, or its durability work hasn't been queued
+            # yet and the kill can still outrace the replica (the
+            # ~1-2/12 flake: 'lost with its node ... no lineage,
+            # replica, or spill copy').  Waiting on the outer results is
+            # enough — the nested put's seal rides the creator's conn
+            # BEFORE its task_done.
+            ray_tpu.wait(list(version_puts.values()),
+                         num_returns=len(version_puts), timeout=90)
+            # At-least-one-replica-acked gate: drain the async durability
+            # worker so every sealed put has its second copy before the
+            # kill site fires — recovery counters become deterministic.
+            assert head.durability_quiesce(timeout=60), \
+                "durability worker did not quiesce before the kill"
 
             def keep_replicated():
                 with head._lock:

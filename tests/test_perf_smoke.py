@@ -12,6 +12,7 @@ from tools.perf_smoke import (
     run_rpc_chaos_smoke,
     run_serving_smoke,
     run_smoke,
+    run_zero_smoke,
 )
 
 
@@ -84,6 +85,21 @@ def test_serving_smoke():
     assert out["admitted_mid_batch"] >= 1, f"batch drained to admit: {out}"
     assert out["decode_cache_size"] == 1, f"decode step recompiled: {out}"
     assert out["pages_leaked"] == 0, out
+    assert out["ok"], out
+
+
+def test_zero_smoke(shutdown_only):
+    """The ZeRO+int8 train step must hold 1/N optimizer bytes per
+    replica, ride the step pipeline with zero extra driver syncs (and
+    the overlap invariant intact), and never recompile across steps —
+    the tier-1 guard for ISSUE 9's memory/bandwidth-efficient data
+    parallelism."""
+    out = run_zero_smoke()
+    assert out["results_ok"], out
+    assert out["driver_syncs"] == 0, out
+    assert out["overlap_ok"], f"ZeRO step reintroduced lockstep: {out}"
+    assert out["opt_bytes_ok"], f"opt-state bytes not 1/N: {out}"
+    assert out["no_recompile"], f"ZeRO step recompiled: {out}"
     assert out["ok"], out
 
 

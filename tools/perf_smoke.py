@@ -459,10 +459,12 @@ def run_node_loss_smoke(steps: int = 8, kill_at: int = 3) -> dict:
                 # real reconstructions, not in-flight retries only.
                 ray_tpu.wait(out_refs, num_returns=len(out_refs),
                              timeout=60)
-                deadline = _time.monotonic() + 20
-                while _time.monotonic() < deadline and \
-                        recovery_stats()["objects_replicated"] < step:
-                    _time.sleep(0.1)
+                ray_tpu.wait(put_refs, num_returns=len(put_refs),
+                             timeout=60)
+                # At-least-one-replica-acked before the kill (same gate
+                # as the node-agent chaos test): the async durability
+                # worker must drain, not merely have started.
+                assert head.durability_quiesce(timeout=30)
                 head.kill_node(node2)
                 killed = True
             put_refs.append(
@@ -502,6 +504,120 @@ def run_node_loss_smoke(steps: int = 8, kill_at: int = 3) -> dict:
     finally:
         ray_tpu.shutdown()
         CONFIG.reset()
+
+
+def _zero_step(state, step_i):
+    """Worker-side ZeRO train step (built lazily on a 4-way virtual data
+    mesh inside the MeshGroup worker): one compiled shard_map program per
+    process, re-dispatched per pipeline step.  Returns the jit cache size
+    so the driver can assert the step never recompiles across
+    admissions of new step indices."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    if "step" not in state:
+        from ray_tpu.rllib.utils.mesh import data_mesh
+        from ray_tpu.train.jax import compile_zero_step
+
+        world = min(4, len(jax.devices()))
+        mesh = data_mesh(world)
+        key = jax.random.PRNGKey(0)
+        params = {"w1": jax.random.normal(key, (64, 33)),
+                  "b1": jnp.zeros((33,)),
+                  "w2": jax.random.normal(key, (33, 1))}
+        tx = optax.adam(1e-2)
+
+        def grad_fn(p, batch):
+            def loss(p):
+                h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+                return jnp.mean((h @ p["w2"] - batch["y"]) ** 2)
+
+            return jax.value_and_grad(loss)(p)
+
+        step, opt, info = compile_zero_step(
+            grad_fn, tx, params, mesh, zero_sharding="opt+grads",
+            quantized_collectives="int8", donate=False)
+        x = jax.random.normal(key, (8 * world, 64))
+        state.update(step_fn=step, params=params, opt=opt, info=info,
+                     batch={"x": x, "y": jnp.sum(x, 1, keepdims=True)},
+                     world=world)
+    state["params"], state["opt"], loss = state["step_fn"](
+        state["params"], state["opt"], state["batch"])
+    return {"cache_size": int(state["step_fn"]._cache_size()),
+            "world": state["world"],
+            "zero_opt_bytes": state["info"]["zero_opt_bytes_per_replica"],
+            "replicated_opt_bytes": state["info"]["replicated_opt_bytes"]}
+
+
+def run_zero_smoke(steps: int = STEPS, depth: int = DEPTH) -> dict:
+    """ZeRO update-plane invariants (tier-1 guard for ISSUE 9):
+
+    1. **1/N optimizer memory**: the per-replica optimizer-state bytes of
+       the sharded plan are <= 1/world + remainder slack of the
+       replicated baseline (exact accounting, no timing).
+    2. **Rides the pipeline with zero extra driver syncs**: driving the
+       ZeRO+int8 step through MeshGroup.pipeline keeps
+       driver_sync_count() flat and preserves the dispatch-before-drain
+       overlap — sharding the update must not reintroduce lockstep.
+    3. **No recompiles**: the compiled step's jit cache size stays 1
+       across all steps (fresh shapes/layouts would silently multiply
+       compile time at scale).
+    """
+    import ray_tpu
+    from ray_tpu._private import profiling
+    from ray_tpu.parallel import MeshGroup, mesh_group
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    mg = MeshGroup(num_hosts=1, platform="cpu", local_device_count=4,
+                   pipeline_depth=depth)
+    try:
+        profiling.clear_recorded_spans()
+        syncs_before = mesh_group.driver_sync_count()
+        with mg.pipeline(depth=depth, metrics_interval=1) as pipe:
+            for i in range(steps):
+                pipe.submit(_zero_step, i)
+            results = pipe.flush()
+        syncs = mesh_group.driver_sync_count() - syncs_before
+
+        dispatch = {s["args"]["step"]: s
+                    for s in profiling.recorded_spans("pipeline_dispatch")}
+        drain = {s["args"]["step"]: s
+                 for s in profiling.recorded_spans("pipeline_drain")}
+        violations = [
+            n for n in range(steps - depth)
+            if not (n + 1 in dispatch and
+                    dispatch[n + 1]["start"] < drain[n]["start"])
+        ]
+        # Pipeline results are (step_idx, [per-rank metrics]) pairs.
+        per_step = [res[0] if isinstance(res, (list, tuple)) else res
+                    for _, res in results]
+        last = per_step[-1]
+        world = last["world"]
+        ratio = (last["zero_opt_bytes"]
+                 / max(1, last["replicated_opt_bytes"]))
+        out = {
+            "steps": steps,
+            "depth": depth,
+            "world": world,
+            "results_ok": len(results) == steps,
+            "driver_syncs": syncs,
+            "overlap_violations": violations,
+            "overlap_ok": not violations,
+            "opt_bytes_ratio": round(ratio, 4),
+            # 1/N + remainder/replicated-scalar slack
+            "opt_bytes_ok": ratio <= 1.0 / world + 0.05,
+            "cache_sizes": sorted({r["cache_size"] for r in per_step}),
+            "no_recompile": all(r["cache_size"] == 1 for r in per_step),
+        }
+        out["ok"] = bool(out["results_ok"] and out["overlap_ok"]
+                         and syncs == 0 and out["opt_bytes_ok"]
+                         and out["no_recompile"])
+        return out
+    finally:
+        mg.shutdown()
+        ray_tpu.shutdown()
 
 
 def run_serving_smoke(max_new: int = 10) -> dict:
@@ -582,8 +698,10 @@ def main() -> int:
     out["node_loss"] = nl
     sv = run_serving_smoke()
     out["serving"] = sv
+    zr = run_zero_smoke()
+    out["zero"] = zr
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
-                     and rpc["ok"] and nl["ok"] and sv["ok"])
+                     and rpc["ok"] and nl["ok"] and sv["ok"] and zr["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
