@@ -292,6 +292,114 @@ def bench_gpt2() -> dict:
         rt.shutdown()
 
 
+def bench_gpt2_pipeline() -> dict:
+    """MPMD pipeline bench (ISSUE 10 acceptance): GPT-2 split across 2
+    compiled stage processes driven by the async 1F1B schedule, vs the
+    SAME model/machinery in one stage — reports both MFUs (per chip), the
+    ratio (acceptance: >= 0.8 at 2 stages), measured bubble fraction,
+    activation GB/s through the object store, and proof of zero
+    steady-state driver syncs.
+
+    Model size adapts to the box: a TPU-class device runs GPT-2-small at
+    1k ctx (RTPU_BENCH_PIPELINE_FULL=1 forces it anywhere); the CPU dev
+    box runs a width/depth-scaled config so the bench finishes in
+    minutes — both legs always measure the SAME config on the SAME
+    platform, so the ratio stays apples-to-apples."""
+    import numpy as np
+
+    import ray_tpu
+
+    out: dict = {}
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    try:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.gpt2 import GPT2Config, split_stages
+        from ray_tpu.parallel import mpmd_pipeline as mp
+
+        kind = jax.devices()[0].device_kind
+        full = os.environ.get("RTPU_BENCH_PIPELINE_FULL") == "1" or \
+            "cpu" not in kind.lower()
+        if full:
+            cfg = GPT2Config.gpt2_small(dtype=jnp.float32)
+            B, S, M, iters = 16, 1024, 8, 8
+        else:
+            cfg = GPT2Config(vocab_size=4096, max_position_embeddings=512,
+                             num_layers=4, num_heads=4, hidden_size=256,
+                             dtype=jnp.float32)
+            B, S, M, iters = 16, 256, 8, 6
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+        tx = optax.adamw(3e-4)
+
+        def run_leg(num_stages, microbatches):
+            stage_fns, init_fns = split_stages(cfg, num_stages)
+            pipe = mp.MPMDPipeline(
+                stage_fns, init_fns, optimizer=tx,
+                num_microbatches=microbatches, step_window=2,
+                drain_timeout=1200.0)
+            pipe.train_step(ids, ids)  # compile + warmup
+            syncs0 = mp.mpmd_driver_sync_count()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                pipe.submit_step(ids, ids)
+            losses = pipe.flush()
+            dt = time.perf_counter() - t0
+            syncs = mp.mpmd_driver_sync_count() - syncs0
+            stats = pipe.stats()
+            pipe.stop()
+            return {
+                "tokens_per_s": iters * B * S / dt,
+                "loss": losses[-1][1],
+                "driver_syncs": syncs,
+                "bubble_fraction": stats["bubble_fraction"],
+                "act_gb_per_s": stats["act_gb_per_s"],
+                "jit_cache": stats["jit_cache"],
+            }
+
+        single = run_leg(1, 1)
+        pipe2 = run_leg(2, M)
+
+        n_params = cfg.num_layers * 12 * cfg.hidden_size ** 2 \
+            + 2 * cfg.vocab_size * cfg.hidden_size \
+            + cfg.max_position_embeddings * cfg.hidden_size
+        fpt = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * S
+        peak = peak_flops_for(kind)
+        mfu_single = single["tokens_per_s"] * fpt / peak
+        mfu_pipe = pipe2["tokens_per_s"] * fpt / (2 * peak)
+        out.update({
+            "pipeline_model": "gpt2_small" if full else "gpt2_scaled_cpu",
+            "pipeline_ctx": S,
+            "pipeline_batch": B,
+            "pipeline_microbatches": M,
+            "pipeline_num_stages": 2,
+            "pipeline_tokens_per_s": round(pipe2["tokens_per_s"]),
+            "pipeline_single_tokens_per_s": round(single["tokens_per_s"]),
+            "pipeline_mfu": round(mfu_pipe, 4),
+            "pipeline_single_mfu": round(mfu_single, 4),
+            # The acceptance ratio: per-chip pipeline MFU over the
+            # single-stage run's (>= 0.8 gate on the TPU dev box).
+            "pipeline_mfu_ratio": round(mfu_pipe / mfu_single, 3),
+            "pipeline_bubble_fraction": round(
+                pipe2["bubble_fraction"] or 0.0, 4),
+            "pipeline_act_gb_per_s": round(pipe2["act_gb_per_s"], 3),
+            "pipeline_driver_syncs_steady": pipe2["driver_syncs"],
+            # Absolute losses (stage init seeds differ between the legs,
+            # so these track learning sanity, not bitwise parity — the
+            # multichip dryrun's pipeline leg asserts real parity).
+            "pipeline_loss": round(float(pipe2["loss"]), 4),
+            "pipeline_single_loss": round(float(single["loss"]), 4),
+        })
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        out["pipeline_error"] = f"{type(e).__name__}: {e}"
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def bench_serving() -> dict:
     """Continuous-batching inference bench (ISSUE 8 acceptance): N
     simulated concurrent users stream requests of mixed prompt lengths at
@@ -662,6 +770,7 @@ def bench_impala_breakout() -> dict:
 
 def main():
     out = bench_gpt2()
+    out.update(bench_gpt2_pipeline())
     out.update(bench_serving())
     out.update(bench_ppo_real_env())
     out.update(bench_impala_breakout())

@@ -24,7 +24,13 @@ def _build() -> Optional[ctypes.CDLL]:
     global _build_failed
     with _build_lock:
         if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-            return ctypes.CDLL(_SO)
+            try:
+                return ctypes.CDLL(_SO)
+            except OSError:
+                # A stale binary built against a different glibc/toolchain
+                # (e.g. checked out on an older container) must not break
+                # the graceful fallback — rebuild from source below.
+                pass
         if _build_failed:
             return None
         try:

@@ -109,8 +109,15 @@ CONFIG = RayTpuConfig()
 # ---- the registry (one declaration per tunable; grep for CONFIG.<name>
 # to find the consumer) ----
 CONFIG \
-    .declare("native_store", bool, True,
-             "Use the C++ shared-memory arena for driver puts.") \
+    .declare("native_store", bool, False,
+             "Use the C++ shared-memory arena for driver puts.  Off by "
+             "default: the arena path predates the segment-pool + "
+             "batched-notify object plane (put_many coalescing, pooled "
+             "pre-faulted segments — the measured 7-8 GB/s path) and "
+             "bypasses both; opt in only until it learns those "
+             "semantics.  (It was also silently disabled for several "
+             "rounds by a stale libshm_store.so built against a newer "
+             "glibc — the loader now rebuilds from source instead.)") \
     .declare("worker_idle_ttl_s", float, 300.0,
              "Idle pooled workers are reaped after this long.") \
     .declare("max_workers_per_node", int, 64,

@@ -20,8 +20,16 @@ import threading
 from multiprocessing.connection import Client
 
 from ray_tpu._private.ids import JobID, NodeID, WorkerID
-from ray_tpu._private.task_spec import TaskSpec, TaskType
+from ray_tpu._private.task_spec import ArgKind, TaskSpec, TaskType
 from ray_tpu._private.worker import ConnTransport, CoreWorker, set_global_worker
+
+
+def _has_ref_args(spec: TaskSpec) -> bool:
+    """True when any task argument is an object ref — executing it may
+    block the main loop waiting on another task's (possibly buffered)
+    completion."""
+    return any(a.kind == ArgKind.REF
+               for a in list(spec.args) + list(spec.kwargs.values()))
 
 
 def main():
@@ -301,6 +309,14 @@ def main():
                 flush_done_buf()  # classic task may block for a long time
             run_one(spec, None)
         else:
+            if done_buf and _has_ref_args(spec):
+                # A task with ref args can BLOCK in arg resolution — and
+                # a completion still sitting in this worker's done buffer
+                # may be (transitively) the producer of one of those
+                # refs.  Holding it while blocking deadlocks any
+                # cross-actor dependency chain (the MPMD pipeline's 1F1B
+                # ref wiring hits this on every step): flush first.
+                flush_done_buf()
             try:
                 done = make_done(spec)
             except BaseException as e:  # noqa: BLE001 — reply must flow

@@ -1630,7 +1630,8 @@ class CoreWorker:
         return ready, not_ready
 
     # ---- task submission ----
-    def make_args(self, args: Sequence[Any], kwargs: Dict[str, Any]
+    def make_args(self, args: Sequence[Any], kwargs: Dict[str, Any],
+                  holds: Optional[list] = None
                   ) -> Tuple[List[TaskArg], Dict[str, TaskArg]]:
         def conv(v) -> TaskArg:
             if isinstance(v, ObjectRef):
@@ -1640,7 +1641,15 @@ class CoreWorker:
             if ser.packed_size(s) > INLINE_OBJECT_THRESHOLD:
                 # Large literal arg: promote to a put object, pass by ref
                 # (reference inlines <100KB, else plasma: dependency_resolver).
+                # The ObjectRef MUST outlive submission (callers stash
+                # `holds` on the result ref / actor handle): dropping it
+                # here lets the ref-gc drainer free the object in the
+                # window before the executing worker resolves it — the
+                # drop and the submit ride different threads, so conn
+                # ordering cannot save us.
                 ref = self.put(v)
+                if holds is not None:
+                    holds.append(ref)
                 return TaskArg(ArgKind.REF, ref=ref.id,
                                owner=ref._effective_owner())
             return TaskArg(ArgKind.VALUE, value=ser.pack(s),

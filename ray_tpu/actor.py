@@ -54,10 +54,16 @@ class ActorMethod:
 class ActorHandle:
     def __init__(self, actor_id: ActorID, method_names: List[str],
                  class_name: str = "Actor", max_task_retries: int = 0):
+        import collections
+
         self._actor_id = actor_id
         self._method_names = list(method_names)
         self._class_name = class_name
         self._max_task_retries = max_task_retries
+        # Driver-side pins for promoted large-literal args (creation args
+        # live for the handle's lifetime; zero-return calls keep a
+        # bounded window — see worker.make_args).
+        self._arg_holds: collections.deque = collections.deque(maxlen=32)
 
     @property
     def actor_id(self) -> ActorID:
@@ -74,8 +80,10 @@ class ActorHandle:
             return global_worker.call_actor(
                 self._actor_id, method_name, args, kwargs,
                 options.get("num_returns", 1))
+        holds: list = []
         if args or kwargs:
-            task_args, task_kwargs = global_worker.make_args(args, kwargs)
+            task_args, task_kwargs = global_worker.make_args(args, kwargs,
+                                                             holds=holds)
         else:
             task_args, task_kwargs = [], {}
         num_returns = options.get("num_returns", 1) if options else 1
@@ -95,7 +103,14 @@ class ActorHandle:
         )
         refs = global_worker.submit_actor_task(spec)
         if num_returns == 0:
+            if holds:
+                # No result ref to pin the promoted args to: park them on
+                # the handle (bounded) so they outlive the call window.
+                self._arg_holds.append(holds)
             return None
+        if holds:
+            for r in refs:
+                r._hold_args = holds
         return refs[0] if num_returns == 1 else refs
 
     def __getattr__(self, name: str) -> ActorMethod:
@@ -175,7 +190,9 @@ class ActorClass:
             actor_id = global_worker.create_actor(
                 self._cls, args, kwargs, name=opts.get("name"))
             return ActorHandle(actor_id, self._method_names, self.__name__)
-        task_args, task_kwargs = global_worker.make_args(args, kwargs)
+        holds: list = []
+        task_args, task_kwargs = global_worker.make_args(args, kwargs,
+                                                         holds=holds)
         actor_id = ActorID.of(global_worker.job_id)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -211,8 +228,14 @@ class ActorClass:
         spec.owner_worker_id = global_worker.worker_id
         spec.parent_task_id = global_worker.current_task_id()
         global_worker.transport.request("create_actor", {"spec": spec})
-        return ActorHandle(actor_id, self._method_names, self.__name__,
-                           max_task_retries=spec.max_task_retries)
+        handle = ActorHandle(actor_id, self._method_names, self.__name__,
+                             max_task_retries=spec.max_task_retries)
+        if holds:
+            # Creation args promoted to put objects stay pinned for the
+            # handle's lifetime: the creation task may execute (and even
+            # re-execute on actor restart) long after this returns.
+            handle._arg_holds.append(holds)
+        return handle
 
     def __call__(self, *a, **kw):
         raise TypeError(

@@ -6,7 +6,13 @@ brings to Train.  Here models are flax modules designed for pjit: static
 shapes, bfloat16-friendly, logical sharding annotations exposed per model
 via `param_logical_axes`.
 """
-from ray_tpu.models.gpt2 import GPT2, GPT2Config, gpt2_loss_fn  # noqa: F401
+from ray_tpu.models.gpt2 import (  # noqa: F401
+    GPT2,
+    GPT2Config,
+    GPT2Stage,
+    gpt2_loss_fn,
+    split_stages,
+)
 from ray_tpu.models.llama import Llama, LlamaConfig, llama_loss_fn  # noqa: F401
 from ray_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401
 from ray_tpu.models.mlp import MLP  # noqa: F401

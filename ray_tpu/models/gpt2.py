@@ -61,6 +61,10 @@ class GPT2Config:
         return cls(num_layers=24, num_heads=16, hidden_size=1024, **kw)
 
     @classmethod
+    def gpt2_xl(cls, **kw):  # 1.5B — the MPMD pipeline scale target
+        return cls(num_layers=48, num_heads=25, hidden_size=1600, **kw)
+
+    @classmethod
     def tiny(cls, **kw):  # test-sized
         kw.setdefault("vocab_size", 512)
         kw.setdefault("max_position_embeddings", 128)
@@ -195,6 +199,122 @@ def gpt2_loss_fn(params, apply_fn, batch) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+class GPT2Stage(nn.Module):
+    """One pipeline stage of a split GPT-2 (see :func:`split_stages`).
+
+    Stage 0 owns the embeddings (wte/wpe) and consumes token ids; middle
+    stages consume/produce hidden states; the last stage owns ln_f and
+    the LM head and produces logits.  The head is UNTIED from wte —
+    pipeline splitting puts them on different processes, and the
+    tied-embedding gradient exchange (Megatron's first↔last allreduce)
+    costs more than the head's extra parameters buy (documented in
+    docs/PERFORMANCE.md)."""
+
+    config: GPT2Config
+    first: bool
+    last: bool
+    blocks: tuple  # (start, stop) block index range owned by this stage
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        if self.first:
+            ids = x
+            _, l = ids.shape
+            wte = self.param("wte", nn.initializers.normal(0.02),
+                             (c.vocab_size, c.hidden_size), jnp.float32)
+            wpe = self.param("wpe", nn.initializers.normal(0.01),
+                             (c.max_position_embeddings, c.hidden_size),
+                             jnp.float32)
+            x = wte[ids].astype(c.dtype) + wpe[None, :l].astype(c.dtype)
+        else:
+            x = x.astype(c.dtype)
+        for i in range(*self.blocks):
+            x = Block(c, name=f"h_{i}")(x)
+        if self.last:
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+            head = self.param("lm_head", nn.initializers.normal(0.02),
+                              (c.vocab_size, c.hidden_size), jnp.float32)
+            logits = jnp.einsum("bld,vd->blv", x.astype(c.dtype),
+                                head.astype(c.dtype))
+            return logits.astype(jnp.float32)
+        return x
+
+
+def _stage_ce_loss(logits: jax.Array, ids: jax.Array) -> jax.Array:
+    """Next-token CE on a microbatch (same objective as gpt2_loss_fn)."""
+    logits = logits[:, :-1]
+    labels = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def split_stages(config: GPT2Config, num_stages: int, *,
+                 boundary_dtype: Any = jnp.float32, seed: int = 0):
+    """Split a GPT-2 config into ``num_stages`` pipeline stages for
+    :class:`ray_tpu.parallel.mpmd_pipeline.MPMDPipeline`.
+
+    Blocks are partitioned by COST, not count: the embedding lookup is
+    nearly free but the LM-head matmul costs ~``vocab/(12*hidden)``
+    block-equivalents (5+ blocks for GPT-2 vocab at small/XL widths), so
+    the last stage gets proportionally fewer blocks.  Returns
+    ``(stage_fns, init_fns)``: ``stage_fns[k](params, x[, target])`` with
+    the last returning the scalar loss, and ``init_fns[k]()`` building
+    that stage's params on the caller (run them ON the stage actors so
+    XL-scale params never visit the driver).  Activations cross stage
+    boundaries as ``boundary_dtype`` (fp32 by default: bf16 objects are
+    shippable but fp32 keeps the cotangent math bit-stable on CPU)."""
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    L = config.num_layers
+    if num_stages > L + 1:
+        raise ValueError(f"cannot split {L} blocks into {num_stages} stages")
+    embed_cost = 0.3  # lookup + add: a fraction of one block
+    head_cost = config.vocab_size / (12.0 * config.hidden_size)
+    per = (embed_cost + L + head_cost) / num_stages
+    # Greedy by cumulative cost: stage k takes blocks until its share
+    # (with the embed/head extras pinned to the ends) reaches (k+1)*per.
+    # The last stage may end up block-free (ln_f + the heavy LM head);
+    # every earlier stage keeps >= 1 block.
+    bounds, start, cum = [], 0, embed_cost
+    for k in range(num_stages - 1):
+        target = (k + 1) * per
+        stop = start
+        max_stop = L - (num_stages - k - 2)  # >= 1 block per later middle
+        while stop < max_stop and cum + 1.0 <= target + 0.5:
+            stop += 1
+            cum += 1.0
+        if stop == start and start + 1 <= max_stop:
+            stop, cum = start + 1, cum + 1.0
+        bounds.append((start, stop))
+        start = stop
+    bounds.append((start, L))
+
+    stage_fns, init_fns = [], []
+    for k in range(num_stages):
+        first, last = k == 0, k == num_stages - 1
+        module = GPT2Stage(config, first=first, last=last, blocks=bounds[k])
+
+        if last:
+            def fn(params, x, target, _m=module):
+                logits = _m.apply({"params": params}, x)
+                return _stage_ce_loss(logits, target)
+        else:
+            def fn(params, x, _m=module, _bd=boundary_dtype):
+                return _m.apply({"params": params}, x).astype(_bd)
+
+        def init_fn(_m=module, _first=first, _seed=seed + k,
+                    _c=config):
+            dummy = jnp.zeros((1, 8), jnp.int32) if _first else \
+                jnp.zeros((1, 8, _c.hidden_size), _c.dtype)
+            return _m.init(jax.random.PRNGKey(_seed), dummy)["params"]
+
+        stage_fns.append(fn)
+        init_fns.append(init_fn)
+    return stage_fns, init_fns
 
 
 # Logical sharding axes per parameter name suffix (DP/FSDP/TP ready).

@@ -20,13 +20,20 @@ _get_global_worker = lambda: None  # noqa: E731
 
 
 class ObjectRef:
-    __slots__ = ("id", "owner_addr", "_owner_registered", "__weakref__")
+    # _hold_args: driver-side pin for large-literal task args promoted to
+    # put objects (worker.make_args) — holding them on the RESULT ref
+    # keeps the promoted objects alive at least as long as the caller
+    # cares about the task, closing the race where ref-gc frees an arg
+    # before the executing worker resolves it.  Never serialized.
+    __slots__ = ("id", "owner_addr", "_owner_registered", "_hold_args",
+                 "__weakref__")
 
     def __init__(self, object_id: ObjectID, skip_adding_local_ref: bool = False,
                  owner_addr: Optional[dict] = None):
         self.id = object_id
         self.owner_addr = owner_addr
         self._owner_registered = False
+        self._hold_args = None
         if not skip_adding_local_ref:
             w = _get_global_worker()
             if w is not None:

@@ -1,193 +1,877 @@
-"""MPMD pipeline parallelism: stages as separate processes, each with its
-own device mesh, activations flowing through the object store.
+"""MPMD pipeline parallelism: model stages owned by separate process
+groups, activations flowing store-to-store, driven by an async 1F1B
+schedule (the ROADMAP's "billion-parameter training across gangs" plane).
 
-This is the second pipeline form SURVEY §7.8 calls for, layered on the
-actor runtime (the first — intra-mesh SPMD GPipe via shard_map/ppermute —
-is parallel/pipeline.py).  Reference substrate: placement groups +
-collective send/recv between actors; the MPMD schedule itself follows the
-GPipe paper (PAPERS.md) — no reference-code counterpart exists.
+Reference papers: "Scaling Deep Learning Training with MPMD Pipeline
+Parallelism" (arxiv 2412.14374) — stage-per-process-group pipelines with
+1F1B schedules reach near-SPMD MFU at multi-billion scale — and GPipe
+(arxiv 1811.06965) for the microbatch decomposition.  The first pipeline
+form (intra-mesh SPMD GPipe via shard_map/ppermute) is
+parallel/pipeline.py; this module is the cross-gang form.
 
-Design:
+Design (the three legs of the rebuild, vs the old naive GPipe driver):
 
-- Each ``PipelineStage`` is an actor owning one stage's params and (on a
-  pod) one process group's chips.  Stage k's forward keeps its VJP
-  residuals per-microbatch ON the actor, so backward needs only the
-  upstream cotangent: nothing but [mb, ...] activation tensors ever
-  crosses processes, and those ride the zero-copy object store.
-- The driver runs the GPipe schedule by CHAINING OBJECT REFS: stage k's
-  forward output ref is passed directly as stage k+1's input, so
-  activations move store-to-store without touching the driver, and the
-  scheduler's locality rules keep the transfer on-node where possible.
-- Backward replays the chain in reverse via the stored residuals; each
-  stage accumulates grads over microbatches and steps its own optimizer
-  (optax) locally — exactly the per-stage-optimizer layout a multi-mesh
-  pipeline wants (no global allreduce across stages).
+1. **Compiled stage workers.**  Each :class:`PipelineStage` precompiles
+   donated fwd/bwd/apply steps once (``train.jax``-style ``jax.jit`` with
+   carry donation).  The forward runs under ``jax.vjp`` *inside* jit and
+   returns the pullback as a ``jax.tree_util.Partial`` — a pytree whose
+   leaves are the VJP residuals, so residuals stay ON-DEVICE between the
+   separately-compiled forward and backward with zero recompute (no GPipe
+   re-materialization tax) and zero per-microbatch retrace (the jit cache
+   size is constant after the first step; ``stats()`` proves it).
+   A stage is optionally *internally SPMD*: ``spmd_devices=N`` places its
+   params replicated and its microbatch sharded over an N-device ``data``
+   mesh (``rllib/utils/mesh.py`` specs), and ``zero_sharding`` composes
+   the per-stage optimizer with ``parallel/zero.py`` — the apply step
+   becomes a shard_map whose optimizer state is 1/N per device.  On a
+   pod, each stage actor owns one process group's chips (the raylet's
+   TPU partitioning), which is the MeshGroup-gang-per-stage layout.
+
+2. **Async 1F1B schedule.**  The driver never touches tensors: stage
+   k's forward output *ref* is passed directly as stage k+1's input (and
+   cotangent refs chain the other way), so activations move store-to-
+   store while the driver only wires the DAG.  Per-stage op order is the
+   textbook 1F1B (warmup of ``num_stages-1-k`` forwards → steady 1F1B
+   alternation → cooldown), enforced by actor submission order; an
+   :class:`InflightWindow` of depth ``num_stages`` gates microbatch
+   admission so at most ``num_stages`` microbatches are ever in flight
+   (stage-side high-watermarks prove it; naive GPipe order holds all M).
+   Stage k's compute overlaps k±1's transfers because the consumer pulls
+   its input from the store while the producer is already running its
+   next op.  :func:`mpmd_driver_sync_count` counts blocking driver↔stage
+   round trips on the lockstep paths — the async schedule performs zero
+   mid-step syncs (tools/perf_smoke.py ``run_mpmd_smoke`` asserts it).
+
+3. **Pipelined step streaming + gang fault tolerance.**  Consecutive
+   ``submit_step`` calls keep up to ``step_window`` steps in flight (the
+   StepPipeline replay model): later steps' schedules are already queued
+   on the stage actors while the oldest drains.  A stage death poisons
+   the whole pipeline gang (its residuals/activations die with it), so
+   recovery is all-or-nothing: every stage is torn down and respawned,
+   state restores from the latest *confirmed* store-resident snapshot
+   (stages snapshot params+opt every ``snapshot_interval`` steps as an
+   ordinary actor op — the ref lives in the object store, the driver
+   never materializes it), and the replay buffer re-dispatches every
+   step since that snapshot IN ORDER — grad accumulation can't be
+   corrupted because replay restarts whole steps and per-step schedules
+   are deterministic.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+import collections
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.parallel.mesh_group import InflightWindow, gang_get
+
+# Blocking driver↔stage syncs on the LOCKSTEP dispatch paths
+# (train_step / get_params).  The async streaming path — submit_step +
+# windowed drains — must leave it untouched: backpressure drains overlap
+# with already-queued work, exactly like mesh_group.StepPipeline.
+_MPMD_SYNCS = {"count": 0}
+
+
+def mpmd_driver_sync_count() -> int:
+    """Blocking per-step driver syncs performed by the lockstep MPMD
+    paths since process start.  The async 1F1B stream adds zero."""
+    return _MPMD_SYNCS["count"]
+
+
+def _note_sync() -> None:
+    _MPMD_SYNCS["count"] += 1
+
+
+def stage_schedule(schedule: str, num_stages: int, num_microbatches: int,
+                   stage: int) -> List[tuple]:
+    """Per-stage op order ``[("F", m) | ("B", m), ...]``.
+
+    ``"1f1b"``: warmup of ``num_stages - 1 - stage`` forwards, then
+    strict one-forward-one-backward alternation, then backward cooldown —
+    at most ``num_stages - stage`` microbatches ever hold residuals on
+    this stage.  ``"gpipe"``: all forwards then all backwards (the naive
+    baseline; holds all ``num_microbatches`` residuals)."""
+    S, M, k = num_stages, num_microbatches, stage
+    if schedule == "gpipe":
+        return [("F", m) for m in range(M)] + [("B", m) for m in range(M)]
+    if schedule != "1f1b":
+        raise ValueError(f"schedule must be 1f1b|gpipe, got {schedule!r}")
+    warm = min(S - 1 - k, M)
+    ops: List[tuple] = [("F", m) for m in range(warm)]
+    f, b = warm, 0
+    while b < M:
+        if f < M:
+            ops.append(("F", f))
+            f += 1
+        ops.append(("B", b))
+        b += 1
+    return ops
 
 
 @ray_tpu.remote
 class PipelineStage:
-    """One pipeline stage process.
+    """One pipeline stage process: owns its stage's params + optimizer
+    and three compiled programs (fwd / bwd / apply).
 
-    stage_fn(params, x) -> y for middle stages; the LAST stage's fn is
-    ``loss_fn(params, x, target) -> scalar loss``.
+    ``stage_fn(params, x) -> y`` for middle stages; the LAST stage's fn
+    is ``loss_fn(params, x, target) -> scalar loss``.  ``init_params``
+    may be the params pytree itself or a zero-arg factory executed here
+    (so XL-scale stages never round-trip params through the driver).
     """
 
     def __init__(self, stage_fn: Callable, init_params: Any,
-                 optimizer=None):
-        # Device placement is the runtime's job, not this actor's: a
-        # pooled worker may already have jax imported (platform config
-        # frozen), so JAX_PLATFORMS/XLA_FLAGS set here would silently
-        # no-op.  On hardware, the raylet's per-worker TPU chip
-        # partitioning (TPU_VISIBLE_CHIPS at spawn) gives each stage its
-        # chips; in tests the conftest's CPU-mesh env does.
+                 optimizer=None, *, stage_id: int = 0, num_stages: int = 1,
+                 is_last: Optional[bool] = None, generation: int = 0,
+                 spmd_devices: int = 0, zero_sharding: str = "off",
+                 restore_from: Any = None):
+        import os
+
         import jax
+        import jax.numpy as jnp
         import optax
+
+        from ray_tpu._private import chaos
 
         self._jax = jax
+        self._jnp = jnp
         self.fn = stage_fn
-        self.params = init_params
+        self.stage_id = int(stage_id)
+        self.num_stages = int(num_stages)
+        self.is_last = (stage_id == num_stages - 1) if is_last is None \
+            else bool(is_last)
+        self.generation = int(generation)
+        os.environ[chaos.GENERATION_ENV] = str(generation)
         self.tx = optimizer or optax.sgd(1e-2)
-        self.opt_state = self.tx.init(self.params)
-        self._residuals: dict = {}
-        self._grad_accum = None
 
-    # ---- schedule ops ----
-    def forward(self, mb_id: int, x, target=None):
-        """Run this stage on one microbatch; keep the VJP closure local.
-        Returns the activation (middle) or the loss value (last)."""
-        args = (x,) if target is None else (x, target)
-        y, vjp_fn = self._jax.vjp(self.fn, self.params, *args)
-        self._residuals[mb_id] = vjp_fn
-        return np.asarray(self._jax.device_get(y))
+        params = init_params() if callable(init_params) else init_params
+        # --- optional intra-stage SPMD (data-parallel over local chips)
+        self._mesh = None
+        self._batched = None
+        self._zero = None
+        self._zero_info = None
+        if spmd_devices and spmd_devices > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
 
-    def backward(self, mb_id: int, dy=None):
-        """Consume the stored residuals: returns the cotangent to ship
-        upstream; grads accumulate locally."""
-        vjp_fn = self._residuals.pop(mb_id)
-        if dy is None:  # last stage: d(loss)/d(loss) = 1
-            dy = np.float32(1.0)
-        cotangents = vjp_fn(self._jax.numpy.asarray(dy))
-        dparams, dx = cotangents[0], cotangents[1]
-        if self._grad_accum is None:
-            self._grad_accum = dparams
+            from ray_tpu.rllib.utils.mesh import data_mesh
+
+            self._mesh = data_mesh(int(spmd_devices))
+            self._repl = NamedSharding(self._mesh, P())
+            self._batched = NamedSharding(self._mesh, P("data"))
+            params = jax.device_put(params, self._repl)
+        elif zero_sharding != "off":
+            raise ValueError(
+                "zero_sharding requires spmd_devices > 1 (the optimizer "
+                "shards over the stage's internal data mesh)")
+        self.params = params
+
+        # --- compiled steps (built once; shape specialization is the jit
+        # cache's job and stats() asserts it stays constant) ---
+        donate = jax.default_backend() != "cpu"  # cpu: donation unimplemented
+
+        def fwd_impl(params, x, *extra):
+            # extra = (target,) on the last stage.  The pullback rides out
+            # of jit as a tree_util.Partial: its leaves ARE the residuals,
+            # device-resident until the matching bwd consumes them.
+            y, vjp = jax.vjp(lambda p, x_: self.fn(p, x_, *extra), params, x)
+            return y, vjp
+
+        def bwd_impl(vjp, acc, dy):
+            dparams, dx = vjp(dy)
+            acc = jax.tree_util.tree_map(jnp.add, acc, dparams)
+            return acc, dx
+
+        def apply_impl(params, opt_state, acc, scale):
+            grads = jax.tree_util.tree_map(lambda g: g * scale, acc)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), opt_state
+
+        self._fwd = jax.jit(fwd_impl)
+        self._bwd = jax.jit(bwd_impl,
+                            donate_argnums=(0, 1, 2) if donate else ())
+        self._zeros = jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p))
+        if zero_sharding != "off":
+            self._init_zero_apply(zero_sharding, donate)
         else:
-            self._grad_accum = self._jax.tree_util.tree_map(
-                lambda a, b: a + b, self._grad_accum, dparams)
-        return np.asarray(self._jax.device_get(dx))
+            self._apply = jax.jit(apply_impl,
+                                  donate_argnums=(0, 1, 2) if donate else ())
+            self.opt_state = self.tx.init(self.params)
+        if restore_from is not None:
+            self.restore(restore_from)
 
-    def apply_grads(self, scale: float = 1.0):
-        """Optimizer step on the accumulated microbatch grads."""
-        import optax
+        # --- schedule state ---
+        self._resid: Dict[int, tuple] = {}   # mb -> (vjp, weight, step)
+        self._acc = None
+        self._step_count = 0
+        # --- per-step observability ---
+        self._ops: List[dict] = []
+        self._peak_inflight = 0
+        self._act_bytes = 0
 
-        grads = self._jax.tree_util.tree_map(
-            lambda g: g * scale, self._grad_accum)
-        updates, self.opt_state = self.tx.update(grads, self.opt_state,
-                                                 self.params)
-        self.params = optax.apply_updates(self.params, updates)
-        self._grad_accum = None
-        return True
+    # ---- internal helpers ----
+    def _init_zero_apply(self, zero_sharding: str, donate: bool):
+        """Per-stage ZeRO optimizer (parallel/zero.py): state sharded 1/N
+        over the stage's internal data mesh; grads enter the shard_map
+        body replicated (already accumulated over microbatches), so the
+        reduce-scatter degenerates to a mean of identical rows — exact."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel import zero as zero_mod
+        from ray_tpu.rllib.utils.mesh import _shard_map
+
+        world = dict(self._mesh.shape).get("data", 1)
+        zu = zero_mod.build_zero_update(
+            jax.eval_shape(lambda: self.params), self.tx, world,
+            zero_sharding=zero_sharding, axis_name="data")
+        self._zero = zu
+        self._zero_info = zero_mod.export_zero_metrics(
+            zu.sharder, self.tx, zero_sharding=zero_sharding,
+            quantized="off")
+
+        def body(params, opt_block, acc, scale):
+            grads = jax.tree_util.tree_map(lambda g: g * scale, acc)
+            params, opt_block = zu.update(grads, opt_block, params)
+            return params, opt_block
+
+        mapped = _shard_map(body, mesh=self._mesh,
+                            in_specs=(P(), zu.opt_specs, P(), P()),
+                            out_specs=(P(), zu.opt_specs))
+        self._apply = jax.jit(
+            mapped, donate_argnums=(0, 1, 2) if donate else ())
+        opt_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self._mesh, s), zu.opt_specs,
+            is_leaf=lambda s: isinstance(s, P))
+        self.opt_state = jax.jit(zu.init_opt, out_shardings=opt_sh)(
+            self.params)
+
+    def _to_device(self, x):
+        x = self._jnp.asarray(x)
+        if self._batched is not None and getattr(x, "ndim", 0) >= 1:
+            x = self._jax.device_put(x, self._batched)
+        return x
+
+    def _record(self, kind: str, step: int, mb: int, t0: float, t1: float):
+        self._ops.append({"kind": kind, "stage": self.stage_id,
+                          "step": step, "mb": mb, "start": t0, "end": t1})
+
+    # ---- schedule ops (dispatched by the driver, executed in strict
+    # submission order — the actor is single-threaded) ----
+    def fwd(self, step: int, mb: int, x, target=None, weight: float = 1.0):
+        """Forward one microbatch; the pullback (residuals) stays on this
+        stage.  Middle stages return the activation (host np, rides the
+        object store); the last stage returns its scalar loss."""
+        from ray_tpu._private import chaos
+
+        chaos.maybe_die("mpmd_fwd", self.stage_id)
+        t_in0 = time.time()
+        x_dev = self._to_device(x)
+        extra = ()
+        if self.is_last:
+            if target is None:
+                raise ValueError("last stage forward requires a target")
+            extra = (self._to_device(target),)
+        t0 = time.time()
+        y, vjp = self._fwd(self.params, x_dev, *extra)
+        y.block_until_ready()
+        t1 = time.time()
+        self._resid[mb] = (vjp, float(weight), step)
+        self._peak_inflight = max(self._peak_inflight, len(self._resid))
+        self._record("X", step, mb, t_in0, t0)
+        self._record("F", step, mb, t0, t1)
+        if self.is_last:
+            return float(self._jax.device_get(y))
+        out = np.asarray(self._jax.device_get(y))
+        self._act_bytes += out.nbytes
+        self._record("X", step, mb, t1, time.time())
+        return out
+
+    def bwd(self, step: int, mb: int, dy=None):
+        """Backward one microbatch: consume the stored pullback, fold
+        dparams into the step's accumulator, ship the input cotangent
+        upstream (stage 0 returns a token — nothing upstream of it)."""
+        from ray_tpu._private import chaos
+
+        chaos.maybe_die("mpmd_bwd", self.stage_id)
+        vjp, weight, fwd_step = self._resid.pop(mb)
+        if fwd_step != step:
+            raise RuntimeError(
+                f"stage {self.stage_id}: bwd(step={step}, mb={mb}) found "
+                f"residuals of step {fwd_step} — schedule corrupted")
+        t_in0 = time.time()
+        if dy is None:
+            # Last stage: d(loss)/d(loss), scaled by this microbatch's
+            # weight (its true row share of the global batch) so ragged
+            # microbatches accumulate EXACT full-batch gradients.
+            dy = self._jnp.asarray(weight, self._jnp.float32)
+        else:
+            dy = self._to_device(dy)
+        if self._acc is None:
+            self._acc = self._zeros(self.params)
+        t0 = time.time()
+        self._acc, dx = self._bwd(vjp, self._acc, dy)
+        self._jax.tree_util.tree_leaves(self._acc)[0].block_until_ready()
+        t1 = time.time()
+        self._record("X", step, mb, t_in0, t0)
+        self._record("B", step, mb, t0, t1)
+        if self.stage_id == 0:
+            return mb
+        out = np.asarray(self._jax.device_get(dx))
+        self._act_bytes += out.nbytes
+        self._record("X", step, mb, t1, time.time())
+        return out
+
+    def apply_grads(self, scale: float = 1.0) -> dict:
+        """Optimizer step on the accumulated grads; returns this step's
+        observability payload (op spans, watermarks, jit cache sizes)."""
+        from ray_tpu._private import chaos
+
+        chaos.maybe_die("mpmd_apply", self.stage_id)
+        if self._resid:
+            raise RuntimeError(
+                f"stage {self.stage_id}: apply with {len(self._resid)} "
+                "unconsumed residuals — schedule corrupted")
+        t0 = time.time()
+        scale_dev = self._jnp.asarray(scale, self._jnp.float32)
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, self._acc, scale_dev)
+        self._jax.tree_util.tree_leaves(self.params)[0].block_until_ready()
+        t1 = time.time()
+        self._acc = None
+        self._step_count += 1
+        self._record("A", self._step_count - 1, -1, t0, t1)
+        out = self.stats()
+        self._ops = []
+        self._peak_inflight = 0
+        return out
+
+    def stats(self) -> dict:
+        caches = {"fwd": int(self._fwd._cache_size()),
+                  "bwd": int(self._bwd._cache_size()),
+                  "apply": int(self._apply._cache_size())}
+        out = {
+            "stage": self.stage_id,
+            "steps": self._step_count,
+            "peak_inflight": self._peak_inflight,
+            "act_bytes": self._act_bytes,
+            "ops": list(self._ops),
+            "busy_s": sum(o["end"] - o["start"] for o in self._ops
+                          if o["kind"] in ("F", "B", "A")),
+            "jit_cache": caches,
+        }
+        if self._zero_info is not None:
+            out["zero_opt_bytes_per_replica"] = \
+                self._zero_info["zero_opt_bytes_per_replica"]
+            out["replicated_opt_bytes"] = \
+                self._zero_info["replicated_opt_bytes"]
+        return out
+
+    # ---- lifecycle / fault tolerance ----
+    def ping(self) -> int:
+        return self.stage_id
 
     def reset(self):
         """Drop partial schedule state after a failed step — stale grad
         accumulations must not leak into the next optimizer update."""
-        self._residuals.clear()
-        self._grad_accum = None
+        self._resid.clear()
+        self._acc = None
+        self._ops = []
+        self._peak_inflight = 0
+        return True
+
+    def snapshot(self):
+        """Host copy of (params, opt_state, step) — the return value
+        lives in the object store; the driver holds only the ref."""
+        return self._jax.device_get(
+            (self.params, self.opt_state, self._step_count))
+
+    def restore(self, snap):
+        params, opt_state, step_count = snap
+        put = self._jax.device_put
+        if self._mesh is not None:
+            self.params = put(params, self._repl)
+            if self._zero is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                opt_sh = self._jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self._mesh, s),
+                    self._zero.opt_specs,
+                    is_leaf=lambda s: isinstance(s, P))
+                self.opt_state = self._jax.tree_util.tree_map(
+                    lambda x, s: put(self._jnp.asarray(x), s),
+                    opt_state, opt_sh)
+            else:
+                self.opt_state = put(opt_state, self._repl)
+        else:
+            self.params = self._jax.tree_util.tree_map(
+                self._jnp.asarray, params)
+            self.opt_state = self._jax.tree_util.tree_map(
+                self._jnp.asarray, opt_state)
+        self._step_count = int(step_count)
         return True
 
     def get_params(self):
         return self._jax.device_get(self.params)
 
     def set_params(self, params):
-        self.params = params
-        self.opt_state = self.tx.init(self.params)
+        """Replace params (and re-init the optimizer) — compat shim."""
+        self.params = self._jax.tree_util.tree_map(self._jnp.asarray, params)
+        if self._mesh is not None:
+            self.params = self._jax.device_put(self.params, self._repl)
+        if self._zero is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            opt_sh = self._jax.tree_util.tree_map(
+                lambda s: NamedSharding(self._mesh, s), self._zero.opt_specs,
+                is_leaf=lambda s: isinstance(s, P))
+            self.opt_state = self._jax.jit(
+                self._zero.init_opt, out_shardings=opt_sh)(self.params)
+        else:
+            self.opt_state = self.tx.init(self.params)
         return True
 
 
+class _StepRec:
+    """One submitted step: the host microbatches (for replay), the refs
+    the driver drains, and bookkeeping flags.  ``aux_refs`` pins every
+    intermediate activation/cotangent ref until the step drains —
+    dropping them at dispatch would let ref-gc free a store-resident
+    activation before its consumer stage resolved it."""
+    __slots__ = ("idx", "xs", "ts", "weights", "loss_refs", "apply_refs",
+                 "aux_refs", "snap", "drained")
+
+    def __init__(self, idx, xs, ts, weights, snap):
+        self.idx = idx
+        self.xs = xs
+        self.ts = ts
+        self.weights = weights
+        self.loss_refs: List[Any] = []
+        self.apply_refs: List[Any] = []
+        self.aux_refs: List[Any] = []
+        self.snap = snap
+        self.drained = False
+
+
+def _mpmd_metrics():
+    """Lazy metric handles (internal_kv needs a connected driver)."""
+    from ray_tpu.util.metrics import Counter, Gauge, Meter
+
+    return {
+        "bubble": Gauge("mpmd_bubble_fraction",
+                        "1 - busy/(stages*wall) of the last drained step"),
+        "steps": Counter("mpmd_steps_total", "pipeline train steps drained"),
+        "replays": Counter("mpmd_replays_total",
+                           "gang restarts absorbed by schedule replay"),
+        "act_bytes": Meter("mpmd_activation_bytes",
+                           "activation/cotangent bytes shipped through "
+                           "the object store"),
+        "idle": Gauge("mpmd_stage_idle_frac",
+                      "per-stage idle fraction of the last drained step",
+                      tag_keys=("stage",)),
+        "inflight": Gauge("mpmd_peak_inflight_microbatches",
+                          "peak microbatches holding residuals on any "
+                          "stage in the last drained step"),
+    }
+
+
 class MPMDPipeline:
-    """Driver-side GPipe schedule over stage actors.
+    """Driver-side async 1F1B schedule over compiled stage actors.
 
     ``stage_fns``: list of callables; the last must be
-    loss_fn(params, x, target) -> scalar.  ``init_params``: per-stage
-    pytrees.
-    """
+    ``loss_fn(params, x, target) -> scalar``.  ``init_params``: per-stage
+    pytrees OR zero-arg factories (run on the stage).  ``stage_options``:
+    per-stage PipelineStage kwargs (``spmd_devices``, ``zero_sharding``).
+
+    Lockstep use (drop-in for the old driver)::
+
+        pipe = MPMDPipeline([f0, loss_fn], [p0, p1], num_microbatches=4)
+        loss = pipe.train_step(x, t)        # one blocking sync per step
+
+    Streaming use (the zero-sync hot path)::
+
+        for x, t in batches:
+            pipe.submit_step(x, t)          # ≤ step_window in flight
+        losses = pipe.flush()               # [(step_idx, loss), ...]
+
+    Fault tolerance: ``max_restarts > 0`` arms snapshotting (every
+    ``snapshot_interval`` steps, store-resident) and replay — a stage
+    death respawns every stage from the latest confirmed snapshot and
+    re-dispatches every step since, in order."""
 
     def __init__(self, stage_fns: Sequence[Callable],
                  init_params: Sequence[Any], optimizer=None,
                  num_microbatches: int = 4,
-                 stage_options: Optional[List[dict]] = None):
+                 stage_options: Optional[List[dict]] = None, *,
+                 schedule: str = "1f1b", step_window: int = 2,
+                 max_restarts: int = 0, snapshot_interval: int = 1,
+                 drain_timeout: Optional[float] = None,
+                 export_metrics: bool = True):
         n = len(stage_fns)
         if len(init_params) != n:
             raise ValueError("one params pytree per stage")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"schedule must be 1f1b|gpipe, got {schedule!r}")
         self.num_stages = n
-        self.num_microbatches = num_microbatches
-        opts = stage_options or [{} for _ in range(n)]
+        self.num_microbatches = int(num_microbatches)
+        self.schedule = schedule
+        self.step_window = max(1, int(step_window))
+        self.max_restarts = int(max_restarts)
+        self.snapshot_interval = max(1, int(snapshot_interval))
+        self.drain_timeout = drain_timeout
+        self.restart_count = 0
+        self._stage_fns = list(stage_fns)
+        self._init_params = list(init_params)
+        self._optimizer = optimizer
+        self._stage_opts = list(stage_options or [{} for _ in range(n)])
+        self._generation = 0
+        self.stages: List[Any] = []
+        self._spawn_stages(restore_refs=None)
+
+        self._window: InflightWindow = InflightWindow(self.step_window)
+        self._replay: collections.deque = collections.deque()  # _StepRec
+        self._results: List[tuple] = []
+        self._next_idx = 0
+        self._snap: Optional[tuple] = None          # (idx, [refs])
+        self._pending_snap: Optional[tuple] = None  # (idx, [refs])
+        self._last_report: Optional[dict] = None
+        self._act_bytes_total = 0
+        self._busy_total = 0.0
+        self._wall_total = 0.0
+        self._peak_window = 0
+        self._metrics = None
+        if export_metrics:
+            try:
+                self._metrics = _mpmd_metrics()
+            except Exception:
+                self._metrics = None
+
+    # ---- gang lifecycle ----
+    def _spawn_stages(self, restore_refs: Optional[List[Any]]) -> None:
         self.stages = [
-            PipelineStage.remote(stage_fns[k], init_params[k],
-                                 optimizer=optimizer, **opts[k])
-            for k in range(n)
+            PipelineStage.remote(
+                self._stage_fns[k], self._init_params[k],
+                optimizer=self._optimizer, stage_id=k,
+                num_stages=self.num_stages, generation=self._generation,
+                restore_from=None if restore_refs is None
+                else restore_refs[k],
+                **self._stage_opts[k])
+            for k in range(self.num_stages)
         ]
 
-    def train_step(self, x: np.ndarray, target: np.ndarray) -> float:
-        """One GPipe step: forward all microbatches through the stage
-        chain (refs chain store-to-store), backward in reverse, then every
-        stage steps its optimizer.  Returns the mean microbatch loss."""
-        M = self.num_microbatches
-        if len(x) < M:
-            raise ValueError(
-                f"batch of {len(x)} rows cannot fill num_microbatches={M} "
-                "(an empty microbatch means a NaN loss, not an error)")
-        xs = np.array_split(x, M)
-        ts = np.array_split(target, M)
-        try:
-            # Forward: chain refs so activations never visit the driver.
-            loss_refs = []
-            for m in range(M):
-                act = xs[m]
-                for k, stage in enumerate(self.stages):
-                    if k == self.num_stages - 1:
-                        act = stage.forward.remote(m, act, ts[m])
-                    else:
-                        act = stage.forward.remote(m, act)
-                loss_refs.append(act)
-            losses = ray_tpu.get(loss_refs)
-            # Backward: reverse chain; cotangents flow downstream→upstream.
-            done = []
-            for m in range(M):
-                dy = None
-                for k in range(self.num_stages - 1, -1, -1):
-                    if dy is None:
-                        dy = self.stages[k].backward.remote(m)
-                    else:
-                        dy = self.stages[k].backward.remote(m, dy)
-                done.append(dy)
-            ray_tpu.get(done)  # barrier: all residuals consumed
-            ray_tpu.get([s.apply_grads.remote(1.0 / M)
-                         for s in self.stages])
-        except Exception:
-            # A failed step leaves partial residuals/grad accumulations on
-            # the stages; drop them so a retry doesn't double-apply.
-            for s in self.stages:
-                try:
-                    ray_tpu.get(s.reset.remote())
-                except Exception:
-                    pass
-            raise
-        return float(np.mean(losses))
-
-    def get_params(self) -> List[Any]:
-        return ray_tpu.get([s.get_params.remote() for s in self.stages])
-
-    def stop(self):
+    def _teardown_stages(self) -> None:
         for s in self.stages:
             try:
                 ray_tpu.kill(s)
             except Exception:
                 pass
+        self.stages = []
+
+    def _dead_stages(self, deadline: float = 15.0) -> List[int]:
+        """Bounded ping fan-out; returns the stage ids that are dead or
+        unresponsive (empty list = the gang looks healthy)."""
+        try:
+            gang_get([s.ping.remote() for s in self.stages],
+                     timeout=deadline)
+            return []
+        except exc.MeshGroupError as e:
+            return sorted(e.failed_ranks)
+        except Exception:
+            return list(range(self.num_stages))
+
+    # ---- schedule dispatch (pure ref wiring — no tensors, no waits) ----
+    def _dispatch_step(self, rec: _StepRec) -> None:
+        if rec.snap:
+            refs = [s.snapshot.remote() for s in self.stages]
+            self._pending_snap = (rec.idx, refs)
+        S, M = self.num_stages, len(rec.xs)
+        queues = [collections.deque(stage_schedule(self.schedule, S, M, k))
+                  for k in range(S)]
+        acts: List[Dict[int, Any]] = [dict() for _ in range(S)]
+        cots: List[Dict[int, Any]] = [dict() for _ in range(S)]
+        window = InflightWindow(S if self.schedule == "1f1b" else M)
+        rec.loss_refs, rec.apply_refs = [], []
+        remaining = sum(len(q) for q in queues)
+        while remaining:
+            progressed = False
+            for k in range(S):
+                q = queues[k]
+                while q:
+                    op, m = q[0]
+                    if op == "F":
+                        src = rec.xs[m] if k == 0 else acts[k - 1].get(m)
+                        if src is None:
+                            break
+                        if k == 0:
+                            window.append(m)
+                            self._peak_window = max(self._peak_window,
+                                                    len(window))
+                            if window.over_depth:
+                                raise RuntimeError(
+                                    "1F1B scheduler admitted more than "
+                                    f"{window.depth} microbatches")
+                        if k == S - 1:
+                            ref = self.stages[k].fwd.remote(
+                                rec.idx, m, src, rec.ts[m],
+                                float(rec.weights[m]))
+                            rec.loss_refs.append(ref)
+                        else:
+                            ref = self.stages[k].fwd.remote(rec.idx, m, src)
+                            acts[k][m] = ref
+                    else:  # "B"
+                        if k == S - 1:
+                            dy = None
+                        else:
+                            dy = cots[k + 1].get(m)
+                            if dy is None:
+                                break
+                        if k == 0:
+                            window.remove(m)
+                        if dy is None:
+                            ref = self.stages[k].bwd.remote(rec.idx, m)
+                        else:
+                            ref = self.stages[k].bwd.remote(rec.idx, m, dy)
+                        cots[k][m] = ref
+                    q.popleft()
+                    remaining -= 1
+                    progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    f"{self.schedule} schedule deadlocked with "
+                    f"{remaining} ops pending (S={S}, M={M})")
+        rec.apply_refs = [s.apply_grads.remote() for s in self.stages]
+        rec.aux_refs = [r for d in acts + cots for r in d.values()]
+
+    def _split_batch(self, x, target):
+        M = self.num_microbatches
+        if len(x) < M:
+            raise ValueError(
+                f"batch of {len(x)} rows cannot fill num_microbatches={M} "
+                "(an empty microbatch means a NaN loss, not an error)")
+        if len(x) != len(target):
+            raise ValueError("x and target row counts differ")
+        xs = np.array_split(x, M)
+        ts = np.array_split(target, M)
+        # True per-microbatch weights: grad accumulation and the reported
+        # loss weight each microbatch by its ACTUAL row share, so ragged
+        # splits (len(x) % M != 0) match the single-process full-batch
+        # gradients exactly (the old driver weighted all equally).
+        weights = np.asarray([len(xb) for xb in xs], np.float64) / len(x)
+        return xs, ts, weights
+
+    # ---- streaming API (the zero-sync hot path) ----
+    def submit_step(self, x: np.ndarray, target: np.ndarray) -> int:
+        """Dispatch one full 1F1B step schedule asynchronously; blocks
+        (draining the oldest step) only once more than ``step_window``
+        steps are in flight.  Returns the step index."""
+        xs, ts, weights = self._split_batch(x, target)
+        idx = self._next_idx
+        self._next_idx += 1
+        snap = self.max_restarts > 0 and (
+            self._snap is None and self._pending_snap is None
+            or (self._pending_snap is None
+                and idx - self._snap[0] >= self.snapshot_interval))
+        rec = _StepRec(idx, xs, ts, weights, snap)
+        self._dispatch_step(rec)
+        self._replay.append(rec)
+        self._window.append(rec)
+        while self._window.over_depth:
+            self._drain_one()
+        return idx
+
+    def flush(self) -> List[tuple]:
+        """Drain every in-flight step; returns all accumulated
+        ``(step_idx, loss)`` pairs (destructive read)."""
+        while self._window:
+            self._drain_one()
+        out, self._results = self._results, []
+        return out
+
+    def train_step(self, x: np.ndarray, target: np.ndarray) -> float:
+        """Lockstep step (compat API): submit + drain everything, return
+        THIS step's weighted mean microbatch loss."""
+        _note_sync()
+        idx = self.submit_step(x, target)
+        drained = dict(self.flush())
+        return drained[idx]
+
+    # ---- drain + recovery ----
+    def _drain_one(self) -> None:
+        rec = self._window.peek()
+        while True:
+            try:
+                vals = gang_get(rec.loss_refs + rec.apply_refs,
+                                timeout=self.drain_timeout)
+                break
+            except exc.MeshGroupError as e:
+                self._recover(e)
+            except exc.RayTpuError:
+                # A user exception — or a task poisoned by an upstream
+                # stage death (surfaces as a TaskError, not an actor
+                # error).  Disambiguate with a bounded ping fan-out.
+                dead = self._dead_stages()
+                if dead:
+                    self._recover(exc.MeshGroupError(
+                        f"pipeline stage(s) {dead} died mid-step",
+                        failed_ranks={d: exc.ActorDiedError(
+                            f"stage {d} unresponsive") for d in dead}))
+                    continue
+                self._abort()
+                raise
+        M = len(rec.loss_refs)
+        losses, stage_stats = vals[:M], vals[M:]
+        loss = float(np.dot(rec.weights, np.asarray(losses, np.float64)))
+        self._window.popleft()
+        rec.drained = True
+        rec.aux_refs = []  # consumers finished: release the pins
+        self._results.append((rec.idx, loss))
+        self._ingest_stats(rec, stage_stats)
+        # Snapshot confirmation: this step drained, so every op queued
+        # before it — including the snapshot — executed.
+        if self._pending_snap is not None and \
+                rec.idx >= self._pending_snap[0]:
+            self._snap = self._pending_snap
+            self._pending_snap = None
+            while self._replay and self._replay[0].idx < self._snap[0]:
+                self._replay.popleft()
+        elif self.max_restarts == 0:
+            while self._replay and self._replay[0].drained:
+                self._replay.popleft()
+
+    def _recover(self, cause: exc.MeshGroupError) -> None:
+        """All-or-nothing gang restart + in-order schedule replay."""
+        if self.restart_count >= self.max_restarts:
+            cause.restarts = self.restart_count
+            self._abort(teardown=False)
+            raise cause
+        self.restart_count += 1
+        self._generation += 1
+        self._teardown_stages()
+        restore = list(self._snap[1]) if self._snap is not None else None
+        self._pending_snap = None  # its refs died with the old gang
+        self._spawn_stages(restore_refs=restore)
+        for rec in self._replay:
+            if rec.snap and self._snap is not None \
+                    and rec.idx <= self._snap[0]:
+                rec.snap = False  # already restored from this snapshot
+            self._dispatch_step(rec)
+        if self._metrics is not None:
+            try:
+                self._metrics["replays"].inc()
+            except Exception:
+                pass
+
+    def _abort(self, teardown: bool = False) -> None:
+        """Drop in-flight schedule state after an unrecoverable error so
+        a retry doesn't double-apply; stages reset their accumulators."""
+        self._window.clear()
+        self._replay.clear()
+        self._pending_snap = None
+        if teardown:
+            self._teardown_stages()
+            return
+        for s in self.stages:
+            try:
+                ray_tpu.get(s.reset.remote())
+            except Exception:
+                pass
+
+    # ---- observability ----
+    def _ingest_stats(self, rec: _StepRec, stage_stats: Sequence[dict]):
+        try:
+            ops = [o for st in stage_stats for o in st["ops"]]
+            wall = (max(o["end"] for o in ops)
+                    - min(o["start"] for o in ops)) if ops else 0.0
+            busy = [st["busy_s"] for st in stage_stats]
+            bubble = 1.0 - sum(busy) / (self.num_stages * wall) \
+                if wall > 0 else 0.0
+            act_bytes = sum(st["act_bytes"] for st in stage_stats) \
+                - self._act_bytes_total
+            self._act_bytes_total += act_bytes
+            self._busy_total += sum(busy)
+            self._wall_total += wall
+            self._last_report = {
+                "step": rec.idx,
+                "bubble_fraction": bubble,
+                "wall_s": wall,
+                "busy_s": busy,
+                "peak_inflight": {st["stage"]: st["peak_inflight"]
+                                  for st in stage_stats},
+                "jit_cache": {st["stage"]: st["jit_cache"]
+                              for st in stage_stats},
+                "act_bytes": act_bytes,
+                "ops": {st["stage"]: st["ops"] for st in stage_stats},
+            }
+            from ray_tpu._private import profiling
+
+            for o in ops:
+                profiling.record_span(
+                    {"F": "mpmd_stage_fwd", "B": "mpmd_stage_bwd",
+                     "A": "mpmd_stage_apply", "X": "mpmd_stage_transfer"}
+                    [o["kind"]], o["start"], o["end"], stage=o["stage"],
+                    step=o["step"], mb=o["mb"])
+            if self._metrics is not None:
+                m = self._metrics
+                m["bubble"].set(bubble)
+                m["steps"].inc()
+                m["act_bytes"].mark(float(act_bytes))
+                m["inflight"].set(float(max(
+                    st["peak_inflight"] for st in stage_stats)))
+                for st, b in zip(stage_stats, busy):
+                    idle = 1.0 - b / wall if wall > 0 else 0.0
+                    m["idle"].set(idle, tags={"stage": str(st["stage"])})
+        except Exception:
+            pass  # observability is best-effort, never the step path
+
+    def last_step_report(self) -> Optional[dict]:
+        """Observability payload of the most recently drained step."""
+        return self._last_report
+
+    def stats(self) -> dict:
+        rep = self._last_report or {}
+        return {
+            "num_stages": self.num_stages,
+            "num_microbatches": self.num_microbatches,
+            "schedule": self.schedule,
+            "steps_submitted": self._next_idx,
+            "steps_inflight": len(self._window),
+            "restarts": self.restart_count,
+            "bubble_fraction": rep.get("bubble_fraction"),
+            "peak_inflight": rep.get("peak_inflight"),
+            "jit_cache": rep.get("jit_cache"),
+            "activation_bytes": self._act_bytes_total,
+            "act_gb_per_s": (self._act_bytes_total / self._wall_total / 1e9
+                             if self._wall_total > 0 else 0.0),
+            "driver_peak_window": self._peak_window,
+        }
+
+    # ---- params access (lockstep paths) ----
+    def get_params(self) -> List[Any]:
+        _note_sync()
+        self.flush()
+        return gang_get([s.get_params.remote() for s in self.stages])
+
+    def stop(self):
+        try:
+            if self._window:
+                self.flush()
+        except Exception:
+            pass
+        self._teardown_stages()
+
+    def __enter__(self) -> "MPMDPipeline":
+        return self
+
+    def __exit__(self, exc_type, exc_val, tb) -> None:
+        if exc_type is not None:
+            self._abort(teardown=True)
+        else:
+            self.stop()

@@ -102,8 +102,10 @@ class RemoteFunction:
             # (reference: ray.init(local_mode=True)).
             return global_worker.run_function(
                 self._function, args, kwargs, opts.get("num_returns", 1))
+        holds: list = []
         if args or kwargs:
-            task_args, task_kwargs = global_worker.make_args(args, kwargs)
+            task_args, task_kwargs = global_worker.make_args(args, kwargs,
+                                                             holds=holds)
         else:
             task_args, task_kwargs = [], {}
         # Options are immutable per RemoteFunction instance: resolve the
@@ -154,6 +156,13 @@ class RemoteFunction:
         refs = global_worker.submit_task(spec)
         if num_returns == 0:
             return None
+        if holds:
+            # Pin promoted large-literal args to the result refs: the head
+            # pins them for the task's lifetime once it SEES the spec, but
+            # the driver-side drop can otherwise race the submit itself
+            # (the ref-gc drainer is a different thread).
+            for r in refs:
+                r._hold_args = holds
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -105,6 +105,7 @@ class TestRllibCLI:
         with pytest.raises(NotImplementedError):
             algo.evaluate(num_steps=50)
 
+    @pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
     def test_evaluate_memory_policies(self):
         """The tuned attention example must have a working
         train→checkpoint→evaluate round trip (and the LSTM path too)."""

@@ -14,7 +14,9 @@ def fresh():
 
 
 def test_defaults_and_attr_access():
-    assert CONFIG.native_store is True
+    # native_store defaults OFF: the arena path bypasses the segment-pool
+    # + batched-notify object plane (see the registry declaration).
+    assert CONFIG.native_store is False
     assert CONFIG.max_workers_per_node == 64
     assert CONFIG.get("transfer_chunk_bytes") == 4 * 1024 * 1024
 

@@ -128,6 +128,7 @@ def test_head_kill9_restart_preserves_actor_state(tmp_path):
                     proc.wait(timeout=10)
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_head_kill9_under_load_with_pending_pg(tmp_path):
     """Failover under FIRE (VERDICT r4 Weak #7): kill -9 the head while
     direct-path task load is in flight AND a placement-group reservation

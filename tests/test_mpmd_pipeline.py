@@ -1,6 +1,12 @@
-"""MPMD pipeline: stages in separate processes, activations through the
-object store, gradient parity with the single-process model (SURVEY §7.8
-second pipeline form; schedule per the GPipe paper)."""
+"""MPMD pipeline: compiled stages in separate processes, activations
+through the object store, async 1F1B schedule (ISSUE 10).
+
+Covers: gradient parity with the single-process model (1F1B and naive
+GPipe schedules), exact ragged-microbatch weighting, schedule-order
+in-flight bounds (1F1B holds <= num_stages microbatches, GPipe holds all),
+stage-death gang restart + in-order replay (same final params as the
+unkilled run), intra-stage SPMD + ZeRO optimizer sharding, GPT-2 stage
+splitting, and the mpmd_* metrics export."""
 import numpy as np
 import pytest
 
@@ -9,20 +15,16 @@ import ray_tpu
 
 @pytest.fixture(scope="module")
 def cluster():
-    ray_tpu.init(num_cpus=4)
+    ray_tpu.init(num_cpus=6)
     yield
     ray_tpu.shutdown()
 
 
-def test_mpmd_two_stage_matches_single_process(cluster):
-    import jax
-    import jax.numpy as jnp
-    import optax
+def _mlp_stages():
+    """Two-stage MLP + MSE loss; nested so cloudpickle captures BY VALUE
+    (module-level test functions pickle by reference and workers can't
+    import tests/)."""
 
-    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
-
-    # Nested so cloudpickle captures them BY VALUE — module-level test
-    # functions pickle by reference and workers can't import tests/.
     def _stage0(params, x):
         import jax.numpy as jnp
 
@@ -34,14 +36,67 @@ def test_mpmd_two_stage_matches_single_process(cluster):
         pred = h @ params["w1"] + params["b1"]
         return jnp.mean((pred - target) ** 2)
 
-    rng = np.random.default_rng(0)
-    d_in, d_h, d_out, n = 6, 16, 3, 32
+    return _stage0, _stage1_loss
+
+
+def _mlp_params(rng, d_in=6, d_h=16, d_out=3):
+    import jax.numpy as jnp
+
     p0 = {"w0": jnp.asarray(rng.normal(0, 0.3, (d_in, d_h)), jnp.float32),
           "b0": jnp.zeros((d_h,), jnp.float32)}
     p1 = {"w1": jnp.asarray(rng.normal(0, 0.3, (d_h, d_out)), jnp.float32),
           "b1": jnp.zeros((d_out,), jnp.float32)}
-    x = rng.normal(size=(n, d_in)).astype(np.float32)
-    w_true = rng.normal(size=(d_in, d_out)).astype(np.float32)
+    return p0, p1
+
+
+def _reference_run(stage0, loss_fn, p0, p1, x, t, lr, steps,
+                   microbatches):
+    """Single-process reference: full-batch mean loss (what weighted
+    microbatch accumulation must reproduce EXACTLY, ragged or not)."""
+    import jax
+    import optax
+
+    def full_loss(params, xb, tb):
+        return loss_fn(params[1], stage0(params[0], xb), tb)
+
+    params = [p0, p1]
+    tx = optax.sgd(lr)
+    opt = [tx.init(p0), tx.init(p1)]
+    losses = []
+    for _ in range(steps):
+        loss, grads = jax.value_and_grad(full_loss)(params, x, t)
+        new_params = []
+        for i in range(2):
+            upd, opt[i] = tx.update(grads[i], opt[i], params[i])
+            new_params.append(optax.apply_updates(params[i], upd))
+        params = new_params
+        losses.append(float(loss))
+    del microbatches
+    return losses, params
+
+
+def _assert_params_close(got, want, rtol=1e-4, atol=1e-5):
+    import jax
+
+    for stage, (g, w) in enumerate(zip(got, want)):
+        gl, wl = jax.tree_util.tree_leaves(g), jax.tree_util.tree_leaves(w)
+        assert len(gl) == len(wl)
+        for a, b in zip(gl, wl):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=rtol, atol=atol,
+                                       err_msg=f"stage {stage}")
+
+
+def test_mpmd_two_stage_matches_single_process(cluster):
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(0)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    w_true = rng.normal(size=(6, 3)).astype(np.float32)
     t = (x @ w_true).astype(np.float32)
 
     pipe = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
@@ -50,39 +105,105 @@ def test_mpmd_two_stage_matches_single_process(cluster):
     pipe_params = pipe.get_params()
     pipe.stop()
 
-    # Single-process reference: identical math, grads averaged over the
-    # same 4 equal microbatches.
-    def full_loss(params, xb, tb):
-        h = _stage0(params[0], xb)
-        return _stage1_loss(params[1], h, tb)
-
-    params = [p0, p1]
-    tx = optax.sgd(0.05)
-    opt = [tx.init(p0), tx.init(p1)]
-    ref_losses = []
-    for _ in range(6):
-        mb_losses, grads_acc = [], None
-        for xb, tb in zip(np.array_split(x, 4), np.array_split(t, 4)):
-            loss, grads = jax.value_and_grad(full_loss)(params, xb, tb)
-            mb_losses.append(float(loss))
-            grads_acc = grads if grads_acc is None else \
-                jax.tree_util.tree_map(lambda a, b: a + b, grads_acc, grads)
-        grads_acc = jax.tree_util.tree_map(lambda g: g / 4, grads_acc)
-        new_params = []
-        for i in range(2):
-            upd, opt[i] = tx.update(grads_acc[i], opt[i], params[i])
-            new_params.append(optax.apply_updates(params[i], upd))
-        params = new_params
-        ref_losses.append(float(np.mean(mb_losses)))
-
+    # Equal microbatches: weighted accumulation == full-batch gradients.
+    ref_losses, ref_params = _reference_run(
+        _stage0, _stage1_loss, p0, p1, x, t, 0.05, 6, 4)
     np.testing.assert_allclose(pipe_losses, ref_losses, rtol=1e-4,
                                atol=1e-5)
-    for got, want in zip(pipe_params, params):
-        for k in want:
-            np.testing.assert_allclose(np.asarray(got[k]),
-                                       np.asarray(want[k]),
-                                       rtol=1e-4, atol=1e-5)
+    _assert_params_close(pipe_params, ref_params)
     assert pipe_losses[-1] < pipe_losses[0]  # it actually learns
+
+
+def test_mpmd_ragged_batch_matches_reference(cluster):
+    """len(x) % M != 0: microbatch grads must be weighted by TRUE sizes —
+    the old equal-weight accumulation diverges from full-batch grads."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(3)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(30, 6)).astype(np.float32)  # 30 % 4 != 0
+    t = rng.normal(size=(30, 3)).astype(np.float32)
+
+    pipe = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                        optimizer=optax.sgd(0.05), num_microbatches=4)
+    losses = [pipe.train_step(x, t) for _ in range(3)]
+    params = pipe.get_params()
+    pipe.stop()
+
+    ref_losses, ref_params = _reference_run(
+        _stage0, _stage1_loss, p0, p1, x, t, 0.05, 3, 4)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+    _assert_params_close(params, ref_params)
+
+
+def test_mpmd_1f1b_and_gpipe_schedule_parity(cluster):
+    """The async 1F1B schedule, the naive GPipe schedule, and the
+    single-process reference must agree on losses AND params over >= 3
+    steps — the schedule changes execution order, never math."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(1)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 3)).astype(np.float32)
+
+    results = {}
+    for sched in ("1f1b", "gpipe"):
+        pipe = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                            optimizer=optax.sgd(0.05), num_microbatches=8,
+                            schedule=sched)
+        losses = [pipe.train_step(x, t) for _ in range(3)]
+        results[sched] = (losses, pipe.get_params())
+        pipe.stop()
+
+    ref_losses, ref_params = _reference_run(
+        _stage0, _stage1_loss, p0, p1, x, t, 0.05, 3, 8)
+    for sched, (losses, params) in results.items():
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4,
+                                   atol=1e-5, err_msg=sched)
+        _assert_params_close(params, ref_params)
+
+
+def test_mpmd_schedule_order_inflight_bounds(cluster):
+    """1F1B keeps at most num_stages microbatches in flight (peak ==
+    num_stages at stage 0, num_stages - k at stage k — never more);
+    naive GPipe holds all M.  Measured worker-side (residual-count high
+    watermark), plus the driver's own admission window."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(2)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 3)).astype(np.float32)
+    M = 8
+
+    peaks = {}
+    for sched in ("1f1b", "gpipe"):
+        pipe = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                            optimizer=optax.sgd(0.05), num_microbatches=M,
+                            schedule=sched)
+        pipe.train_step(x, t)
+        rep = pipe.last_step_report()
+        peaks[sched] = dict(rep["peak_inflight"])
+        if sched == "1f1b":
+            assert pipe.stats()["driver_peak_window"] == 2  # num_stages
+        pipe.stop()
+
+    S = 2
+    # 1F1B: stage k peaks at exactly S - k, never more.
+    for k in range(S):
+        assert peaks["1f1b"][k] == S - k, peaks
+    # GPipe: stage 0 holds every microbatch's residuals.
+    assert peaks["gpipe"][0] == M, peaks
 
 
 def test_mpmd_three_stages_run(cluster):
@@ -92,9 +213,13 @@ def test_mpmd_three_stages_run(cluster):
     from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
 
     def mid(params, x):
+        import jax.numpy as jnp
+
         return jnp.tanh(x @ params["w"])
 
     def last(params, h, target):
+        import jax.numpy as jnp
+
         return jnp.mean((h @ params["w"] - target) ** 2)
 
     rng = np.random.default_rng(1)
@@ -106,6 +231,10 @@ def test_mpmd_three_stages_run(cluster):
     x = rng.normal(size=(16, 4)).astype(np.float32)
     t = rng.normal(size=(16, 2)).astype(np.float32)
     losses = [pipe.train_step(x, t) for _ in range(20)]
+    rep = pipe.last_step_report()
+    # 3-stage 1F1B in-flight bound: stage k <= 3 - k (M=2 caps it at 2).
+    for k, peak in rep["peak_inflight"].items():
+        assert peak <= min(3 - k, 2), rep["peak_inflight"]
     pipe.stop()
     assert losses[-1] < losses[0] * 0.9
 
@@ -116,6 +245,8 @@ def test_mpmd_rejects_undersized_batch(cluster):
     from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
 
     def last(params, x, t):
+        import jax.numpy as jnp
+
         return jnp.mean((x @ params["w"] - t) ** 2)
 
     pipe = MPMDPipeline([last], [{"w": jnp.ones((3, 2))}],
@@ -124,3 +255,208 @@ def test_mpmd_rejects_undersized_batch(cluster):
         pipe.train_step(np.ones((2, 3), np.float32),
                         np.ones((2, 2), np.float32))
     pipe.stop()
+
+
+def test_mpmd_step_streaming_and_jit_cache_constant(cluster):
+    """Streaming submit_step keeps steps in flight with zero lockstep
+    syncs, and the compiled stage programs never retrace: every stage's
+    jit cache sizes are identical from step 1 to step N."""
+    import optax
+
+    from ray_tpu.parallel import mpmd_pipeline as mp
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(5)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 3)).astype(np.float32)
+
+    pipe = mp.MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                           optimizer=optax.sgd(0.05), num_microbatches=4,
+                           step_window=2)
+    syncs_before = mp.mpmd_driver_sync_count()
+    caches = []
+    for i in range(6):
+        pipe.submit_step(x, t)
+        rep = pipe.last_step_report()
+        if rep is not None:
+            caches.append(rep["jit_cache"])
+    results = pipe.flush()
+    assert mp.mpmd_driver_sync_count() == syncs_before
+    assert [i for i, _ in results] == list(range(6))
+    losses = [l for _, l in results]
+    assert losses[-1] < losses[0]
+    rep = pipe.last_step_report()
+    caches.append(rep["jit_cache"])
+    pipe.stop()
+    assert caches[0] == caches[-1], caches  # constant — no retrace
+    for stage_caches in caches[-1].values():
+        assert set(stage_caches.values()) == {1}, caches[-1]
+
+
+def test_mpmd_stage_death_replay_matches_unkilled(cluster):
+    """Kill one stage's worker process mid-step: the pipeline restarts
+    the whole stage gang, restores from the store-resident snapshot,
+    replays the in-flight steps in order, and lands on EXACTLY the
+    params of an unkilled run."""
+    import optax
+
+    from ray_tpu._private.chaos import _kill_actor_process
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(7)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 3)).astype(np.float32)
+    steps = 5
+
+    # Reference: unkilled pipeline, same seed/params/batches.
+    ref = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                       optimizer=optax.sgd(0.05), num_microbatches=4)
+    ref_losses = [ref.train_step(x, t) for _ in range(steps)]
+    ref_params = ref.get_params()
+    ref.stop()
+
+    pipe = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                        optimizer=optax.sgd(0.05), num_microbatches=4,
+                        step_window=2, max_restarts=2,
+                        snapshot_interval=1, drain_timeout=60.0)
+    losses = {}
+    for i in range(steps):
+        pipe.submit_step(x, t)
+        if i == 2:
+            # Mid-step murder: the step's schedule is in flight on the
+            # stage actors right now.
+            assert _kill_actor_process(pipe.stages[1])
+    for idx, loss in pipe.flush():
+        losses[idx] = loss
+    params = pipe.get_params()
+    assert pipe.restart_count >= 1, "kill never triggered a restart"
+    pipe.stop()
+
+    np.testing.assert_allclose([losses[i] for i in range(steps)],
+                               ref_losses, rtol=1e-5, atol=1e-6)
+    _assert_params_close(params, ref_params, rtol=1e-6, atol=1e-7)
+
+
+def test_mpmd_spmd_stage_with_zero_sharded_optimizer(cluster):
+    """A stage that is internally SPMD (microbatch sharded over a local
+    data mesh) with a ZeRO-sharded optimizer must match the plain
+    single-device pipeline: layout changes, math doesn't.  Also asserts
+    the optimizer state is genuinely 1/N per device."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(9)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 3)).astype(np.float32)
+
+    plain = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                         optimizer=optax.adam(1e-2), num_microbatches=4)
+    plain_losses = [plain.train_step(x, t) for _ in range(4)]
+    plain_params = plain.get_params()
+    plain.stop()
+
+    spmd = MPMDPipeline(
+        [_stage0, _stage1_loss], [p0, p1], optimizer=optax.adam(1e-2),
+        num_microbatches=4,
+        stage_options=[{"spmd_devices": 2, "zero_sharding": "opt+grads"},
+                       {"spmd_devices": 2}])
+    spmd_losses = [spmd.train_step(x, t) for _ in range(4)]
+    spmd_params = spmd.get_params()
+    stats = ray_tpu.get(spmd.stages[0].stats.remote())
+    spmd.stop()
+
+    np.testing.assert_allclose(spmd_losses, plain_losses, rtol=1e-4,
+                               atol=1e-5)
+    _assert_params_close(spmd_params, plain_params, rtol=1e-4, atol=1e-5)
+    ratio = stats["zero_opt_bytes_per_replica"] / \
+        stats["replicated_opt_bytes"]
+    assert ratio <= 0.5 + 0.05, f"opt state not 1/N-sharded: {ratio}"
+
+
+def test_mpmd_gpt2_split_pipeline_parity(cluster):
+    """A split tiny GPT-2 trained through the 2-stage pipeline matches
+    the same stages composed in-process (the single-mesh reference)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.gpt2 import GPT2Config, split_stages
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    stage_fns, init_fns = split_stages(cfg, 2)
+    params = [f() for f in init_fns]
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, cfg.vocab_size, size=(8, 32)).astype(np.int32)
+
+    pipe = MPMDPipeline(stage_fns, params, optimizer=optax.adamw(1e-3),
+                        num_microbatches=4)
+    pipe_losses = [pipe.train_step(ids, ids) for _ in range(3)]
+    pipe.stop()
+
+    # Single-process reference: compose the SAME stage fns.
+    def full_loss(ps, ids_b):
+        h = stage_fns[0](ps[0], ids_b)
+        return stage_fns[1](ps[1], h, ids_b)
+
+    tx = optax.adamw(1e-3)
+    ps = list(params)
+    opt = [tx.init(p) for p in ps]
+    ref_losses = []
+    for _ in range(3):
+        loss, grads = jax.value_and_grad(full_loss)(ps, ids)
+        for i in range(2):
+            upd, opt[i] = tx.update(grads[i], opt[i], ps[i])
+            ps[i] = optax.apply_updates(ps[i], upd)
+        ref_losses.append(float(loss))
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_mpmd_metrics_exported(cluster):
+    """pipeline_* metrics land in the dashboard's /metrics source."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+    from ray_tpu.util.metrics import prometheus_text
+
+    _stage0, _stage1_loss = _mlp_stages()
+    rng = np.random.default_rng(13)
+    p0, p1 = _mlp_params(rng)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 3)).astype(np.float32)
+    pipe = MPMDPipeline([_stage0, _stage1_loss], [p0, p1],
+                        optimizer=optax.sgd(0.05), num_microbatches=4)
+    for _ in range(2):
+        pipe.train_step(x, t)
+    pipe._metrics["act_bytes"].flush()  # Meter batches kv writes
+    pipe.stop()
+    text = prometheus_text()
+    for name in ("mpmd_bubble_fraction", "mpmd_steps_total",
+                 "mpmd_activation_bytes", "mpmd_stage_idle_frac",
+                 "mpmd_peak_inflight_microbatches"):
+        assert name in text, f"{name} missing from metrics export"
+
+
+def test_gpt2_split_stages_cost_balance():
+    """No cluster needed: split bounds cover all blocks exactly once and
+    the LM-head-heavy last stage gets fewer blocks."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config, split_stages
+
+    cfg = GPT2Config.gpt2_small(dtype=jnp.float32)
+    for n in (2, 3, 4):
+        fns, inits = split_stages(cfg, n)
+        assert len(fns) == n and len(inits) == n
+    # XL config: 48 layers over 4 stages, last stage lighter in blocks.
+    xl = GPT2Config.gpt2_xl(dtype=jnp.float32)
+    assert xl.num_layers == 48 and xl.hidden_size == 1600
+    fns, _ = split_stages(xl, 4)
+    assert len(fns) == 4

@@ -6,6 +6,7 @@ import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 
 from tools.perf_smoke import (
     run_checkpoint_smoke,
+    run_mpmd_smoke,
     run_node_loss_smoke,
     run_object_plane_smoke,
     run_rollout_smoke,
@@ -100,6 +101,20 @@ def test_zero_smoke(shutdown_only):
     assert out["overlap_ok"], f"ZeRO step reintroduced lockstep: {out}"
     assert out["opt_bytes_ok"], f"opt-state bytes not 1/N: {out}"
     assert out["no_recompile"], f"ZeRO step recompiled: {out}"
+    assert out["ok"], out
+
+
+def test_mpmd_smoke(shutdown_only):
+    """The MPMD pipeline must genuinely parallelize stages (stage 0 on
+    microbatch m+1 while stage 1 works m), stream steps with zero
+    driver syncs, hold the 1F1B residual bound, and never retrace its
+    compiled stage programs — the tier-1 guard for ISSUE 10."""
+    out = run_mpmd_smoke()
+    assert out["results_ok"], out
+    assert out["driver_syncs_steady"] == 0, f"lockstep regression: {out}"
+    assert out["overlap_ok"], f"stages serialized: {out}"
+    assert out["jit_cache_constant"], f"stage program retraced: {out}"
+    assert out["inflight_bound_ok"], f"1F1B bound violated: {out}"
     assert out["ok"], out
 
 

@@ -60,6 +60,7 @@ def test_gru_gate_starts_near_identity():
     assert drift < 0.5, f"gate not identity-biased at init: drift={drift}"
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_pixel_env_attention_trains_and_evaluates():
     """CNN+attention: each window slot runs through the MinAtar CNN
     before the GTrXL stack (reference: visionnet + GTrXL)."""
@@ -83,6 +84,7 @@ def test_lstm_and_attention_exclusive():
         cfg.build()
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_attention_ppo_learns_stateless_cartpole():
     """The memory gate: with velocities hidden a memoryless policy
     plateaus around ~30; the attention window must clear 150 (same bar

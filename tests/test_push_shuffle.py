@@ -83,6 +83,7 @@ def _run_over_budget_shuffle(n_blocks: int, rows_per_block: int,
     assert peak <= 256 * MB, f"store overflowed: peak {peak / MB:.0f}MB"
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_push_shuffle_beyond_store_budget(small_store_cluster):
     """A dataset larger than the store budget full-shuffles to completion
     with bounded in-store memory (accumulators spill; scratch is

@@ -120,6 +120,7 @@ def _run_breakout(floor: float, iters: int, **training):
     assert best >= floor, f"no learning on pixel breakout: best={best}"
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_anakin_ppo_breakout_pixels_learns():
     """Atari-class pixel PPO: Breakout board -> CNN trunk, fully on-device
     anakin loop.  Fast gate: clear 0.5 (random policy scores ~0.14) within

@@ -98,6 +98,7 @@ def test_dqn_actor_mode_learns_cartpole(ray_cluster):
     assert best >= 100.0, f"actor-path DQN failed to learn: best={best}"
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_sac_actor_mode_learns_pendulum(ray_cluster):
     """SAC actor path drives a CONTINUOUS gym env through the Box-action
     bridge; random policy scores ~-1400, learning must lift it."""

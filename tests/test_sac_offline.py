@@ -28,20 +28,26 @@ def test_squashed_gaussian_logp_matches_numeric():
     key = jax.random.PRNGKey(0)
     obs = jax.random.normal(key, (16, 3))
     params = pi.init(key, obs)
-    a, logp = pi.sample(params, obs, jax.random.PRNGKey(1))
+    key = jax.random.PRNGKey(1)
+    a, logp = pi.sample(params, obs, key)
     assert a.shape == (16, 1) and logp.shape == (16,)
     assert bool(jnp.all(a >= -2.0)) and bool(jnp.all(a <= 2.0))
     assert bool(jnp.all(jnp.isfinite(logp)))
     # Exact change-of-variables check: action = tanh(pre) * scale with
     # pre ~ N(mu, std), so log p(action) = logN(pre) - log(1 - tanh(pre)^2)
-    # - log(scale).  Recompute in float64 numpy from the dist params.
-    mu, log_std = map(np.asarray, pi.dist_params(params, obs))
-    # Invert the squash to recover pre-activation from the action.
-    y = np.asarray(a, np.float64) / 2.0  # scale = 2
-    pre = np.arctanh(np.clip(y, -1 + 1e-12, 1 - 1e-12))
-    std = np.exp(np.asarray(log_std, np.float64))
-    gauss = (-0.5 * ((pre - mu) / std) ** 2 - np.log(std)
-             - 0.5 * np.log(2 * np.pi))
+    # - log(scale).  Build the fp64 baseline from the SAME pre-activation
+    # the policy sampled (regenerate eps from the key) — inverting the
+    # squash from the fp32 action (arctanh near ±1) is ill-conditioned
+    # where tanh saturates and used to push ~1/16 elements past the gate.
+    mu, log_std = (np.asarray(v, np.float64)
+                   for v in pi.dist_params(params, obs))
+    eps = np.asarray(jax.random.normal(key, mu.shape), np.float64)
+    std = np.exp(log_std)
+    pre = mu + std * eps
+    # The fp64 squash must match the fp32 action it claims to explain.
+    np.testing.assert_allclose(np.asarray(a, np.float64),
+                               np.tanh(pre) * 2.0, rtol=1e-5, atol=1e-5)
+    gauss = (-0.5 * eps ** 2 - log_std - 0.5 * np.log(2 * np.pi))
     expect = gauss - np.log1p(-np.tanh(pre) ** 2 + 1e-300) - np.log(2.0)
     np.testing.assert_allclose(np.asarray(logp), expect[:, 0], rtol=1e-3,
                                atol=1e-3)

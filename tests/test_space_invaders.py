@@ -4,6 +4,7 @@ rllib/utils/test_utils.py:57; env is a clean-room MinAtar-scale game like
 the Breakout board)."""
 import math
 
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -89,6 +90,7 @@ def test_episode_terminates():
     assert float(run(states, key)) > 0
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_anakin_ppo_space_invaders_learns():
     """Fast gate: clear 6.0 mean reward (random play scores ~4.7; trained
     runs reach ~10) within 40 iters on the CPU mesh."""

@@ -60,6 +60,7 @@ def test_streaming_shuffle_preserves_rows(small_store_cluster):
     assert not np.array_equal(first, np.arange(500))
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_streaming_gb_scale_through_quarter_gb_store(small_store_cluster):
     """The VERDICT gate: ~1GB of data flows read->map->shuffle->iter through
     a 256MB store without overflowing it (32MB blocks x 32 = 1GiB)."""

@@ -124,6 +124,7 @@ def test_tuner_over_trainer(ray_start_regular):
     assert results.get_best_result().metrics["value"] == 6
 
 
+@pytest.mark.slow  # long-tail gate: nightly covers it (tier-1 budget)
 def test_tpe_searcher_beats_random_on_quadratic(ray_start_regular):
     """TPE should concentrate samples near the optimum of a smooth 1-D
     objective once past its random warmup (reference bar: the

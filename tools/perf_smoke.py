@@ -620,6 +620,108 @@ def run_zero_smoke(steps: int = STEPS, depth: int = DEPTH) -> dict:
         ray_tpu.shutdown()
 
 
+def run_mpmd_smoke(steps: int = 6, microbatches: int = 4) -> dict:
+    """MPMD pipeline invariants (tier-1 guard for ISSUE 10; tiny 2-stage
+    MLP pipeline, no timing thresholds):
+
+    1. **Cross-stage fwd/bwd overlap**: in some steady-state step, stage
+       0 was computing microbatch m+1 WHILE stage 1 was computing
+       microbatch m (wall-clock op intervals measured worker-side) — the
+       1F1B schedule genuinely parallelizes the stages.
+    2. **Zero driver syncs in steady state**: the streamed submit_step
+       path leaves mpmd_driver_sync_count() untouched (the driver only
+       wires refs; activations never visit it).
+    3. **Constant jit cache**: every stage's fwd/bwd/apply compile
+       exactly once — no per-microbatch retrace, ever.
+    4. **1F1B residual bound**: no stage ever holds more than
+       (num_stages - stage) microbatches of residuals.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.parallel import mpmd_pipeline as mp
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    try:
+        import jax.numpy as jnp
+        import optax
+
+        def _stage0(params, x):
+            import jax.numpy as jnp
+
+            return jnp.tanh(x @ params["w0"])
+
+        def _stage1_loss(params, h, target):
+            import jax.numpy as jnp
+
+            return jnp.mean((h @ params["w1"] - target) ** 2)
+
+        rng = np.random.default_rng(0)
+        p0 = {"w0": jnp.asarray(rng.normal(0, 0.3, (32, 64)), jnp.float32)}
+        p1 = {"w1": jnp.asarray(rng.normal(0, 0.3, (64, 8)), jnp.float32)}
+        x = rng.normal(size=(64, 32)).astype(np.float32)
+        t = rng.normal(size=(64, 8)).astype(np.float32)
+
+        pipe = mp.MPMDPipeline(
+            [_stage0, _stage1_loss], [p0, p1],
+            optimizer=optax.sgd(0.05), num_microbatches=microbatches,
+            step_window=2, drain_timeout=120.0)
+        syncs_before = mp.mpmd_driver_sync_count()
+        caches, overlap_steps, peaks = [], 0, {}
+        for _ in range(steps):
+            pipe.submit_step(x, t)
+            rep = pipe.last_step_report()
+            if rep is None:
+                continue
+            caches.append(rep["jit_cache"])
+        syncs = mp.mpmd_driver_sync_count() - syncs_before
+        results = pipe.flush()
+        # Tail reports (flush drains the window).
+        rep = pipe.last_step_report()
+        caches.append(rep["jit_cache"])
+
+        # Overlap: stage0 computing microbatch m+1 while stage1 computes
+        # m — compare the worker-stamped wall-clock intervals (same
+        # host).  Checked on the last drained step's op list.
+        ops = rep["ops"]
+        for m in range(microbatches - 1):
+            s0 = [o for o in ops[0] if o["mb"] == m + 1
+                  and o["kind"] in ("F", "B")]
+            s1 = [o for o in ops[1] if o["mb"] == m
+                  and o["kind"] in ("F", "B")]
+            if any(a["start"] < b["end"] and a["end"] > b["start"]
+                   for a in s0 for b in s1):
+                overlap_steps += 1
+        for k, peak in rep["peak_inflight"].items():
+            peaks[int(k)] = int(peak)
+        stats = pipe.stats()
+        pipe.stop()
+        out = {
+            "steps": steps,
+            "microbatches": microbatches,
+            "results_ok": len(results) == steps,
+            "driver_syncs_steady": syncs,
+            "overlap_pairs": overlap_steps,
+            "overlap_ok": overlap_steps >= 1,
+            "jit_cache_constant": caches[0] == caches[-1] and all(
+                size == 1 for st in caches[-1].values()
+                for size in st.values()),
+            "peak_inflight": peaks,
+            "inflight_bound_ok": all(
+                peak <= 2 - k for k, peak in peaks.items()),
+            "bubble_fraction": round(stats["bubble_fraction"] or 0.0, 4),
+        }
+        out["ok"] = bool(out["results_ok"]
+                         and out["driver_syncs_steady"] == 0
+                         and out["overlap_ok"]
+                         and out["jit_cache_constant"]
+                         and out["inflight_bound_ok"])
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def run_serving_smoke(max_new: int = 10) -> dict:
     """Continuous-batching inference invariants (tier-1 guard for
     ISSUE 8; one in-process engine "replica", no timing assertions):
@@ -700,8 +802,11 @@ def main() -> int:
     out["serving"] = sv
     zr = run_zero_smoke()
     out["zero"] = zr
+    mpmd = run_mpmd_smoke()
+    out["mpmd"] = mpmd
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
-                     and rpc["ok"] and nl["ok"] and sv["ok"] and zr["ok"])
+                     and rpc["ok"] and nl["ok"] and sv["ok"] and zr["ok"]
+                     and mpmd["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
