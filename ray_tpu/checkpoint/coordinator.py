@@ -12,9 +12,17 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.checkpoint import manifest as mf
+
+
+def _flow_token():
+    # Lazy: the parallel package init pulls jax, and checkpoint's tree
+    # plumbing is deliberately importable jax-free (checkpoint/tree.py).
+    from ray_tpu.parallel.flow import CancellationToken
+
+    return CancellationToken()
 
 
 def commit_when_complete(root: str, step: int, world_size: int,
@@ -49,12 +57,20 @@ def commit_when_complete(root: str, step: int, world_size: int,
 
 class AsyncCommitter:
     """Background commit threads for async sharded saves.  One commit per
-    step; ``flush()`` joins them and re-raises the first failure.  A gang
-    restart cancels pending commits (their writers died with the gang)."""
+    step; ``flush()`` joins them and re-raises the first failure.
+
+    Each commit thread carries a :class:`ray_tpu.parallel.flow.
+    CancellationToken`; ``cancel_pending()`` — wired into MeshGroup
+    restart hooks, so gang restart is ONE call — cancels every pending
+    step's token (the flow drain contract).  A cancelled commit wakes
+    from its poll immediately instead of sleeping it out, and a
+    cancelled-then-resaved step simply registers a FRESH token, so stale
+    cancellations can never suppress a replayed save."""
 
     def __init__(self):
-        self._threads: Dict[int, threading.Thread] = {}
-        self._cancelled: set = set()
+        # (thread, token) per step; a re-registered step replaces both.
+        self._pending: Dict[int, Tuple[threading.Thread,
+                                       CancellationToken]] = {}
         self._errors: List[BaseException] = []
         self._lock = threading.Lock()
 
@@ -63,20 +79,24 @@ class AsyncCommitter:
                      timeout: float = 120.0,
                      on_commit: Optional[Callable[[dict], None]] = None
                      ) -> None:
+        token = _flow_token()
+
         def run():
             try:
                 poll = 0.05
                 deadline = time.monotonic() + timeout
                 while True:
-                    with self._lock:
-                        if step in self._cancelled:
-                            return
                     if not mf.missing_rank_files(root, step, world_size):
                         break
                     if time.monotonic() > deadline:
                         raise TimeoutError(
                             f"checkpoint step {step} commit timed out")
-                    time.sleep(poll)
+                    # token.wait doubles as the poll sleep: a cancel (gang
+                    # restart killed the writers) wakes and exits NOW.
+                    if token.wait(poll):
+                        return
+                if token.cancelled:
+                    return
                 manifest = mf.commit_manifest(root, step, world_size,
                                               meta=meta)
                 # Sibling commits still pending (e.g. step N while we are
@@ -84,7 +104,7 @@ class AsyncCommitter:
                 # them from the sweep or we'd destroy a valid save in the
                 # window between its poll and its manifest rename.
                 with self._lock:
-                    pending = [s for s in self._threads if s != int(step)]
+                    pending = [s for s in self._pending if s != int(step)]
                 mf.gc_orphans(root, in_progress=pending, below=step)
                 if on_commit is not None:
                     on_commit(manifest)
@@ -95,33 +115,36 @@ class AsyncCommitter:
                 with self._lock:
                     # A cancelled-then-resaved step re-registers under the
                     # same key: only deregister if we still own it.
-                    if self._threads.get(int(step)) is t:
-                        self._threads.pop(int(step), None)
+                    entry = self._pending.get(int(step))
+                    if entry is not None and entry[0] is t:
+                        self._pending.pop(int(step), None)
 
         t = threading.Thread(target=run, daemon=True,
                              name=f"ckpt-commit-{step}")
         with self._lock:
             # A fresh save supersedes any stale cancellation of this step
             # (a restart can roll training back and replay through a step
-            # whose earlier save was cancelled).
-            self._cancelled.discard(int(step))
-            self._threads[int(step)] = t
+            # whose earlier save was cancelled): the fresh thread owns a
+            # fresh token the stale cancel never touched.
+            self._pending[int(step)] = (t, token)
         t.start()
 
     def cancel_pending(self) -> None:
         """Abandon uncommitted saves (e.g. after a gang restart killed the
         writers): their step dirs become orphans for the next GC."""
         with self._lock:
-            self._cancelled.update(self._threads.keys())
+            tokens = [tok for _, tok in self._pending.values()]
+        for tok in tokens:
+            tok.cancel()
 
     def pending_steps(self) -> List[int]:
         """Steps whose commit threads are still registered."""
         with self._lock:
-            return list(self._threads.keys())
+            return list(self._pending.keys())
 
     def flush(self, timeout: Optional[float] = None) -> None:
         with self._lock:
-            threads = list(self._threads.values())
+            threads = [t for t, _ in self._pending.values()]
         for t in threads:
             t.join(timeout)
         with self._lock:

@@ -1,12 +1,16 @@
 """Distributed Dataset on object-store blocks.
 
 Reference: python/ray/data/dataset.py:156 (Dataset), _internal/plan.py
-(lazy ExecutionPlan).  Round-1 engine is eager block-parallel (the
-reference's original bulk executor): every transform fans out one remote
-task per block and yields a new Dataset of result refs.  The streaming
-executor with backpressure (reference streaming_executor.py:31) is the
-round-2 upgrade; the ML-ingest path — read → map_batches → split →
-iter_batches with device prefetch — is complete here.
+(lazy ExecutionPlan).  Since the flow substrate landed, the per-block
+transforms (``map_batches``/``map``/``filter``) are LAZY plan ops
+(data/execution.py): nothing runs at call time, and the consuming
+iterators (``iter_batches``/``iter_device_batches``/``count``/``take``)
+drive the plan per-block through a bounded
+:class:`ray_tpu.parallel.flow.RefStream` — read→map→consume overlap with
+peak resident blocks capped by the window, byte-identical to the old
+eager engine (same per-block kernels, same order).  Whole-dataset
+operators (repartition/sort/split/zip/writes) still materialize the plan
+eagerly first — they are barriers by nature.
 """
 from __future__ import annotations
 
@@ -16,6 +20,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data import block as block_mod
+from ray_tpu.data import execution
 from ray_tpu.data.block import (
     apply_batch_fn,
     block_from_items,
@@ -33,20 +38,7 @@ def _map_block(blk, fn, batch_format):
 
 @ray_tpu.remote
 def _filter_block(blk, fn):
-    import pyarrow as pa
-    import pyarrow.compute as pc
-
-    if isinstance(fn, pc.Expression):
-        # Vectorized fast path: the predicate compiles to arrow compute
-        # kernels, no Python per row (reference: Dataset.filter(expr=...)).
-        return blk.filter(fn)
-    # Row UDF: evaluate over zipped column values — same contract, but no
-    # to_pylist() dict materialization per row.
-    cols = {name: blk.column(name).to_pylist() for name in blk.column_names}
-    names = list(cols)
-    mask = [bool(fn(dict(zip(names, vals))))
-            for vals in zip(*cols.values())] if names else []
-    return blk.filter(pa.array(mask, type=pa.bool_()))
+    return execution.apply_op(blk, ("filter", fn, None))
 
 
 @ray_tpu.remote
@@ -105,8 +97,32 @@ def _read_file(reader, path: str, columns=None):
 
 
 class Dataset:
-    def __init__(self, block_refs: List[Any]):
-        self._blocks = block_refs
+    """``sources`` holds object refs to materialized blocks and/or lazy
+    ``("read", reader, path, columns)`` descriptors; ``plan`` holds the
+    per-block ops not yet applied.  ``_blocks`` (the pre-substrate
+    internal contract, still used by grouped/sort) materializes the plan
+    eagerly and caches the resulting refs."""
+
+    def __init__(self, block_refs: List[Any],
+                 plan: Optional[List[execution.PlanOp]] = None):
+        self._sources: List[Any] = list(block_refs)
+        self._plan: List[execution.PlanOp] = list(plan or [])
+
+    @property
+    def _blocks(self) -> List[Any]:
+        """Materialized block refs: collapses lazy reads + pending plan
+        ops into store-resident blocks (the old eager engine's state)."""
+        if self._plan or any(execution.is_read_source(s)
+                             for s in self._sources):
+            self._sources = execution.PlanExecutor(
+                self._sources, self._plan).materialize_refs()
+            self._plan = []
+        return self._sources
+
+    def _executor(self, window: Optional[int] = None,
+                  name: str = "dataset") -> execution.PlanExecutor:
+        return execution.PlanExecutor(self._sources, self._plan,
+                                      window=window, name=name)
 
     # ---------------- creation ----------------
     @staticmethod
@@ -140,14 +156,18 @@ class Dataset:
         from ray_tpu.data.datasource import expand_paths, resolve_datasource
 
         reader = resolve_datasource(fmt)
-        return Dataset([_read_file.remote(reader, p, columns)
+        # Lazy read sources: no task runs until a consumer drives the
+        # plan, and then the read fuses with the chained per-block ops.
+        return Dataset([("read", reader, p, columns)
                         for p in expand_paths(paths)])
 
-    # ---------------- transforms ----------------
+    # ---------------- transforms (lazy plan ops) ----------------
+    def _with_op(self, op: execution.PlanOp) -> "Dataset":
+        return Dataset(self._sources, self._plan + [op])
+
     def map_batches(self, fn: Callable, batch_format: str = "numpy"
                     ) -> "Dataset":
-        return Dataset([_map_block.remote(b, fn, batch_format)
-                        for b in self._blocks])
+        return self._with_op(("map_batches", fn, batch_format))
 
     def map(self, fn: Callable) -> "Dataset":
         def row_fn(batch: dict):
@@ -158,15 +178,15 @@ class Dataset:
         return self.map_batches(row_fn, batch_format="numpy")
 
     def filter(self, fn: Callable) -> "Dataset":
-        return Dataset([_filter_block.remote(b, fn) for b in self._blocks])
+        return self._with_op(("filter", fn, None))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """Block-parallel repartition via a slice plan: each output block
         concatenates only the input slices it needs — no task ever holds
         the whole dataset (the previous global-concat form bounded the
         dataset by one worker's memory)."""
-        lengths = ray_tpu.get([_count_block.remote(b)
-                               for b in self._blocks])
+        blocks = self._blocks  # barrier: slice plan needs all lengths
+        lengths = ray_tpu.get([_count_block.remote(b) for b in blocks])
         total = int(sum(lengths))
         starts = np.cumsum([0] + lengths)  # input block i covers
         bounds = np.linspace(0, total, num_blocks + 1, dtype=int)
@@ -176,8 +196,7 @@ class Dataset:
             for i, (s, ln) in enumerate(zip(starts, lengths)):
                 lo, hi = max(a, s), min(b, s + ln)
                 if hi > lo:
-                    pieces.append((self._blocks[i], int(lo - s),
-                                   int(hi - s)))
+                    pieces.append((blocks[i], int(lo - s), int(hi - s)))
             if pieces:
                 out.append(_concat_slices.remote(
                     [p[1:] for p in pieces], *[p[0] for p in pieces]))
@@ -185,18 +204,29 @@ class Dataset:
                 # More output blocks than rows: an empty output must keep
                 # the dataset's SCHEMA (a 0-row slice of a real block), or
                 # schema()/iter_batches break on the placeholder type.
-                out.append(_slice_block.remote(self._blocks[0], 0, 0))
+                out.append(_slice_block.remote(blocks[0], 0, 0))
         return Dataset(out)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        def shuf(batch: dict):
-            n = len(next(iter(batch.values())))
-            idx = np.random.default_rng(seed).permutation(n)
+        """Block-local shuffle after a round-robin repartition (cheap
+        global mix; the streaming executor's push shuffle is the full-
+        radius form).  Per-block permutations are DECORRELATED: every
+        block derives its rng from ``(seed, block_index)`` through a
+        SeedSequence spawn — the old engine fed every block the identical
+        seed, so all blocks were permuted the same way — and
+        ``seed=None`` draws fresh OS entropy per call (irreproducible by
+        request, not by accident)."""
+        entropy = np.random.SeedSequence(seed).entropy
+
+        def shuf(batch: dict, block_index: int):
+            rng = np.random.default_rng(np.random.SeedSequence(
+                entropy=entropy, spawn_key=(int(block_index),)))
+            n = len(next(iter(batch.values()))) if batch else 0
+            idx = rng.permutation(n)
             return {k: v[idx] for k, v in batch.items()}
 
-        # Block-local shuffle after a round-robin repartition (cheap global
-        # mix; full push-based shuffle is the round-2 engine's job).
-        return self.repartition(len(self._blocks)).map_batches(shuf)
+        out = self.repartition(self.num_blocks())
+        return out._with_op(("map_batches_indexed", shuf, "numpy"))
 
     def split(self, n: int, equal: bool = True) -> List["Dataset"]:
         """Per-worker shards (reference: Dataset.split with locality hints →
@@ -234,39 +264,60 @@ class Dataset:
                 **{n: b.column(n) for n in b.column_names}}
         return Dataset([ray_tpu.put(pa.table(cols))])
 
-    # ---------------- consumption ----------------
-    def count(self) -> int:
-        return sum(ray_tpu.get([_count_block.remote(b) for b in self._blocks]))
+    # ---------------- consumption (drives the plan, windowed) -----------
+    def count(self, window: Optional[int] = None) -> int:
+        total = 0
+        for ref in self._executor(window, name="count").iter_count_refs():
+            total += int(ray_tpu.get(ref))
+            del ref
+        return total
 
-    def take(self, n: int = 20) -> List[dict]:
+    def take(self, n: int = 20, window: Optional[int] = None) -> List[dict]:
         out: List[dict] = []
-        for b in self._blocks:
-            blk = ray_tpu.get(b)
-            out.extend(blk.to_pylist()[: n - len(out)])
-            if len(out) >= n:
-                break
+        refs = self._executor(window, name="take").iter_block_refs()
+        try:
+            for ref in refs:
+                blk = ray_tpu.get(ref)
+                del ref
+                out.extend(blk.to_pylist()[: n - len(out)])
+                if len(out) >= n:
+                    break
+        finally:
+            refs.close()  # early exit: release the in-flight window
         return out
 
-    def take_all(self) -> List[dict]:
-        return [r for b in ray_tpu.get(self._blocks) for r in b.to_pylist()]
+    def take_all(self, window: Optional[int] = None) -> List[dict]:
+        return list(self.iter_rows(window=window))
 
     def schema(self):
-        return ray_tpu.get(self._blocks[0]).schema
+        # Only the first block is executed (plan ops preserve schema
+        # presence even on 0-row outputs).
+        ex = execution.PlanExecutor(self._sources[:1], self._plan,
+                                    window=1, name="schema")
+        for ref in ex.iter_block_refs():
+            return ray_tpu.get(ref).schema
+        raise ValueError("schema() on an empty dataset")
 
     def num_blocks(self) -> int:
-        return len(self._blocks)
+        return len(self._sources)
 
-    def iter_rows(self) -> Iterator[dict]:
-        for b in self._blocks:
-            yield from ray_tpu.get(b).to_pylist()
+    def iter_rows(self, window: Optional[int] = None) -> Iterator[dict]:
+        for ref in self._executor(window, name="rows").iter_block_refs():
+            blk = ray_tpu.get(ref)
+            del ref
+            yield from blk.to_pylist()
 
     def iter_batches(self, batch_size: int = 256, batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Batch]:
-        """Stream batches; blocks are fetched one ahead (prefetch)."""
+                     drop_last: bool = False,
+                     window: Optional[int] = None) -> Iterator[Batch]:
+        """Stream batches; the plan executes per-block with at most
+        ``window`` blocks in flight (read→map→consume overlap)."""
         carry: Optional[dict] = None
-        for b in self._blocks:
-            blk = ray_tpu.get(b)
+        for ref in self._executor(window, name="batches").iter_block_refs():
+            blk = ray_tpu.get(ref)
+            del ref  # release the store copy once rows are in-process
             batch = block_to_numpy(blk)
+            del blk
             if carry is not None:
                 batch = {k: np.concatenate([carry[k], batch[k]])
                          for k in batch}
@@ -282,7 +333,8 @@ class Dataset:
             yield _format(carry, batch_format)
 
     def iter_device_batches(self, batch_size: int = 256, sharding=None,
-                            prefetch: int = 2) -> Iterator[Any]:
+                            prefetch: int = 2,
+                            window: Optional[int] = None) -> Iterator[Any]:
         """ML-ingest hot path: host batches → jax.device_put (optionally
         sharded over a mesh) on a BACKGROUND thread feeding a bounded
         queue, so the store fetch + H2D transfer overlap the consumer's
@@ -291,21 +343,27 @@ class Dataset:
         old inline path; see ray_tpu.data.prefetch.DevicePrefetcher."""
         from ray_tpu.data.prefetch import DevicePrefetcher
 
-        return DevicePrefetcher(self.iter_batches(batch_size, "numpy"),
-                                sharding=sharding, prefetch=prefetch)
+        return DevicePrefetcher(
+            self.iter_batches(batch_size, "numpy", window=window),
+            sharding=sharding, prefetch=prefetch)
 
     def materialize(self) -> "Dataset":
-        ray_tpu.wait(self._blocks, num_returns=len(self._blocks))
+        blocks = self._blocks  # collapse lazy reads + pending plan ops
+        ray_tpu.wait(blocks, num_returns=len(blocks))
         return self
 
     def streaming(self, store_budget: Optional[int] = None,
                   max_inflight_blocks: Optional[int] = None):
-        """Switch to the bounded-memory streaming executor over this
-        dataset's blocks (ray_tpu.data.streaming.StreamingDataset)."""
+        """Switch to the bounded-memory streaming executor
+        (ray_tpu.data.streaming.StreamingDataset).  The pending plan
+        carries over verbatim — ops are the same tuples both engines
+        execute."""
         from ray_tpu.data.streaming import StreamingDataset
 
-        thunks = [(lambda r=r: r) for r in self._blocks]
-        return StreamingDataset(thunks, store_budget=store_budget,
+        sources = [s if execution.is_read_source(s) else (lambda r=s: r)
+                   for s in self._sources]
+        return StreamingDataset(sources, stages=list(self._plan),
+                                store_budget=store_budget,
                                 max_inflight_blocks=max_inflight_blocks)
 
     # ---------------- writes (reference: Dataset.write_parquet/csv/json,
@@ -342,7 +400,7 @@ class Dataset:
         return self._write(path, "json", "json", mode)
 
     def stats(self) -> dict:
-        return {"num_blocks": len(self._blocks), "count": self.count()}
+        return {"num_blocks": len(self._sources), "count": self.count()}
 
 
 Batch = Union[Dict[str, np.ndarray], Any]

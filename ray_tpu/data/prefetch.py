@@ -11,7 +11,12 @@ of batch N+1..N+prefetch overlaps the consumer's compute on batch N
 python/ray/data/dataset_iterator.py; the Podracer "keep the device fed"
 rule, arXiv:2104.06272).
 
-Contract:
+Since the flow substrate landed this is a thin wrapper over one
+:class:`ray_tpu.parallel.flow.Stage` — the bounded queue, producer
+thread, error propagation and close/drain semantics all come from flow;
+only the ``device_put`` placement policy lives here.
+
+Contract (unchanged from the hand-rolled version):
 
 - ``prefetch=0`` degrades to the old inline behavior — no thread, the
   consumer pays the device_put (useful for debugging and as the
@@ -23,24 +28,14 @@ Contract:
   and joins the producer thread deterministically — no leaked threads,
   even when the producer is blocked on a full queue.
 - Queue occupancy and batch counts export through ray_tpu.util.metrics
-  (best-effort; skipped where no driver is connected) and per-batch H2D
-  spans land in the ray_tpu._private.profiling span recorder.
+  (both the legacy ``data_prefetch_*`` names and the substrate's tagged
+  ``flow_*`` series; best-effort, skipped where no driver is connected)
+  and per-batch H2D spans land in the ray_tpu._private.profiling span
+  recorder as ``prefetch_h2d``.
 """
 from __future__ import annotations
 
-import queue
-import threading
-import time
 from typing import Any, Callable, Iterable, Iterator, Optional
-
-
-class _EndOfStream:
-    """Producer→consumer sentinel; carries the producer's exception (or
-    None for a clean end of stream)."""
-    __slots__ = ("error",)
-
-    def __init__(self, error: Optional[BaseException] = None):
-        self.error = error
 
 
 def _make_place_fn(sharding, place_fn):
@@ -57,50 +52,6 @@ def _make_place_fn(sharding, place_fn):
     return place
 
 
-def _bounded_put(q: "queue.Queue", stop: threading.Event, item) -> bool:
-    """Bounded-queue put that aborts promptly on close() — the producer
-    must never be stranded on a full queue the consumer abandoned."""
-    while not stop.is_set():
-        try:
-            q.put(item, timeout=0.1)
-            return True
-        except queue.Full:
-            continue
-    return False
-
-
-def _produce(src, q: "queue.Queue", stop: threading.Event, place):
-    """Producer thread body.  Deliberately a MODULE-LEVEL function taking
-    its state as arguments: a bound-method target would make the running
-    thread keep the DevicePrefetcher alive, so consumer-side GC could
-    never trigger __del__/close and the thread would leak."""
-    from ray_tpu._private import profiling
-
-    error: Optional[BaseException] = None
-    try:
-        for batch in src:
-            if stop.is_set():
-                return
-            t0 = time.perf_counter()
-            dev = place(batch)
-            profiling.record_span("prefetch_h2d", t0, time.perf_counter())
-            if not _bounded_put(q, stop, dev):
-                return
-    except BaseException as e:  # noqa: BLE001 — shipped to consumer
-        error = e
-    finally:
-        # The producer thread owns the source iterator: release its
-        # upstream resources (object-store refs held by the block
-        # iterator) here, where the generator is not mid-execution.
-        close = getattr(src, "close", None)
-        if close is not None:
-            try:
-                close()
-            except Exception:
-                pass
-        _bounded_put(q, stop, _EndOfStream(error))
-
-
 class DevicePrefetcher(Iterator[Any]):
     """Iterator of device-resident batches with background H2D transfer.
 
@@ -115,83 +66,36 @@ class DevicePrefetcher(Iterator[Any]):
                  prefetch: int = 2,
                  place_fn: Optional[Callable[[Any], Any]] = None,
                  name: str = "device-prefetch"):
-        self._src = iter(host_batches)
-        self._place = _make_place_fn(sharding, place_fn)
+        from ray_tpu.parallel.flow import Stage  # lazy: parallel pulls jax
+
         self.prefetch = int(prefetch)
-        self._count = 0
-        self._peak_occupancy = 0
-        self._end: Optional[_EndOfStream] = None
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self._q: Optional["queue.Queue"] = None
-        if self.prefetch > 0:
-            self._q = queue.Queue(maxsize=self.prefetch)
-            self._thread = threading.Thread(
-                target=_produce, args=(self._src, self._q, self._stop,
-                                       self._place),
-                daemon=True, name=f"rtpu-{name}")
-            self._thread.start()
+        self._stage = Stage(
+            host_batches, _make_place_fn(sharding, place_fn),
+            depth=max(1, self.prefetch),
+            workers=1 if self.prefetch > 0 else 0,
+            name=name, span="prefetch_h2d",
+            # flow's throttled export is kept; the legacy gauge names are
+            # exported once at end-of-stream/close below.
+            export_metrics=True)
+        self._exported = False
 
     # ---- consumer side ----
     def __iter__(self) -> "DevicePrefetcher":
         return self
 
     def __next__(self):
-        if self._end is not None:
-            self._raise_end()
-        if self.prefetch <= 0:
-            try:
-                batch = next(self._src)
-            except StopIteration:
-                self._end = _EndOfStream()
-                self._export_metrics()
-                raise
-            dev = self._place(batch)
-            self._count += 1
-            return dev
-        while True:
-            self._peak_occupancy = max(self._peak_occupancy,
-                                       self._q.qsize())
-            try:
-                item = self._q.get(timeout=0.5)
-            except queue.Empty:
-                if self._thread is not None and not self._thread.is_alive():
-                    # Defensive: the producer always enqueues a sentinel in
-                    # its finally, so this means the thread was killed hard.
-                    self._end = _EndOfStream(
-                        RuntimeError("prefetch producer thread died"))
-                    self._raise_end()
-                continue
-            if isinstance(item, _EndOfStream):
-                self._end = item
-                self._export_metrics()
-                self._raise_end()
-            self._count += 1
-            return item
-
-    def _raise_end(self):
-        if self._end.error is not None:
-            raise self._end.error
-        raise StopIteration
+        try:
+            return next(self._stage)
+        except BaseException:
+            self._export_metrics()
+            raise
 
     # ---- lifecycle ----
     def close(self):
         """Stop the producer and join its thread.  Idempotent; safe to
         call mid-stream (pending device batches are dropped)."""
-        self._stop.set()
-        if self._q is not None:
-            # Unblock a producer waiting on a full queue.
-            while True:
-                try:
-                    self._q.get_nowait()
-                except queue.Empty:
-                    break
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
-        if self._end is None:
-            self._end = _EndOfStream()
-            self._export_metrics()
+        self._stage.close()
+        self._export_metrics()
 
     def __del__(self):
         try:
@@ -205,24 +109,35 @@ class DevicePrefetcher(Iterator[Any]):
     def __exit__(self, exc_type, exc_val, tb):
         self.close()
 
+    # ---- observability ----
+    @property
+    def _thread(self):
+        """The producer thread (None once joined / in inline mode) —
+        part of the de-facto API: tests assert its lifecycle."""
+        threads = self._stage.worker_threads
+        return threads[0] if threads else None
+
     @property
     def peak_occupancy(self) -> int:
-        return self._peak_occupancy
+        return self._stage.peak_occupancy
 
     @property
     def batches_delivered(self) -> int:
-        return self._count
+        return self._stage.items_delivered
 
     def _export_metrics(self):
+        if self._exported:
+            return
+        self._exported = True
         try:
             from ray_tpu.util.metrics import Counter, Gauge
 
             Counter("data_prefetch_batches_total",
                     "device batches delivered by the prefetch queue"
-                    ).inc(self._count)
+                    ).inc(self.batches_delivered)
             Gauge("data_prefetch_queue_peak",
                   "peak occupancy of the device prefetch queue"
-                  ).set(float(self._peak_occupancy))
+                  ).set(float(self.peak_occupancy))
         except Exception:
             pass  # no connected driver (e.g. bare worker process)
 

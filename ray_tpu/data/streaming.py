@@ -29,6 +29,7 @@ Design (TPU-first, driver-light):
 """
 from __future__ import annotations
 
+import itertools
 import random
 from typing import Any, Callable, Iterator, List, Optional
 
@@ -36,35 +37,25 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data import block as block_mod
-
-
-def _apply_stage_local(blk, kind: str, fn, batch_format: str):
-    if kind == "map_batches":
-        return block_mod.apply_batch_fn(blk, fn, batch_format)
-    if kind == "filter":
-        import pyarrow as pa
-
-        mask = [bool(fn(row)) for row in blk.to_pylist()]
-        return blk.filter(pa.array(mask))
-    raise ValueError(kind)
+from ray_tpu.data import execution
 
 
 @ray_tpu.remote
-def _apply_stage(blk, kind: str, fn, batch_format: str):
-    return _apply_stage_local(blk, kind, fn, batch_format)
+def _apply_stage(blk, op, block_index):
+    # The SAME per-block kernel the eager plan executor runs
+    # (data/execution.py): streaming results are byte-identical to eager
+    # ones by construction.
+    return execution.apply_op(blk, op, block_index)
 
 
 @ray_tpu.remote
-def _fused_read_apply(reader, path: str, columns, stages):
+def _fused_read_apply(reader, path: str, columns, stages, block_index):
     """Operator fusion (the logical optimizer's one rewrite that matters
     for this executor): read + every chained per-block stage execute in
     ONE task, so a read→map→filter pipeline costs one store write per
     block instead of one per stage (reference: the Read→MapBatches fusion
     in data/_internal/logical/optimizers.py)."""
-    blk = reader(path, columns)
-    for kind, fn, batch_format in stages:
-        blk = _apply_stage_local(blk, kind, fn, batch_format)
-    return blk
+    return execution.apply_ops(reader(path, columns), stages, block_index)
 
 
 @ray_tpu.remote
@@ -209,17 +200,18 @@ class StreamingDataset:
         # Half the budget: map stages briefly hold input+output per block.
         return max(2, int(self.store_budget * 0.5 // max(1, info["size"])))
 
-    def _chain_source(self, src):
+    def _chain_source(self, src, block_index: int = 0):
         """Materialize one source with every per-block stage applied:
         structured read sources fuse read+stages into ONE task; opaque
         thunks fall back to a task per stage."""
         stages = self._per_block_stages
         if isinstance(src, tuple) and src[0] == "read":
             _, reader, path, columns = src
-            return _fused_read_apply.remote(reader, path, columns, stages)
+            return _fused_read_apply.remote(reader, path, columns, stages,
+                                            block_index)
         ref = src()
-        for kind, fn, batch_format in stages:
-            ref = _apply_stage.remote(ref, kind, fn, batch_format)
+        for op in stages:
+            ref = _apply_stage.remote(ref, op, block_index)
         return ref
 
     @property
@@ -233,74 +225,72 @@ class StreamingDataset:
                 if s[0] in ("shuffle", "push_shuffle")]
 
     def iter_block_refs(self) -> Iterator[Any]:
-        """The executor: yields output block refs, ≤ window in flight.
+        """The executor: yields output block refs, ≤ window in flight
+        (a :class:`ray_tpu.parallel.flow.RefStream` holds the bound —
+        the hand-rolled window-fill loop this method used to carry).
         The caller must drop each yielded ref to release its memory."""
+        from ray_tpu.parallel import flow  # lazy: keeps data jax-free
+
         shuffles = self._shuffle_stages
-        pending: List[Any] = []
-        window: Optional[int] = None
-        sources = iter(self._sources)
-        first = next(sources, None)
+        indexed = iter(enumerate(self._sources))
+        first = next(indexed, None)
         if first is None:
             return
-        first_ref = self._chain_source(first)
+        first_ref = self._chain_source(first[1], first[0])
         # Measure the first (fused) output block to size the window.
         ray_tpu.wait([first_ref], num_returns=1, timeout=300)
         window = self._window_size(first_ref)
-        pending.append(first_ref)
+        thunks = (lambda s=s, i=i: self._chain_source(s, i)
+                  for i, s in indexed)
+        stream = flow.RefStream(thunks, depth=window, prime=[first_ref],
+                                name="streaming_data")
         del first_ref
-
-        def fill():
-            while len(pending) < window:
-                src = next(sources, None)
-                if src is None:
-                    return False
-                pending.append(self._chain_source(src))
-            return True
-
-        if shuffles and shuffles[0][0] == "push_shuffle":
-            yield from self._push_shuffle_refs(pending, sources, window,
-                                               shuffles[0][1])
-            return
-        if not shuffles:
-            fill()
-            while pending:
-                ref = pending.pop(0)
-                yield ref
-                del ref
-                fill()
-            return
-        # Shuffle: process window-sized groups through the two-phase
-        # exchange; outputs stream out under the same in-flight bound.
-        seed_base = shuffles[0][1]
-        rng = random.Random(seed_base)
-        group_idx = 0
-        while True:
-            fill()
-            if not pending:
+        try:
+            if shuffles and shuffles[0][0] == "push_shuffle":
+                yield from self._push_shuffle_refs(stream, window,
+                                                   shuffles[0][1])
                 return
-            group, pending = pending, []
-            p = len(group)
-            seed0 = (seed_base if seed_base is not None
-                     else rng.randrange(2**31))
-            parted = [
-                _partition_block.options(num_returns=p).remote(
-                    b, p, seed0 + group_idx * 100003 + i)
-                for i, b in enumerate(group)]
-            if p == 1:
-                parted = [[r] for r in parted]
-            del group
-            outs = [
-                _combine_parts.remote(seed0 + 7 + group_idx * 100003 + j,
-                                      *[parted[i][j] for i in range(p)])
-                for j in range(p)]
-            del parted
-            for ref in outs:
-                yield ref
-                del ref
-            outs = None
-            group_idx += 1
+            if not shuffles:
+                for ref in stream:
+                    yield ref
+                    del ref
+                return
+            # Shuffle: process window-sized groups through the two-phase
+            # exchange; outputs stream out under the same in-flight bound.
+            seed_base = shuffles[0][1]
+            rng = random.Random(seed_base)
+            group_idx = 0
+            while True:
+                group = list(itertools.islice(stream, window))
+                if not group:
+                    return
+                p = len(group)
+                seed0 = (seed_base if seed_base is not None
+                         else rng.randrange(2**31))
+                parted = [
+                    _partition_block.options(num_returns=p).remote(
+                        b, p, seed0 + group_idx * 100003 + i)
+                    for i, b in enumerate(group)]
+                if p == 1:
+                    parted = [[r] for r in parted]
+                del group
+                outs = [
+                    _combine_parts.remote(
+                        seed0 + 7 + group_idx * 100003 + j,
+                        *[parted[i][j] for i in range(p)])
+                    for j in range(p)]
+                del parted
+                for ref in outs:
+                    yield ref
+                    del ref
+                outs = None
+                group_idx += 1
+        finally:
+            # Abandoned iteration (dead consumer, early break) releases
+            # every in-flight ref — the flow drain contract.
+            stream.close()
 
-    def _push_shuffle_refs(self, pending, sources, window, seed_base):
+    def _push_shuffle_refs(self, stream, window, seed_base):
         """Push-based FULL shuffle (reference: push_based_shuffle.py's
         pipelined map+merge rounds).  Map tasks partition each block into
         P parts; after every window-sized round the parts FOLD into P
@@ -338,12 +328,7 @@ class StreamingDataset:
                 ray_tpu.wait(folded, num_returns=len(folded), timeout=600)
 
         while True:
-            batch, pending = list(pending), []
-            while len(batch) < window:
-                src = next(sources, None)
-                if src is None:
-                    break
-                batch.append(self._chain_source(src))
+            batch = list(itertools.islice(stream, window))
             if not batch:
                 break
             for b in batch:

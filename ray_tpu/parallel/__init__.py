@@ -29,6 +29,12 @@ from ray_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from ray_tpu.parallel.flow import (  # noqa: F401
+    CancellationToken,
+    RefStream,
+    Stage,
+    Window,
+)
 from ray_tpu.parallel.mesh_group import (  # noqa: F401
     MeshGroup,
     StepPipeline,

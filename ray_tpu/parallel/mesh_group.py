@@ -104,12 +104,12 @@ tests/test_mesh_group.py (pipeline semantics: tests/test_step_pipeline.py).
 """
 from __future__ import annotations
 
-import collections
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import ray_tpu
 from ray_tpu import exceptions as exc
+from ray_tpu.parallel import flow
 
 # Errors that poison the gang (vs. a user exception raised by fn, which is
 # re-raised as-is: the worker is alive and a restart would not help).
@@ -462,55 +462,11 @@ def _restart_metrics():
                     "failed MeshGroup gang-restart attempts"))
 
 
-class InflightWindow:
-    """Bounded window of dispatched-but-undrained work — the backpressure
-    primitive under both the step pipeline (gang-wide steps, below) and
-    the rollout plane's per-worker fragment streams
-    (rllib/evaluation/sample_stream.py): items append at dispatch,
-    ``over_depth`` tells the owner to drain the oldest before dispatching
-    more, so the producer side always holds queued work while the
-    consumer touches a result."""
-
-    __slots__ = ("depth", "_items")
-
-    def __init__(self, depth: int):
-        if depth < 1:
-            raise ValueError(f"window depth must be >= 1, got {depth}")
-        self.depth = depth
-        self._items: collections.deque = collections.deque()
-
-    def append(self, item) -> None:
-        self._items.append(item)
-
-    def popleft(self):
-        return self._items.popleft()
-
-    def peek(self):
-        return self._items[0]
-
-    def remove(self, item) -> None:
-        self._items.remove(item)
-
-    def clear(self) -> list:
-        out, self._items = list(self._items), collections.deque()
-        return out
-
-    @property
-    def over_depth(self) -> bool:
-        return len(self._items) > self.depth
-
-    @property
-    def full(self) -> bool:
-        return len(self._items) >= self.depth
-
-    def __len__(self) -> int:
-        return len(self._items)
-
-    def __iter__(self):
-        return iter(self._items)
-
-    def __bool__(self) -> bool:
-        return bool(self._items)
+# The bounded in-flight window primitive was extracted to the shared
+# dataflow substrate (parallel/flow.py) along with the rest of the
+# backpressure/drain machinery; re-exported here because the step
+# pipeline's public docs and downstream code name it InflightWindow.
+InflightWindow = flow.Window
 
 
 class _InflightStep:

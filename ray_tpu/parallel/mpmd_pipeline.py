@@ -66,7 +66,8 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu import exceptions as exc
-from ray_tpu.parallel.mesh_group import InflightWindow, gang_get
+from ray_tpu.parallel.flow import Window as InflightWindow
+from ray_tpu.parallel.mesh_group import gang_get
 
 # Blocking driver↔stage syncs on the LOCKSTEP dispatch paths
 # (train_step / get_params).  The async streaming path — submit_step +

@@ -9,8 +9,9 @@ rollout actors:
 
 - **Streaming production** — every worker holds up to
   ``max_in_flight_per_worker`` queued ``sample_fragment`` calls (a
-  per-worker :class:`~ray_tpu.parallel.mesh_group.InflightWindow`, the
-  same bounded-window backpressure primitive as the mesh StepPipeline).
+  per-worker :class:`~ray_tpu.parallel.flow.Window`, the shared
+  bounded-window backpressure primitive under the mesh StepPipeline and
+  the whole dataflow substrate).
   The actor mailbox is FIFO, so a worker finishes one fragment and rolls
   straight into the next with no driver round trip in between; the
   learner consumes fragments as they land via :meth:`next_fragment`.
@@ -42,7 +43,7 @@ import time
 from typing import Any, Dict, List, NamedTuple, Optional
 
 import ray_tpu
-from ray_tpu.parallel.mesh_group import InflightWindow
+from ray_tpu.parallel.flow import CancellationToken, Window
 
 
 class Fragment(NamedTuple):
@@ -106,11 +107,15 @@ class SampleStream:
         self.kind = kind
         self.depth = int(max_in_flight_per_worker)
         self.max_weight_staleness = max_weight_staleness
-        self._windows: Dict[int, InflightWindow] = {
-            i: InflightWindow(self.depth)
+        self._windows: Dict[int, Window] = {
+            i: Window(self.depth)
             for i in range(len(workers.workers))
         }
-        self._closed = False
+        # One flow cancellation token governs the stream's lifetime: the
+        # owner (or a supervisor's restart hook) cancels it once and every
+        # in-flight window drains (docs/FAULT_TOLERANCE.md).
+        self.token = CancellationToken()
+        self.token.on_cancel(self._drop_all_windows)
         # --- stats (driver-local; stats() snapshots them) ---
         self._t0 = time.monotonic()
         self.fragments_consumed = 0
@@ -164,6 +169,10 @@ class SampleStream:
             except Exception:
                 pass
 
+    def _drop_all_windows(self) -> None:
+        for i in list(self._windows):
+            self._drop_window(i)
+
     @property
     def inflight(self) -> int:
         return sum(len(w) for w in self._windows.values())
@@ -173,7 +182,7 @@ class SampleStream:
         """Block until the next fragment lands (refilling windows so
         production never drains), apply the staleness gate, and return it.
         Returns None when ``timeout`` elapses with nothing consumable."""
-        if self._closed:
+        if self.token.cancelled:
             raise RuntimeError("SampleStream is closed")
         deadline = None if timeout is None else time.monotonic() + timeout
         t_wait0 = time.perf_counter()
@@ -272,12 +281,11 @@ class SampleStream:
 
     def close(self) -> None:
         """Abandon all in-flight fragments (the workers' queued fragments
-        finish and are garbage-collected unseen)."""
-        if self._closed:
+        finish and are garbage-collected unseen).  One token cancel — the
+        window drop rides the flow token's on_cancel hook."""
+        if self.token.cancelled:
             return
-        self._closed = True
-        for i in list(self._windows):
-            self._drop_window(i)
+        self.token.cancel()
         if self._metrics is not None:
             for m in self._metrics.values():
                 flush = getattr(m, "flush", None)

@@ -6,6 +6,7 @@ import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 
 from tools.perf_smoke import (
     run_checkpoint_smoke,
+    run_flow_smoke,
     run_mpmd_smoke,
     run_node_loss_smoke,
     run_object_plane_smoke,
@@ -116,6 +117,36 @@ def test_mpmd_smoke(shutdown_only):
     assert out["jit_cache_constant"], f"stage program retraced: {out}"
     assert out["inflight_bound_ok"], f"1F1B bound violated: {out}"
     assert out["ok"], out
+
+
+def test_flow_smoke(shutdown_only):
+    """Streaming Dataset execution on the flow substrate must genuinely
+    stream — a later block read (worker wall-clock stamps) overlaps an
+    earlier block's consume — while the RefStream holds at most `window`
+    blocks in flight, results byte-match the eager engine, and the loop
+    performs zero driver syncs (the tier-1 guard for ISSUE 11's async
+    dataflow substrate)."""
+    out = run_flow_smoke()
+    assert out["exact_results"], f"streaming diverged from eager: {out}"
+    assert out["residency_ok"], f"window bound violated: {out}"
+    assert out["produce_consume_overlap"], f"stage barrier regression: {out}"
+    assert out["driver_syncs"] == 0, out
+    assert out["ok"], out
+
+
+def test_flow_usage_static_check():
+    """No NEW hand-rolled threading.Thread+queue.Queue pipeline outside
+    flow.py/_private, and the not-yet-migrated allowlist only shrinks —
+    the CI guard that keeps the dataflow substrate the single copy."""
+    from tools.check_flow_usage import scan
+
+    result = scan()
+    assert not result["violations"], (
+        "hand-rolled pipeline outside flow.py — build it on "
+        f"ray_tpu.parallel.flow instead: {result['violations']}")
+    assert not result["stale_allowlist"], (
+        "allowlist entries no longer hand-roll pipelines — remove them "
+        f"from tools/check_flow_usage.py: {result['stale_allowlist']}")
 
 
 def test_node_loss_smoke(shutdown_only):

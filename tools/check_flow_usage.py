@@ -1,0 +1,109 @@
+"""Static check: hand-rolled bounded-queue pipelines belong in flow.py.
+
+The async dataflow substrate (ray_tpu/parallel/flow.py) exists precisely
+because this repo grew six hand-rolled copies of the same
+thread+bounded-queue/backpressure/drain pattern.  This check keeps the
+count monotonically SHRINKING: any ray_tpu module (outside ``_private``
+runtime plumbing and ``flow.py`` itself) that pairs ``threading.Thread``
+with a ``queue.Queue`` is flagged as a hand-rolled pipeline unless it is
+on the explicit allowlist of not-yet-migrated copies.
+
+- A NEW combo outside the allowlist fails the check: build it on
+  ``flow.Stage``/``flow.RefStream`` instead (docs/PERFORMANCE.md, "Async
+  dataflow substrate").
+- An allowlisted file that no longer matches also fails: remove the
+  stale entry, so the list can only shrink.
+
+Run standalone (``python tools/check_flow_usage.py``) or through the
+tier-1 wrapper in tests/test_perf_smoke.py.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Hand-rolled thread+queue pipelines that predate flow.py and have not
+# been migrated yet.  DO NOT add entries: new code uses the substrate.
+# When one of these is rebased on flow primitives, delete its line (the
+# check fails on stale entries to force that).
+ALLOWLIST = {
+    # replica-side request batcher: asyncio/thread bridge, pre-substrate
+    "ray_tpu/serve/batching.py",
+    # continuous-batching engine loop: admission queue + decode thread
+    "ray_tpu/serve/llm_engine.py",
+    # train worker-group result plumbing
+    "ray_tpu/train/_internal/worker_group.py",
+    # tune trial-runner event queue
+    "ray_tpu/tune/execution/trial_runner.py",
+}
+
+# Runtime plumbing exempt from the operator-core rule: the transport /
+# store / head loops are message routers, not item pipelines, and
+# flow.py itself implements the substrate.
+EXEMPT_PREFIXES = ("ray_tpu/_private/",)
+EXEMPT_FILES = {"ray_tpu/parallel/flow.py"}
+
+_THREAD_RE = re.compile(r"\bthreading\.Thread\s*\(")
+_QUEUE_RE = re.compile(r"\bqueue\.Queue\b|\bQueue\s*\(\s*maxsize")
+
+
+def _iter_py_files() -> List[str]:
+    out = []
+    pkg_root = os.path.join(REPO_ROOT, "ray_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        for fn in filenames:
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                out.append(os.path.relpath(path, REPO_ROOT))
+    return sorted(out)
+
+
+def scan() -> Dict[str, List[str]]:
+    """Returns {"violations": [...], "stale_allowlist": [...],
+    "flagged": [...]}."""
+    flagged = []
+    for rel in _iter_py_files():
+        posix = rel.replace(os.sep, "/")
+        if posix in EXEMPT_FILES or \
+                any(posix.startswith(p) for p in EXEMPT_PREFIXES):
+            continue
+        try:
+            with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        if _THREAD_RE.search(text) and _QUEUE_RE.search(text):
+            flagged.append(posix)
+    flagged_set = set(flagged)
+    return {
+        "flagged": sorted(flagged),
+        "violations": sorted(flagged_set - ALLOWLIST),
+        "stale_allowlist": sorted(ALLOWLIST - flagged_set),
+    }
+
+
+def main() -> int:
+    result = scan()
+    ok = not result["violations"] and not result["stale_allowlist"]
+    for path in result["violations"]:
+        print(f"FLOW-USAGE VIOLATION: {path} pairs threading.Thread with "
+              "a bounded queue.Queue — build the pipeline on "
+              "ray_tpu.parallel.flow (Stage/RefStream) instead, or "
+              "(migrations only) discuss an allowlist entry.")
+    for path in result["stale_allowlist"]:
+        print(f"STALE ALLOWLIST ENTRY: {path} no longer hand-rolls a "
+              "thread+queue pipeline — remove it from "
+              "tools/check_flow_usage.py so the list keeps shrinking.")
+    if ok:
+        print(f"flow-usage check OK: {len(result['flagged'])} "
+              f"known hand-rolled pipelines remain "
+              f"({', '.join(result['flagged']) or 'none'})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
