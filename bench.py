@@ -400,6 +400,129 @@ def bench_gpt2_pipeline() -> dict:
         ray_tpu.shutdown()
 
 
+def bench_llama_3d() -> dict:
+    """Composed 3D-parallelism bench (ISSUE 12 acceptance): a GQA Llama
+    trained pipeline x intra-stage SPMD x ZeRO through MeshGroup-hosted
+    stage workers, three legs at IDENTICAL (stages, microbatches,
+    config):
+
+    - v=1, fp32 wire — the PR 10-shaped non-interleaved baseline;
+    - v=2, fp32 wire — interleaved virtual stages: measured bubble
+      fraction must drop below the v=1 leg;
+    - v=2, int8 wire — EQuARX block-scaled activations/cotangents:
+      wire bytes/step must drop >= 3.5x below the fp32 legs.
+
+    Model size adapts to the box: ``RTPU_BENCH_LLAMA_FULL=1`` runs the
+    real ``llama_1b()`` (22L/2048d GQA, ~1.1B params — multi-chip
+    hosts); the default is a width/depth-scaled GQA config so the CPU
+    dev box finishes in minutes.  All legs share config and platform, so
+    the bubble/wire comparisons stay apples-to-apples."""
+    import numpy as np
+
+    import ray_tpu
+
+    out: dict = {}
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    try:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.llama import LlamaConfig, split_stages
+        from ray_tpu.parallel import mpmd_pipeline as mp
+
+        kind = jax.devices()[0].device_kind
+        full = os.environ.get("RTPU_BENCH_LLAMA_FULL") == "1"
+        if full:
+            cfg = LlamaConfig.llama_1b(dtype=jnp.float32)
+            B, S, M, iters = 8, 1024, 8, 4
+        else:
+            cfg = LlamaConfig(vocab_size=4096, max_position_embeddings=512,
+                              num_layers=8, num_heads=8, num_kv_heads=4,
+                              hidden_size=256, dtype=jnp.float32)
+            B, S, M, iters = 16, 128, 8, 4
+        spmd = 2
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+        tx = optax.adamw(3e-4)
+
+        def run_leg(v, wire):
+            stage_fns, init_fns = split_stages(cfg, 2, virtual_per_rank=v)
+            pipe = mp.MPMDPipeline(
+                stage_fns, init_fns, optimizer=tx, num_microbatches=M,
+                virtual_per_rank=v, wire_dtype=wire, step_window=2,
+                drain_timeout=2400.0, gang_hosts=1, gang_platform="cpu",
+                gang_local_device_count=spmd,
+                stage_options=[
+                    {"spmd_devices": spmd, "zero_sharding": "opt+grads"},
+                    {"spmd_devices": spmd, "zero_sharding": "opt+grads"}])
+            pipe.train_step(ids, ids)  # compile + warmup
+            wire0 = pipe.stats()["wire_bytes"]
+            syncs0 = mp.mpmd_driver_sync_count()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                pipe.submit_step(ids, ids)
+            losses = pipe.flush()
+            dt = time.perf_counter() - t0
+            stats = pipe.stats()
+            pipe.stop()
+            return {
+                "tokens_per_s": iters * B * S / dt,
+                "loss": losses[-1][1],
+                "bubble": stats["bubble_fraction"],
+                "wire_bytes_per_step": (stats["wire_bytes"] - wire0)
+                / iters,
+                "driver_syncs": mp.mpmd_driver_sync_count() - syncs0,
+            }
+
+        base = run_leg(1, "fp32")
+        inter = run_leg(2, "fp32")
+        quant = run_leg(2, "int8")
+
+        fpt = 6 * cfg.n_params + 12 * cfg.num_layers * cfg.hidden_size * S
+        peak = peak_flops_for(kind)
+        # Wire comparison at IDENTICAL config: the two v=2 legs (v=1
+        # crosses 3x fewer chunk boundaries per microbatch, so comparing
+        # across v would understate the int8 win).
+        wire_ratio = inter["wire_bytes_per_step"] / max(
+            1.0, quant["wire_bytes_per_step"])
+        out.update({
+            "llama3d_model": "llama_1b" if full else "llama_scaled_cpu",
+            "llama3d_n_params": cfg.n_params,
+            "llama3d_ctx": S,
+            "llama3d_batch": B,
+            "llama3d_microbatches": M,
+            "llama3d_num_stages": 2,
+            "llama3d_spmd_per_stage": spmd,
+            "llama3d_zero": "opt+grads",
+            "llama3d_tokens_per_s": round(quant["tokens_per_s"]),
+            "llama3d_mfu": round(
+                quant["tokens_per_s"] * fpt / (2 * spmd * peak), 6),
+            # Interleaving acceptance: measured bubble at v=2 strictly
+            # below the v=1 baseline at the same stage count.
+            "llama3d_bubble_v1": round(base["bubble"] or 0.0, 4),
+            "llama3d_bubble_v2": round(inter["bubble"] or 0.0, 4),
+            "llama3d_bubble_improved": bool(
+                (inter["bubble"] or 1.0) < (base["bubble"] or 0.0)),
+            # int8 wire acceptance: >= 3.5x fewer bytes on the same leg.
+            "llama3d_wire_bytes_per_step_fp32": round(
+                inter["wire_bytes_per_step"]),
+            "llama3d_wire_bytes_per_step_int8": round(
+                quant["wire_bytes_per_step"]),
+            "llama3d_wire_reduction": round(wire_ratio, 2),
+            "llama3d_loss_fp32": round(float(inter["loss"]), 4),
+            "llama3d_loss_int8": round(float(quant["loss"]), 4),
+            "llama3d_driver_syncs_steady": base["driver_syncs"]
+            + inter["driver_syncs"] + quant["driver_syncs"],
+        })
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must still emit a line
+        out["llama3d_error"] = f"{type(e).__name__}: {e}"
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def bench_serving() -> dict:
     """Continuous-batching inference bench (ISSUE 8 acceptance): N
     simulated concurrent users stream requests of mixed prompt lengths at
@@ -874,6 +997,7 @@ def bench_streaming_data() -> dict:
 def main():
     out = bench_gpt2()
     out.update(bench_gpt2_pipeline())
+    out.update(bench_llama_3d())
     out.update(bench_serving())
     out.update(bench_streaming_data())
     out.update(bench_ppo_real_env())

@@ -149,6 +149,33 @@ def _defuse_all_at_exit() -> None:
 atexit.register(_defuse_all_at_exit)
 
 
+# The atexit hook covers interpreter shutdown, but SharedMemory.__del__
+# also fires whenever GC frees a segment handle while a consumer still
+# holds numpy/arrow views into its mmap (zero-copy reads hand such views
+# to user code); stock __del__ only swallows OSError, so the BufferError
+# from mmap.close() escapes and CPython prints an ignored-exception
+# traceback per segment — the bench-tail spam.  Route every __del__
+# through the same defusal: try the normal close, and on a live export
+# drop the handles instead of raising.  Locals are bound as defaults so
+# the wrapper stays callable during late interpreter teardown.
+_orig_shm_del = shared_memory.SharedMemory.__del__
+
+
+def _shm_del(self, _orig=_orig_shm_del, _defuse=defuse_shm):
+    try:
+        _orig(self)
+    except BufferError:
+        try:
+            _defuse(self)
+        except Exception:
+            pass
+    except Exception:
+        pass  # __del__ must never raise (late-shutdown torn-down globals)
+
+
+shared_memory.SharedMemory.__del__ = _shm_del
+
+
 def attach(object_id: ObjectID,
            segment: Optional[str] = None) -> shared_memory.SharedMemory:
     """Attach to an existing sealed object's segment (any process on node).

@@ -13,7 +13,12 @@ from ray_tpu.models.gpt2 import (  # noqa: F401
     gpt2_loss_fn,
     split_stages,
 )
-from ray_tpu.models.llama import Llama, LlamaConfig, llama_loss_fn  # noqa: F401
+from ray_tpu.models.llama import (  # noqa: F401
+    Llama,
+    LlamaConfig,
+    LlamaStage,
+    llama_loss_fn,
+)
 from ray_tpu.models.resnet import ResNet, ResNetConfig  # noqa: F401
 from ray_tpu.models.mlp import MLP  # noqa: F401
 from ray_tpu.models.nature_cnn import NatureCNN  # noqa: F401

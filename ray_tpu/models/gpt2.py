@@ -252,50 +252,43 @@ def _stage_ce_loss(logits: jax.Array, ids: jax.Array) -> jax.Array:
     return -jnp.mean(ll)
 
 
+def gpt2_head_cost(config: GPT2Config) -> float:
+    """LM-head cost in block-equivalents: a GPT-2 block is ~12*h^2
+    params/FLOP-units, the head matmul vocab*h."""
+    return config.vocab_size / (12.0 * config.hidden_size)
+
+
 def split_stages(config: GPT2Config, num_stages: int, *,
+                 virtual_per_rank: int = 1,
                  boundary_dtype: Any = jnp.float32, seed: int = 0):
-    """Split a GPT-2 config into ``num_stages`` pipeline stages for
+    """Split a GPT-2 config into ``num_stages * virtual_per_rank``
+    pipeline chunks for
     :class:`ray_tpu.parallel.mpmd_pipeline.MPMDPipeline`.
 
-    Blocks are partitioned by COST, not count: the embedding lookup is
-    nearly free but the LM-head matmul costs ~``vocab/(12*hidden)``
-    block-equivalents (5+ blocks for GPT-2 vocab at small/XL widths), so
-    the last stage gets proportionally fewer blocks.  Returns
-    ``(stage_fns, init_fns)``: ``stage_fns[k](params, x[, target])`` with
-    the last returning the scalar loss, and ``init_fns[k]()`` building
-    that stage's params on the caller (run them ON the stage actors so
-    XL-scale params never visit the driver).  Activations cross stage
-    boundaries as ``boundary_dtype`` (fp32 by default: bf16 objects are
-    shippable but fp32 keeps the cotangent math bit-stable on CPU)."""
+    Blocks are partitioned by COST, not count
+    (``models/pipeline_split.py``): the embedding lookup is nearly free
+    but the LM-head matmul costs ~``vocab/(12*hidden)`` block-equivalents
+    (5+ blocks for GPT-2 vocab at small/XL widths), so the head-owning
+    chunk gets proportionally fewer blocks.  With ``virtual_per_rank=v``
+    the chunks interleave over the stages (chunk c on stage ``c % S``):
+    the embedding stays pinned to stage 0 and the head to the last
+    stage.  Returns ``(stage_fns, init_fns)`` in GLOBAL chunk order:
+    ``stage_fns[c](params, x[, target])`` with the last returning the
+    scalar loss, and ``init_fns[c]()`` building that chunk's params on
+    the caller (run them ON the stage actors so XL-scale params never
+    visit the driver).  Activations cross chunk boundaries as
+    ``boundary_dtype`` (fp32 by default: bf16 objects are shippable but
+    fp32 keeps the cotangent math bit-stable on CPU)."""
+    from ray_tpu.models.pipeline_split import balance_chunks, chunk_flags
+
     if num_stages < 1:
         raise ValueError(f"num_stages must be >= 1, got {num_stages}")
-    L = config.num_layers
-    if num_stages > L + 1:
-        raise ValueError(f"cannot split {L} blocks into {num_stages} stages")
-    embed_cost = 0.3  # lookup + add: a fraction of one block
-    head_cost = config.vocab_size / (12.0 * config.hidden_size)
-    per = (embed_cost + L + head_cost) / num_stages
-    # Greedy by cumulative cost: stage k takes blocks until its share
-    # (with the embed/head extras pinned to the ends) reaches (k+1)*per.
-    # The last stage may end up block-free (ln_f + the heavy LM head);
-    # every earlier stage keeps >= 1 block.
-    bounds, start, cum = [], 0, embed_cost
-    for k in range(num_stages - 1):
-        target = (k + 1) * per
-        stop = start
-        max_stop = L - (num_stages - k - 2)  # >= 1 block per later middle
-        while stop < max_stop and cum + 1.0 <= target + 0.5:
-            stop += 1
-            cum += 1.0
-        if stop == start and start + 1 <= max_stop:
-            stop, cum = start + 1, cum + 1.0
-        bounds.append((start, stop))
-        start = stop
-    bounds.append((start, L))
+    C = num_stages * max(1, int(virtual_per_rank))
+    bounds = balance_chunks(config.num_layers, C, embed_cost=0.3,
+                            head_cost=gpt2_head_cost(config))
 
     stage_fns, init_fns = [], []
-    for k in range(num_stages):
-        first, last = k == 0, k == num_stages - 1
+    for k, (first, last) in enumerate(chunk_flags(C)):
         module = GPT2Stage(config, first=first, last=last, blocks=bounds[k])
 
         if last:

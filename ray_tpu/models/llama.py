@@ -50,6 +50,35 @@ class LlamaConfig:
         kw.setdefault("hidden_size", 64)
         return cls(**kw)
 
+    @classmethod
+    def llama_1b(cls, **kw):
+        """~1.1B-param GQA config (TinyLlama-1.1B shape: 22 layers,
+        2048 hidden, 32 q heads over 4 kv heads, 5632 SwiGLU) — the 3D
+        pipeline x SPMD x ZeRO scale target (bench.py bench_llama_3d)."""
+        kw.setdefault("vocab_size", 32000)
+        kw.setdefault("max_position_embeddings", 2048)
+        kw.setdefault("num_layers", 22)
+        kw.setdefault("num_heads", 32)
+        kw.setdefault("num_kv_heads", 4)
+        kw.setdefault("hidden_size", 2048)
+        kw.setdefault("intermediate_size", 5632)
+        return cls(**kw)
+
+    @property
+    def block_params(self) -> int:
+        """Parameters per decoder block: q/o at h^2, GQA k/v at
+        h^2 * kv/heads, three SwiGLU mats at h*mlp (+2 RMSNorm scales)."""
+        h, m = self.hidden_size, self.mlp_dim
+        kv = self.num_kv_heads / self.num_heads
+        return int(h * h * (2 + 2 * kv) + 3 * h * m + 2 * h)
+
+    @property
+    def n_params(self) -> int:
+        """Total parameter count (embed + blocks + final norm + head)."""
+        h = self.hidden_size
+        return int(2 * self.vocab_size * h + h
+                   + self.num_layers * self.block_params)
+
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
@@ -209,6 +238,99 @@ class Llama(nn.Module):
         if decode:
             return logits, new_kvs
         return logits
+
+
+class LlamaStage(nn.Module):
+    """One pipeline chunk of a split Llama (see :func:`split_stages`).
+
+    Chunk 0 owns the token embedding and consumes ids; middle chunks
+    consume/produce hidden states; the last chunk owns the final RMSNorm
+    and the (already-untied, llama convention) LM head and produces the
+    loss-side logits.  Rope is positional-from-zero inside each block,
+    so splitting changes nothing about the attention math."""
+
+    config: LlamaConfig
+    first: bool
+    last: bool
+    blocks: tuple  # (start, stop) block index range owned by this chunk
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        if self.first:
+            x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                         name="embed")(x)
+        else:
+            x = x.astype(c.dtype)
+        for i in range(*self.blocks):
+            x = LlamaBlock(c, name=f"layer_{i}")(x)
+        if self.last:
+            x = RMSNorm(c.rms_eps, c.dtype, name="final_norm")(x)
+            logits = nn.Dense(c.vocab_size, use_bias=False,
+                              dtype=jnp.float32, name="lm_head")(
+                x.astype(jnp.float32))
+            return logits
+        return x
+
+
+def _stage_ce_loss(logits: jax.Array, ids: jax.Array) -> jax.Array:
+    """Next-token CE on a microbatch (same objective as llama_loss_fn)."""
+    logits = logits[:, :-1]
+    labels = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def llama_head_cost(config: LlamaConfig) -> float:
+    """LM-head cost in llama block-equivalents — the GQA/SwiGLU-aware
+    analogue of gpt2's ``vocab/(12*hidden)``: a llama block costs
+    ``h^2*(2 + 2*kv/heads) + 3*h*mlp`` param-FLOP units, the head
+    ``vocab*h``."""
+    return (config.vocab_size * config.hidden_size) / config.block_params
+
+
+def split_stages(config: LlamaConfig, num_stages: int, *,
+                 virtual_per_rank: int = 1,
+                 boundary_dtype: Any = jnp.float32, seed: int = 0):
+    """Split a Llama config into ``num_stages * virtual_per_rank``
+    pipeline chunks for
+    :class:`ray_tpu.parallel.mpmd_pipeline.MPMDPipeline` — same contract
+    as ``models/gpt2.py::split_stages`` (GLOBAL chunk order, last chunk
+    is the loss fn, init fns run on the stage actors), with the block
+    cost model adjusted for GQA attention + SwiGLU MLP
+    (:func:`llama_head_cost`).  Embedding pins to chunk 0 (stage 0),
+    head to the last chunk (last stage), interleaved assignment
+    ``chunk c -> stage c % num_stages``."""
+    from ray_tpu.models.pipeline_split import balance_chunks, chunk_flags
+
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    C = num_stages * max(1, int(virtual_per_rank))
+    bounds = balance_chunks(config.num_layers, C, embed_cost=0.3,
+                            head_cost=llama_head_cost(config))
+
+    stage_fns, init_fns = [], []
+    for k, (first, last) in enumerate(chunk_flags(C)):
+        module = LlamaStage(config, first=first, last=last,
+                            blocks=bounds[k])
+
+        if last:
+            def fn(params, x, target, _m=module):
+                logits = _m.apply({"params": params}, x)
+                return _stage_ce_loss(logits, target)
+        else:
+            def fn(params, x, _m=module, _bd=boundary_dtype):
+                return _m.apply({"params": params}, x).astype(_bd)
+
+        def init_fn(_m=module, _first=first, _seed=seed + k, _c=config):
+            dummy = jnp.zeros((1, 8), jnp.int32) if _first else \
+                jnp.zeros((1, 8, _c.hidden_size), _c.dtype)
+            return _m.init(jax.random.PRNGKey(_seed), dummy)["params"]
+
+        stage_fns.append(fn)
+        init_fns.append(init_fn)
+    return stage_fns, init_fns
 
 
 def llama_loss_fn(params, apply_fn, batch) -> jax.Array:

@@ -48,8 +48,9 @@ from ray_tpu.parallel.mesh_group import (  # noqa: F401
 def __getattr__(name):
     # mpmd_pipeline spawns actors on import-site use; keep it lazy so
     # `import ray_tpu.parallel` stays runtime-free.
-    if name in ("MPMDPipeline", "PipelineStage", "mpmd_driver_sync_count",
-                "stage_schedule"):
+    if name in ("MPMDPipeline", "PipelineStage", "StageCore",
+                "mpmd_driver_sync_count", "stage_schedule",
+                "simulate_schedule"):
         from ray_tpu.parallel import mpmd_pipeline
 
         return getattr(mpmd_pipeline, name)

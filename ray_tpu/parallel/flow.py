@@ -212,12 +212,14 @@ class _StageCore:
     __del__/close and the threads would leak."""
 
     def __init__(self, name: str, fn, src, depth: int, workers: int,
-                 token: CancellationToken, span: Optional[str]):
+                 token: CancellationToken, span: Optional[str],
+                 sink: bool = False):
         self.name = name
         self.fn = fn
         self.src = src
         self.token = token
         self.span = span
+        self.sink = sink
         self.out_q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self.src_lock = threading.Lock()
         self.state_lock = threading.Lock()
@@ -285,6 +287,8 @@ def _stage_worker(core: _StageCore) -> None:
             if core.span is not None:
                 profiling.record_span(core.span, t0, t1, stage=core.name,
                                       seq=seq)
+            if core.sink:
+                continue  # results are fn's side effects; nothing queues
             with core.state_lock:
                 core.peak_queue = max(core.peak_queue, core.out_q.qsize())
             if not _bounded_put(core.out_q, core.token, (seq, out)):
@@ -298,7 +302,8 @@ def _stage_worker(core: _StageCore) -> None:
             # The workers own the source: release its upstream resources
             # (threads, object refs) here, where it is not mid-pull.
             core.close_src()
-            _bounded_put(core.out_q, core.token, _End(end_seq))
+            if not core.sink:
+                _bounded_put(core.out_q, core.token, _End(end_seq))
 
 
 def _tag_stage(exc: BaseException, name: str) -> None:
@@ -316,29 +321,39 @@ class Stage(Iterator[Any]):
     items.  ``ordered=True`` (default) re-serializes multi-worker results
     into source order; ``ordered=False`` yields them as they complete.
     ``workers=0`` degrades to a threadless inline transform (debugging /
-    comparison baseline).  Iterate to consume; ``close()`` (also via
-    ``with`` or GC) cancels, drains and joins every thread.
+    comparison baseline).  ``sink=True`` makes the stage terminal: ``fn``
+    consumes items purely by side effect (resolving futures, writing
+    files), nothing queues downstream and the stage is not iterable —
+    the request/response shape (e.g. the serve batcher), where callers
+    wait on futures ``fn`` resolves rather than pulling an iterator.
+    Iterate to consume (non-sink); ``close()`` (also via ``with`` or GC)
+    cancels, drains and joins every thread.
 
     The consumer side is single-threaded by contract (chained stages pull
     from each other under the downstream stage's source lock)."""
 
     def __init__(self, source: Iterable[Any], fn: Callable[[Any], Any],
                  *, depth: int = 2, workers: int = 1, ordered: bool = True,
-                 name: str = "stage", token: Optional[CancellationToken] = None,
+                 sink: bool = False, name: str = "stage",
+                 token: Optional[CancellationToken] = None,
                  span: Optional[str] = None, export_metrics: bool = True):
         if depth < 1:
             raise ValueError(f"stage depth must be >= 1, got {depth}")
         if workers < 0:
             raise ValueError(f"workers must be >= 0, got {workers}")
+        if sink and workers < 1:
+            raise ValueError("a sink stage needs at least one worker")
         self.name = name
         self.depth = int(depth)
         self.workers = int(workers)
         self.ordered = bool(ordered)
+        self.sink = bool(sink)
         self.token = token if token is not None else CancellationToken()
         self._export = bool(export_metrics)
         self._core = _StageCore(name, fn, iter(source), depth,
                                 max(1, workers), self.token,
-                                span if span is not None else f"flow_{name}")
+                                span if span is not None else f"flow_{name}",
+                                sink=self.sink)
         self._threads: List[threading.Thread] = []
         self._buffer: Dict[int, Any] = {}   # ordered-mode reorder buffer
         self._next_seq = 0
@@ -362,6 +377,10 @@ class Stage(Iterator[Any]):
         return self
 
     def __next__(self):
+        if self.sink:
+            raise TypeError(
+                f"sink stage {self.name!r} is not iterable — its fn "
+                "consumes items by side effect; use close()/join")
         if self._done:
             self._raise_end()
         if self.workers == 0:

@@ -953,6 +953,37 @@ class MeshGroup:
                 pipe.submit(fn, *step_args, **kwargs)
             return pipe.flush()
 
+    # ---- ordered per-rank dispatch (the MPMD stage-gang primitive) ----
+    def seek_ranks(self, idx: int) -> None:
+        """(Re)arm every rank's pipeline sequence gate at ``idx`` — the
+        setup/restart fan-out for callers that drive the gang through
+        :meth:`submit_ordered` instead of a :class:`StepPipeline`."""
+        gang_get([w.pipeline_seek.remote(idx) for w in self.workers],
+                 timeout=self.bootstrap_timeout)
+
+    def submit_ordered(self, seq: int, calls: Sequence[tuple],
+                       kwargs: Optional[dict] = None) -> List[Any]:
+        """Dispatch one gated op per rank at sequence position ``seq``
+        and return the per-rank refs WITHOUT draining.
+
+        ``calls[r] = (fn, *args)`` runs ``fn(state, *args)`` on rank r
+        through the MeshWorker pipeline gate: every rank executes its
+        ops in the same global order, which is what keeps compiled
+        cross-process collectives matched across ranks even though each
+        op is an independent actor task.  The MPMD pipeline plane drives
+        its multi-host stage gangs through this (one ``seq`` per
+        schedule op); unlike ``run*`` it performs no blocking driver
+        sync — callers drain the refs themselves (``gang_get``)."""
+        if len(calls) != len(self.workers):
+            raise ValueError(
+                f"submit_ordered needs one call per rank "
+                f"({len(self.workers)}), got {len(calls)}")
+        kw = kwargs or {}
+        return [
+            w.pipeline_step.remote(seq, True, *calls[r], **kw)
+            for r, w in enumerate(self.workers)
+        ]
+
     def _supervised(self, attempt: Callable[[], List[Any]],
                     on_restart: Optional[Callable]) -> List[Any]:
         while True:

@@ -375,6 +375,46 @@ def make_update_fn(sharder: ZeroSharder, tx, *,
     return update
 
 
+# ---- snapshot/restore placement (MPMD stage snapshots, gang-aware) ----
+def replicate_opt_state(opt_state: Any, mesh) -> Any:
+    """All-gather a natively-sharded optimizer state into replicated
+    arrays on ``mesh`` (one compiled identity with replicated
+    out_shardings).  Snapshot path, not the hot path: every process of a
+    multi-host mesh ends holding the full state, so any rank's host copy
+    can restore any future gang shape."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    shardings = jax.tree_util.tree_map(lambda _: repl, opt_state)
+    return jax.jit(lambda o: o, out_shardings=shardings)(opt_state)
+
+
+def place_opt_state(host_opt: Any, mesh, opt_specs: Any,
+                    multihost: bool = False) -> Any:
+    """Place a host (replicated-layout) optimizer state onto ``mesh``
+    with the ZeRO shardings in ``opt_specs`` — the inverse of
+    ``replicate_opt_state`` + ``device_get``.  ``multihost=True`` routes
+    through ``jax.make_array_from_callback`` so each process
+    materializes only its addressable shards (``device_put`` cannot
+    target non-addressable devices)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), opt_specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+    def place(x, sh):
+        arr = np.asarray(x)
+        if not multihost:
+            return jax.device_put(jnp.asarray(arr), sh)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx, _a=arr: _a[idx])
+
+    return jax.tree_util.tree_map(place, host_opt, shardings)
+
+
 # ---- metrics ----
 def export_zero_metrics(sharder: ZeroSharder, tx, *, zero_sharding: str,
                         quantized: str) -> dict:

@@ -6,17 +6,22 @@ replica hosting a pjit-compiled model turns N concurrent single requests
 into ONE batched device call, which is the only way the MXU sees a real
 batch dimension from a request/response workload.
 
-Mechanics: requests enqueue (item, Future) and block on the future; a
-lazily-started batcher thread drains the queue — first item blocking, then
-up to max_batch_size or until batch_wait_timeout_s passes — and calls the
-wrapped function once with the list of items, distributing results back.
+Mechanics: requests enqueue (item, Future) and block on the future; the
+batcher is ONE ``flow.Stage(sink=True)`` over a batch-assembly source —
+the source generator drains the queue (first item blocking, then up to
+max_batch_size or until batch_wait_timeout_s passes) and yields batches,
+the stage worker calls the wrapped function once per batch and resolves
+each item's future.  This was the first hand-rolled Thread+Queue loop
+migrated onto the async dataflow substrate (tools/check_flow_usage.py's
+allowlist-only-shrinks contract): thread lifecycle, cancellation and
+join-on-close now come from ``ray_tpu.parallel.flow``.
 
 Failure semantics: an exception from the batched handler is ISOLATED —
 each item of the failed batch is retried alone, so only the item whose
 handler actually raises sees the exception; its batchmates still get
 results (at the cost of re-running their handler calls, so batched
 handlers should be idempotent per item).  ``close()`` stops the batcher
-thread and wakes queued submitters with a typed
+stage and wakes queued submitters with a typed
 :class:`~ray_tpu.exceptions.BatcherClosedError` — deployment teardown and
 ``serve.shutdown()`` drain every batcher instead of leaking daemon
 threads and permanently-blocked callers.
@@ -32,7 +37,7 @@ from typing import Any, Callable, List, Optional
 
 from ray_tpu.exceptions import BatcherClosedError
 
-_CLOSE = object()  # queue sentinel: wake the loop for shutdown
+_CLOSE = object()  # queue sentinel: wake the assembly source for shutdown
 
 # Every live batcher in this process, so teardown paths (serve.shutdown,
 # replica drain) can close them without holding the decorated objects.
@@ -46,25 +51,30 @@ class _Batcher:
         self.max_batch_size = max_batch_size
         self.batch_wait_timeout_s = batch_wait_timeout_s
         self._queue: "queue.Queue" = queue.Queue()
-        self._thread: Optional[threading.Thread] = None
+        self._stage: Optional[Any] = None  # flow.Stage (lazy import)
         self._lock = threading.Lock()
         self._closed = False
         _BATCHERS.add(self)
 
-    def _ensure_thread(self):
+    def _ensure_stage(self):
+        # Lazy: ray_tpu.parallel's __init__ pulls jax; the serve package
+        # must stay importable without it (same rule as ray_tpu.data).
+        from ray_tpu.parallel import flow
+
         with self._lock:
             if self._closed:
                 raise BatcherClosedError(
                     f"batcher for {getattr(self.fn, '__name__', self.fn)!r} "
                     f"is closed")
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._loop, name="rtpu-serve-batcher", daemon=True)
-                self._thread.start()
+            if self._stage is None:
+                self._stage = flow.Stage(
+                    self._batch_source(), self._dispatch, workers=1,
+                    depth=1, sink=True, name="serve-batch",
+                    export_metrics=False)
 
     def submit(self, item) -> Any:
         fut: Future = Future()
-        self._ensure_thread()
+        self._ensure_stage()
         self._queue.put((item, fut))
         if self._closed:
             # close() raced our put: its drain may already have run, so
@@ -74,18 +84,57 @@ class _Batcher:
                 fut.set_exception(BatcherClosedError("batcher closed"))
         return fut.result()
 
+    def _batch_source(self):
+        """Batch-assembly source for the sink stage: block for the first
+        item, then fill up to max_batch_size or the wait deadline.  The
+        _CLOSE sentinel ends the stream (a mid-assembly close still
+        yields the partial batch so its callers get results)."""
+        import time
+
+        while True:
+            item, fut = self._queue.get()
+            if item is _CLOSE:
+                return
+            if self._closed:
+                # Drain mode: everything queued at close time is failed,
+                # not run — callers wake with the typed error.
+                if fut is not None and not fut.done():
+                    fut.set_exception(BatcherClosedError(
+                        "batcher closed before this request ran"))
+                continue
+            batch = [(item, fut)]
+            deadline = time.monotonic() + self.batch_wait_timeout_s
+            closing = False
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt[0] is _CLOSE:
+                    closing = True
+                    break
+                batch.append(nxt)
+            yield batch
+            if closing:
+                return
+
     def close(self, timeout: float = 5.0):
-        """Stop the batcher thread and fail queued submitters with a
+        """Stop the batcher stage and fail queued submitters with a
         typed error.  The batch currently executing finishes and its
         callers get their results."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
-            t = self._thread
+            stage = self._stage
         self._queue.put((_CLOSE, None))
-        if t is not None and t.is_alive():
-            t.join(timeout)
+        if stage is not None:
+            # Joins the worker thread (the in-flight dispatch completes;
+            # the _CLOSE above wakes a source parked on an empty queue).
+            stage.close()
         err = BatcherClosedError(
             f"batcher for {getattr(self.fn, '__name__', self.fn)!r} was "
             f"closed before this request ran")
@@ -129,40 +178,6 @@ class _Batcher:
                     f.set_result(r[0])
                 except BaseException as ee:  # noqa: BLE001
                     f.set_exception(ee)
-
-    def _loop(self):
-        import time
-
-        while True:
-            item, fut = self._queue.get()
-            if item is _CLOSE:
-                return
-            if self._closed:
-                # Drain mode: the in-flight batch (if any) already got its
-                # results; everything queued at close time is failed, not
-                # run — callers wake with the typed error.
-                if not fut.done():
-                    fut.set_exception(BatcherClosedError(
-                        "batcher closed before this request ran"))
-                continue
-            batch = [(item, fut)]
-            deadline = time.monotonic() + self.batch_wait_timeout_s
-            closing = False
-            while len(batch) < self.max_batch_size:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt[0] is _CLOSE:
-                    closing = True
-                    break
-                batch.append(nxt)
-            self._dispatch(batch)
-            if closing:
-                return
 
 
 def shutdown_batchers():

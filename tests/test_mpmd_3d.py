@@ -1,0 +1,452 @@
+"""Composed 3D parallelism (ISSUE 12): interleaved virtual pipeline
+stages, the block-scaled int8 inter-stage wire, multi-host MeshGroup
+stage gangs, and the Llama pipeline splitter.
+
+Covers: interleaved-schedule feasibility across a (S, v, M) grid plus the
+analytic bubble shrink (simulate_schedule — deterministic, no wall-clock
+assertions), interleaved 1F1B/GPipe loss+param parity with the
+single-process reference, int8 wire byte accounting (>= 3x) and loss
+envelope, Llama split_stages cost balance with GQA/SwiGLU block
+equivalents + embed/head pinning + virtual chunk assignment, a tiny-Llama
+pipeline parity gate, multi-host gang stages (jax.distributed SPMD worlds
+per stage) with ZeRO and exact parity, and gang-rank death -> whole-gang
+respawn -> schedule replay landing on the unkilled run's exact params."""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _mlp_chunks(dims, seed=1):
+    """len(dims)-1 chunk fns (tanh MLP layers + MSE loss tail), nested so
+    cloudpickle captures BY VALUE (workers can't import tests/)."""
+    import jax.numpy as jnp
+
+    def mk_mid():
+        def mid(params, x):
+            import jax.numpy as jnp
+
+            return jnp.tanh(x @ params["w"])
+
+        return mid
+
+    def last(params, h, target):
+        import jax.numpy as jnp
+
+        return jnp.mean((h @ params["w"] - target) ** 2)
+
+    rng = np.random.default_rng(seed)
+    n = len(dims) - 1
+    fns = [mk_mid() for _ in range(n - 1)] + [last]
+    ps = [{"w": jnp.asarray(rng.normal(0, 0.4, (dims[i], dims[i + 1])),
+                            jnp.float32)} for i in range(n)]
+    return fns, ps
+
+
+def _reference_run(fns, ps, x, t, tx, steps):
+    import jax
+    import optax
+
+    def full_pos(params, xb, tb):
+        h = xb
+        for i in range(len(fns) - 1):
+            h = fns[i](params[i], h)
+        return fns[-1](params[-1], h, tb)
+
+    params = [dict(p) for p in ps]
+    opt = [tx.init(p) for p in params]
+    losses = []
+    for _ in range(steps):
+        loss, grads = jax.value_and_grad(full_pos)(params, x, t)
+        for i in range(len(params)):
+            upd, opt[i] = tx.update(grads[i], opt[i], params[i])
+            params[i] = optax.apply_updates(params[i], upd)
+        losses.append(float(loss))
+    return losses, params
+
+
+def _assert_chunk_params_close(got, want, rtol=1e-4, atol=1e-5):
+    import jax
+
+    for c, (g, w) in enumerate(zip(got, want)):
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(w)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=rtol, atol=atol,
+                                       err_msg=f"chunk {c}")
+
+
+# ---------------------------------------------------------------------------
+# Schedules (pure — no cluster)
+# ---------------------------------------------------------------------------
+
+def test_interleaved_schedule_grid_feasible():
+    """Every (schedule, S, v, M) combination must be deadlock-free and
+    cover each (chunk, microbatch) op exactly once."""
+    from ray_tpu.parallel.mpmd_pipeline import (
+        simulate_schedule,
+        stage_schedule,
+    )
+
+    for S in (2, 3, 4):
+        for v in (1, 2, 3):
+            for M in (S, 2 * S, 4 * S):
+                for sched in ("1f1b", "gpipe"):
+                    ops = [op for k in range(S)
+                           for op in stage_schedule(sched, S, M, k, v)]
+                    want = {(d, c, m) for d in ("F", "B")
+                            for c in range(S * v) for m in range(M)}
+                    assert set(ops) == want and len(ops) == len(want), \
+                        (sched, S, v, M)
+                    r = simulate_schedule(sched, S, M, v)
+                    assert 0.0 <= r["bubble_fraction"] < 1.0, (S, v, M, r)
+
+
+def test_interleaving_cuts_predicted_bubble():
+    """The analytic bubble envelope strictly shrinks with
+    virtual_per_rank at identical (S, M) — the deterministic version of
+    the bench's measured mpmd_bubble_fraction comparison."""
+    from ray_tpu.parallel.mpmd_pipeline import simulate_schedule
+
+    for S, M in ((2, 8), (4, 8), (2, 16)):
+        b1 = simulate_schedule("1f1b", S, M, 1)["bubble_fraction"]
+        b2 = simulate_schedule("1f1b", S, M, 2)["bubble_fraction"]
+        assert b2 < b1, (S, M, b1, b2)
+    # And the absolute value tracks the (S-1)/(M + S - 1) law at v=1.
+    b1 = simulate_schedule("1f1b", 2, 8, 1)["bubble_fraction"]
+    assert abs(b1 - 1 / 9) < 0.02, b1
+
+
+def test_interleaved_requires_divisible_microbatches():
+    from ray_tpu.parallel.mpmd_pipeline import stage_schedule
+
+    with pytest.raises(ValueError, match="num_microbatches"):
+        stage_schedule("1f1b", 2, 7, 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved + int8 wire on solo stages
+# ---------------------------------------------------------------------------
+
+def test_interleaved_v2_matches_reference(cluster):
+    """2 physical stages x 2 virtual chunks: losses AND params match the
+    single-process full-batch reference exactly (fp32 wire) — the
+    interleaved schedule changes execution order, never math."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    fns, ps = _mlp_chunks([6, 16, 16, 16, 2])
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 2)).astype(np.float32)
+    tx = optax.sgd(0.05)
+    ref_losses, ref_params = _reference_run(fns, ps, x, t, tx, 3)
+
+    for sched in ("1f1b", "gpipe"):
+        pipe = MPMDPipeline(fns, ps, optimizer=tx, num_microbatches=4,
+                            virtual_per_rank=2, schedule=sched)
+        losses = [pipe.train_step(x, t) for _ in range(3)]
+        params = pipe.get_params()
+        pipe.stop()
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4,
+                                   atol=1e-5, err_msg=sched)
+        _assert_chunk_params_close(params, ref_params)
+
+
+def test_int8_wire_bytes_and_envelope(cluster):
+    """wire_dtype=int8 ships >= 3x fewer boundary bytes than the logical
+    fp32 activations (exact byte accounting, no timing) while the loss
+    stays inside the quantization envelope; the mpmd_wire_bytes meter
+    lands on /metrics."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+    from ray_tpu.util.metrics import prometheus_text
+
+    fns, ps = _mlp_chunks([8, 64, 64, 64, 4], seed=3)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    t = rng.normal(size=(32, 4)).astype(np.float32)
+    tx = optax.sgd(0.05)
+    ref_losses, _ = _reference_run(fns, ps, x, t, tx, 3)
+
+    pipe = MPMDPipeline(fns, ps, optimizer=tx, num_microbatches=4,
+                        virtual_per_rank=2, wire_dtype="int8")
+    losses = [pipe.train_step(x, t) for _ in range(3)]
+    stats = pipe.stats()
+    pipe._metrics["wire"].flush()  # Meter batches kv writes
+    pipe.stop()
+    assert stats["wire_reduction_vs_fp32"] >= 3.0, stats
+    assert stats["wire_bytes"] > 0, stats
+    for a, b in zip(losses, ref_losses):
+        assert abs(a - b) < 0.05, (losses, ref_losses)
+    assert "mpmd_wire_bytes" in prometheus_text()
+
+
+def test_fp32_wire_byte_accounting(cluster):
+    """The fp32 wire ships exactly its logical bytes (ratio 1.0) — the
+    denominator of the int8 comparison is honest."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    fns, ps = _mlp_chunks([8, 64, 64, 64, 4], seed=4)
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    t = rng.normal(size=(32, 4)).astype(np.float32)
+    pipe = MPMDPipeline(fns, ps, optimizer=optax.sgd(0.05),
+                        num_microbatches=4)
+    pipe.train_step(x, t)
+    stats = pipe.stats()
+    pipe.stop()
+    assert stats["wire_bytes"] == stats["activation_bytes"] > 0, stats
+    assert stats["wire_reduction_vs_fp32"] == 1.0, stats
+
+
+# ---------------------------------------------------------------------------
+# Llama splitting (pure — no cluster)
+# ---------------------------------------------------------------------------
+
+def test_llama_split_cost_balance_gqa_swiglu():
+    """Chunk cost balance uses llama block-equivalents (GQA attention +
+    SwiGLU MLP): the head-owning chunk gets fewer blocks, and the spread
+    of per-chunk cost stays within one block-equivalent of ideal."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import (
+        LlamaConfig,
+        llama_head_cost,
+        split_stages,
+    )
+    from ray_tpu.models.pipeline_split import balance_chunks
+
+    cfg = LlamaConfig.llama_1b(dtype=jnp.float32)
+    # GQA shrinks the block (k/v at kv/heads), SwiGLU grows it: the head
+    # cost in block-equivalents must reflect both.
+    head = llama_head_cost(cfg)
+    dense_blk = 12 * cfg.hidden_size ** 2  # gpt2-style block param count
+    assert cfg.block_params != dense_blk
+    assert 0.5 < head < cfg.vocab_size / cfg.hidden_size, head
+
+    for n in (2, 4):
+        bounds = balance_chunks(cfg.num_layers, n, embed_cost=0.3,
+                                head_cost=head)
+        costs = [stop - start for start, stop in bounds]
+        costs[0] += 0.3
+        costs[-1] += head
+        ideal = sum(costs) / n
+        assert max(costs) - min(costs) <= 1.0 + 1e-9, (bounds, costs)
+        # Head-owning chunk holds fewer blocks than the first.
+        assert bounds[-1][1] - bounds[-1][0] <= \
+            bounds[0][1] - bounds[0][0], bounds
+        assert abs(max(costs) - ideal) <= 1.0, (costs, ideal)
+        fns, inits = split_stages(cfg, n)
+        assert len(fns) == n and len(inits) == n
+
+
+def test_llama_split_pinning_and_virtual_assignment():
+    """Embed pins to chunk 0 (stage 0), head to the last chunk (last
+    stage); virtual chunks are contiguous, non-overlapping, and cover
+    every block exactly once."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig, split_stages
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, num_layers=4)
+    S, v = 2, 2
+    fns, inits = split_stages(cfg, S, virtual_per_rank=v)
+    assert len(fns) == S * v
+    params = [f() for f in inits]
+    # Embed only in chunk 0, head only in chunk C-1.
+    assert "embed" in params[0] and "lm_head" not in params[0]
+    for mid in params[1:-1]:
+        assert "embed" not in mid and "lm_head" not in mid
+    assert "lm_head" in params[-1] and "embed" not in params[-1]
+    # Interleaved ownership: chunk c -> stage c % S puts embed on stage
+    # 0 and head on stage S-1.
+    assert 0 % S == 0 and (S * v - 1) % S == S - 1
+    # Coverage: every layer_i appears in exactly one chunk, in order.
+    seen = []
+    for p in params:
+        layers = sorted(int(k.split("_")[1]) for k in p
+                        if k.startswith("layer_"))
+        assert layers == list(range(layers[0], layers[0] + len(layers))) \
+            if layers else True  # contiguous
+        seen += layers
+    assert seen == list(range(cfg.num_layers)), seen
+    # The chunk fns compose into a working loss.
+    ids = jnp.zeros((2, 8), jnp.int32)
+    h = fns[0](params[0], ids)
+    for c in range(1, S * v - 1):
+        h = fns[c](params[c], h)
+    loss = fns[-1](params[-1], h, ids)
+    assert jax.numpy.isfinite(loss)
+
+
+def test_llama_1b_config_scale():
+    """llama_1b() is a genuine ~1.1B-param GQA config."""
+    from ray_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.llama_1b()
+    assert 1.0e9 < cfg.n_params < 1.25e9, cfg.n_params
+    assert cfg.num_kv_heads < cfg.num_heads  # GQA
+    assert cfg.mlp_dim == 5632
+
+
+def test_llama_pipeline_parity(cluster):
+    """A split tiny Llama (GQA + SwiGLU) trained through the interleaved
+    2-stage pipeline matches the same chunk fns composed in-process."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, split_stages
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    fns, inits = split_stages(cfg, 2, virtual_per_rank=2)
+    params = [f() for f in inits]
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+    tx = optax.adamw(1e-3)
+
+    pipe = MPMDPipeline(fns, params, optimizer=tx, num_microbatches=2,
+                        virtual_per_rank=2)
+    pipe_losses = [pipe.train_step(ids, ids) for _ in range(2)]
+    pipe.stop()
+
+    def full_loss(ps, ids_b):
+        h = fns[0](ps[0], ids_b)
+        for c in range(1, 3):
+            h = fns[c](ps[c], h)
+        return fns[3](ps[3], h, ids_b)
+
+    ps = list(params)
+    opt = [tx.init(p) for p in ps]
+    ref_losses = []
+    for _ in range(2):
+        loss, grads = jax.value_and_grad(full_loss)(ps, ids)
+        for i in range(4):
+            upd, opt[i] = tx.update(grads[i], opt[i], ps[i])
+            ps[i] = optax.apply_updates(ps[i], upd)
+        ref_losses.append(float(loss))
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=1e-4,
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host stage gangs
+# ---------------------------------------------------------------------------
+
+def test_gang_stage_parity_with_zero(cluster):
+    """Each stage a 2-process jax.distributed gang (params replicated
+    across the gang, microbatch sharded over every gang device, ZeRO
+    optimizer 1/N across the gang): losses and params match the
+    single-process reference exactly — multi-host layout changes
+    nothing about the math."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    fns, ps = _mlp_chunks([8, 32, 4], seed=6)
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    t = rng.normal(size=(32, 4)).astype(np.float32)
+    tx = optax.adam(1e-2)
+    ref_losses, ref_params = _reference_run(fns, ps, x, t, tx, 3)
+
+    pipe = MPMDPipeline(
+        fns, ps, optimizer=tx, num_microbatches=4, gang_hosts=2,
+        gang_platform="cpu", gang_local_device_count=1,
+        stage_options=[{"zero_sharding": "opt+grads"}, {}])
+    losses = [pipe.train_step(x, t) for _ in range(3)]
+    params = pipe.get_params()
+    stats = pipe.stats()
+    pipe.stop()
+    assert stats["gang_hosts"] == 2
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+    _assert_chunk_params_close(params, ref_params)
+
+
+@pytest.mark.slow
+def test_gang_3d_composed_interleaved_int8(cluster):
+    """The full composition — 2 stages x 2-process gangs x interleaved
+    v=2 x ZeRO x int8 wire — trains inside the quantization envelope
+    with >= 3x wire reduction."""
+    import optax
+
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    fns, ps = _mlp_chunks([6, 64, 64, 64, 4], seed=7)
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    t = rng.normal(size=(32, 4)).astype(np.float32)
+    tx = optax.sgd(0.05)
+    ref_losses, _ = _reference_run(fns, ps, x, t, tx, 4)
+
+    pipe = MPMDPipeline(
+        fns, ps, optimizer=tx, num_microbatches=4, virtual_per_rank=2,
+        wire_dtype="int8", gang_hosts=2, gang_platform="cpu",
+        gang_local_device_count=1,
+        stage_options=[{"zero_sharding": "opt+grads"},
+                       {"zero_sharding": "opt+grads"}])
+    losses = [pipe.train_step(x, t) for _ in range(4)]
+    stats = pipe.stats()
+    pipe.stop()
+    assert stats["wire_reduction_vs_fp32"] >= 3.0, stats
+    for a, b in zip(losses, ref_losses):
+        assert abs(a - b) < 0.07, (losses, ref_losses)
+
+
+@pytest.mark.slow
+def test_gang_rank_death_replay_matches_unkilled(cluster):
+    """SIGKILL one RANK of one stage gang mid-step: the whole pipeline
+    (every gang) tears down, respawns, restores from the store-resident
+    snapshot and replays in order — landing on EXACTLY the params of an
+    unkilled run.  This is the multi-host version of the PR 10 chaos
+    gate: the dead process takes its jax.distributed peers with it
+    (SPMD worlds die as units) and recovery still converges."""
+    import optax
+
+    from ray_tpu._private.chaos import _kill_actor_process
+    from ray_tpu.parallel.mpmd_pipeline import MPMDPipeline
+
+    fns, ps = _mlp_chunks([8, 32, 4], seed=8)
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    t = rng.normal(size=(32, 4)).astype(np.float32)
+    tx = optax.sgd(0.05)
+    steps = 5
+
+    ref = MPMDPipeline(fns, ps, optimizer=tx, num_microbatches=4)
+    ref_losses = [ref.train_step(x, t) for _ in range(steps)]
+    ref_params = ref.get_params()
+    ref.stop()
+
+    pipe = MPMDPipeline(
+        fns, ps, optimizer=tx, num_microbatches=4, gang_hosts=2,
+        gang_platform="cpu", gang_local_device_count=1, step_window=2,
+        max_restarts=2, snapshot_interval=1, drain_timeout=120.0)
+    losses = {}
+    for i in range(steps):
+        pipe.submit_step(x, t)
+        if i == 2:
+            assert _kill_actor_process(pipe._gangs[1].workers[0])
+    for idx, loss in pipe.flush():
+        losses[idx] = loss
+    params = pipe.get_params()
+    assert pipe.restart_count >= 1, "kill never triggered a restart"
+    pipe.stop()
+
+    np.testing.assert_allclose([losses[i] for i in range(steps)],
+                               ref_losses, rtol=1e-5, atol=1e-6)
+    _assert_chunk_params_close(params, ref_params, rtol=1e-6, atol=1e-7)

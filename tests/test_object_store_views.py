@@ -135,3 +135,40 @@ def test_exit_guard_defuses_tracked_segments():
             shared_memory.SharedMemory(name=s.name).unlink()
         except FileNotFoundError:
             pass
+
+
+def test_patched_del_never_raises_with_live_exports():
+    """The ISSUE 12 satellite: SharedMemory.__del__ itself routes
+    through the defuse guard, so GC'ing a handle whose mmap still has
+    numpy-view exports never prints an ignored BufferError — even for
+    segments nobody registered with track_for_exit (the mid-run GC
+    case, not just interpreter shutdown)."""
+    import gc
+    from multiprocessing import shared_memory
+
+    import numpy as np
+
+    from ray_tpu._private import object_store as store_mod
+
+    assert shared_memory.SharedMemory.__del__ is store_mod._shm_del
+
+    shm = shared_memory.SharedMemory(create=True, size=2048)
+    store_mod.untrack(shm)
+    name = shm.name
+    view = np.frombuffer(shm.buf, dtype=np.uint8)  # live C-level export
+    view[:2] = 9
+    with warnings.catch_warnings():
+        # An escaping __del__ exception surfaces as an "Exception
+        # ignored" unraisable event; fail the test if one fires.
+        warnings.simplefilter("error")
+        shm.__del__()  # exactly what GC runs — must be silent
+    assert (view[:2] == 9).all()  # exporter's mapping survives
+    del view, shm
+    gc.collect()
+    cleanup = shared_memory.SharedMemory(name=name)
+    store_mod.untrack(cleanup)
+    cleanup.close()
+    try:
+        cleanup.unlink()
+    except FileNotFoundError:
+        pass

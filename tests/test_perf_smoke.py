@@ -5,6 +5,7 @@ test pass instead of only surfacing in the full bench."""
 import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 
 from tools.perf_smoke import (
+    run_3d_smoke,
     run_checkpoint_smoke,
     run_flow_smoke,
     run_mpmd_smoke,
@@ -116,6 +117,23 @@ def test_mpmd_smoke(shutdown_only):
     assert out["overlap_ok"], f"stages serialized: {out}"
     assert out["jit_cache_constant"], f"stage program retraced: {out}"
     assert out["inflight_bound_ok"], f"1F1B bound violated: {out}"
+    assert out["ok"], out
+
+
+def test_3d_smoke(shutdown_only):
+    """The composed 3D plane — interleaved MPMD pipeline x intra-stage
+    SPMD x ZeRO with the int8 inter-stage wire, on a tiny GQA Llama —
+    must stream with zero mid-step driver syncs, compile each chunk's
+    programs exactly once, ship >= 3x fewer wire bytes than fp32, stay
+    inside the quantization loss envelope, and hold 1/N optimizer bytes
+    (the tier-1 guard for ISSUE 12)."""
+    out = run_3d_smoke()
+    assert out["results_ok"], out
+    assert out["driver_syncs_steady"] == 0, f"lockstep regression: {out}"
+    assert out["jit_cache_constant"], f"chunk program retraced: {out}"
+    assert out["wire_ok"], f"int8 wire under 3x: {out}"
+    assert out["loss_envelope_ok"], f"int8 numerics drifted: {out}"
+    assert out["zero_ok"], f"opt state not sharded: {out}"
     assert out["ok"], out
 
 

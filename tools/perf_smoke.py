@@ -722,6 +722,131 @@ def run_mpmd_smoke(steps: int = 6, microbatches: int = 4) -> dict:
         ray_tpu.shutdown()
 
 
+def run_3d_smoke(steps: int = 4, microbatches: int = 2) -> dict:
+    """Composed 3D-parallelism invariants (tier-1 guard for ISSUE 12;
+    tiny GQA Llama, 2 pipeline stages x 2-way intra-stage SPMD x ZeRO,
+    interleaved virtual stages, int8 inter-stage wire — no timing
+    thresholds):
+
+    1. **Zero mid-step driver syncs**: the streamed submit_step path
+       leaves mpmd_driver_sync_count() untouched even with every plane
+       composed (SPMD shard_map apply + ZeRO + interleaving + wire
+       quantization must not reintroduce lockstep).
+    2. **Constant jit caches**: each stage compiles exactly one
+       fwd/bwd/apply per owned chunk (= virtual_per_rank) and never
+       retraces across steps.
+    3. **int8 wire >= 3x**: `mpmd_wire_bytes` (actually shipped) is at
+       least 3x below the logical fp32 activation bytes when
+       wire_dtype=int8 — the EQuARX block format's envelope at the
+       model's hidden size.
+    4. **Numerics**: the int8-wire loss tracks the fp32-wire loss within
+       the quantization envelope, and ZeRO's optimizer state is
+       genuinely 1/N per device.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.parallel import mpmd_pipeline as mp
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    try:
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.llama import LlamaConfig, split_stages
+
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        S, v = 2, 2
+        stage_fns, init_fns = split_stages(cfg, S, virtual_per_rank=v)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+        tx = optax.adamw(1e-3)
+
+        def run_leg(wire):
+            pipe = mp.MPMDPipeline(
+                stage_fns, init_fns, optimizer=tx,
+                num_microbatches=microbatches, virtual_per_rank=v,
+                wire_dtype=wire, step_window=2, drain_timeout=300.0,
+                gang_hosts=1, gang_platform="cpu",
+                gang_local_device_count=2,
+                stage_options=[
+                    {"spmd_devices": 2, "zero_sharding": "opt+grads"},
+                    {"spmd_devices": 2, "zero_sharding": "opt+grads"}])
+            syncs0 = mp.mpmd_driver_sync_count()
+            caches = []
+            for _ in range(steps):
+                pipe.submit_step(ids, ids)
+                rep = pipe.last_step_report()
+                if rep is not None:
+                    caches.append(rep["jit_cache"])
+            results = pipe.flush()
+            syncs = mp.mpmd_driver_sync_count() - syncs0
+            rep = pipe.last_step_report()
+            caches.append(rep["jit_cache"])
+            stats = pipe.stats()
+            stage0 = ray_tpu.get(
+                pipe._handles[0].submit("stats", [()])[0])
+            pipe.stop()
+            return {
+                "losses": [l for _, l in sorted(results)],
+                "driver_syncs": syncs,
+                "caches": caches,
+                "stats": stats,
+                "zero_ratio": stage0["zero_opt_bytes_per_replica"]
+                / max(1, stage0["replicated_opt_bytes"]),
+            }
+
+        fp32 = run_leg("fp32")
+        i8 = run_leg("int8")
+
+        def leg_cache_ok(leg):
+            # Constant across steps (no per-step/microbatch retrace).
+            # fwd/apply compile exactly once per owned chunk; bwd may
+            # compile twice per chunk under SPMD (the first call's fresh
+            # zero-accumulator carries a different committed sharding
+            # than the steady-state loop-carried one) — warmup-bounded,
+            # never per-step.
+            if leg["caches"][0] != leg["caches"][-1]:
+                return False
+            for st in leg["caches"][-1].values():
+                if st["fwd"] != v or st["apply"] != v:
+                    return False
+                if not v <= st["bwd"] <= 2 * v:
+                    return False
+            return True
+
+        cache_ok = leg_cache_ok(fp32) and leg_cache_ok(i8)
+        wire_ratio = i8["stats"]["wire_reduction_vs_fp32"]
+        loss_gap = max(abs(a - b) for a, b in zip(fp32["losses"],
+                                                  i8["losses"]))
+        out = {
+            "steps": steps,
+            "microbatches": microbatches,
+            "virtual_per_rank": v,
+            "results_ok": len(fp32["losses"]) == steps
+            and len(i8["losses"]) == steps,
+            "driver_syncs_steady": fp32["driver_syncs"]
+            + i8["driver_syncs"],
+            "jit_cache_constant": cache_ok,
+            "wire_reduction_vs_fp32": round(wire_ratio, 2),
+            "wire_ok": wire_ratio >= 3.0,
+            "int8_loss_gap": round(loss_gap, 4),
+            "loss_envelope_ok": loss_gap < 0.05,
+            "zero_opt_bytes_ratio": round(i8["zero_ratio"], 3),
+            "zero_ok": i8["zero_ratio"] <= 0.5 + 0.05,
+            "bubble_fraction": round(
+                i8["stats"]["bubble_fraction"] or 0.0, 4),
+        }
+        out["ok"] = bool(out["results_ok"]
+                         and out["driver_syncs_steady"] == 0
+                         and out["jit_cache_constant"] and out["wire_ok"]
+                         and out["loss_envelope_ok"] and out["zero_ok"])
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def run_serving_smoke(max_new: int = 10) -> dict:
     """Continuous-batching inference invariants (tier-1 guard for
     ISSUE 8; one in-process engine "replica", no timing assertions):
@@ -901,9 +1026,11 @@ def main() -> int:
     out["mpmd"] = mpmd
     fl = run_flow_smoke()
     out["flow"] = fl
+    td = run_3d_smoke()
+    out["threed"] = td
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
                      and rpc["ok"] and nl["ok"] and sv["ok"] and zr["ok"]
-                     and mpmd["ok"] and fl["ok"])
+                     and mpmd["ok"] and fl["ok"] and td["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
