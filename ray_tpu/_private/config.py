@@ -162,6 +162,14 @@ CONFIG \
              "decode step's fixed batch dimension).") \
     .declare("serve_page_size", int, 16,
              "Tokens per KV-cache page in the LLM engine's paged pool.") \
+    .declare("serve_spec_tokens", int, 0,
+             "Speculative-decode window (tokens verified per target "
+             "step; >= 2 with a draft model, 0 = plain decode).") \
+    .declare("serve_prefill_min_tokens", int, 32,
+             "Uncached-tail length at which an admission is offloaded "
+             "to a disaggregated prefill replica.") \
+    .declare("serve_prefix_cache_bytes", int, 256 * 1024 * 1024,
+             "Per-replica host LRU budget for prefix-cache KV pages.") \
     .declare("tcp_host", str, "127.0.0.1",
              "Head TCP bind host (0.0.0.0 to accept remote nodes).") \
     .declare("chaos_delay_us", int, 0,

@@ -64,6 +64,27 @@ class LlamaConfig:
         kw.setdefault("intermediate_size", 5632)
         return cls(**kw)
 
+    @classmethod
+    def draft_of(cls, target: "LlamaConfig", num_layers: int = 1,
+                 num_heads: Optional[int] = None,
+                 num_kv_heads: Optional[int] = None,
+                 hidden_size: Optional[int] = None, **kw):
+        """A speculative-decoding draft config for ``target``: same
+        vocab, context length and dtype (the serve engine's hard
+        requirements), everything else shrunk — one layer at half width
+        by default, GQA ratio preserved."""
+        heads = num_heads or max(1, target.num_heads // 2)
+        kvh = num_kv_heads or max(
+            1, heads * target.num_kv_heads // target.num_heads)
+        heads -= heads % kvh  # q heads must group evenly over kv heads
+        hidden = hidden_size or max(heads * 8, target.hidden_size // 2)
+        hidden -= hidden % heads
+        return cls(vocab_size=target.vocab_size,
+                   max_position_embeddings=target.max_position_embeddings,
+                   num_layers=num_layers, num_heads=heads,
+                   num_kv_heads=kvh, hidden_size=hidden,
+                   rope_theta=target.rope_theta, dtype=target.dtype, **kw)
+
     @property
     def block_params(self) -> int:
         """Parameters per decoder block: q/o at h^2, GQA k/v at

@@ -199,3 +199,43 @@ def comm_bytes_accounting(n_params: int, world: int, *,
         "reduction_vs_fp32": (baseline / grad) if grad else 1.0,
     }
     return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side (numpy) mirror of the block-int8 format — the serving tier's
+# KV-page wire (serve/prefill.py, serve/prefix_cache.py) packs pages on
+# the host, where a jit per page shape would cost more than the copy.
+# Bitwise-compatible with quantize_block_int8/dequantize_block_int8
+# (same padding, same round-half-to-even, same f32 scales), asserted by
+# tests/test_serving_tier.py.
+# ---------------------------------------------------------------------------
+def quantize_block_int8_np(x, block: int = DEFAULT_BLOCK):
+    """Numpy twin of :func:`quantize_block_int8` (deterministic rounding
+    only).  Returns ``(q int8, scales f32)`` with the trailing axis
+    padded up to a block multiple, exactly like the jax version."""
+    import numpy as np
+
+    x = np.asarray(x, np.float32)
+    pad = (-x.shape[-1]) % block
+    if pad:
+        x = np.concatenate(
+            [x, np.zeros(x.shape[:-1] + (pad,), np.float32)], axis=-1)
+    blocks = x.reshape(x.shape[:-1] + (-1, block))
+    absmax = np.max(np.abs(blocks), axis=-1)
+    scales = (absmax / 127.0).astype(np.float32)
+    v = blocks / (scales[..., None] + _EPS)
+    q = np.clip(np.round(v), -127, 127).astype(np.int8)
+    return q.reshape(x.shape[:-1] + (-1,)), scales
+
+
+def dequantize_block_int8_np(q, scales, n: int, dtype=None):
+    """Numpy twin of :func:`dequantize_block_int8`."""
+    import numpy as np
+
+    q = np.asarray(q)
+    scales = np.asarray(scales, np.float32)
+    block = q.shape[-1] // scales.shape[-1]
+    blocks = q.reshape(q.shape[:-1] + (scales.shape[-1], block))
+    out = blocks.astype(np.float32) * scales[..., None]
+    out = out.reshape(q.shape[:-1] + (-1,))[..., :n]
+    return out.astype(dtype) if dtype is not None else out

@@ -28,10 +28,22 @@ from ray_tpu.serve.autoscaling import calculate_desired_num_replicas  # noqa: F4
 from ray_tpu.serve.asgi import ASGIAdapter, ingress  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 
-# The LLM decode engine pulls in jax/flax — load it lazily so importing
-# ray_tpu.serve stays cheap for deployments that never touch a model.
+# Sampling params and the prefix-cache surface are import-light (no jax
+# at module scope) — export them eagerly.
+from ray_tpu.serve.sampling import SamplingParams  # noqa: F401
+from ray_tpu.serve.prefix_cache import (  # noqa: F401
+    PrefixCacheLocal,
+    PrefixDirectory,
+    affinity_key,
+    create_directory,
+)
+
+# The LLM decode engine and prefill worker pull in jax/flax — load them
+# lazily so importing ray_tpu.serve stays cheap for deployments that
+# never touch a model.
 _LLM_EXPORTS = ("LLMEngine", "LLMServer", "NaiveLM", "PagePool",
                 "build_model", "generate_many")
+_PREFILL_EXPORTS = ("PrefillWorker", "PrefillClient")
 
 
 def __getattr__(name):
@@ -39,4 +51,8 @@ def __getattr__(name):
         from ray_tpu.serve import llm_engine
 
         return getattr(llm_engine, name)
+    if name in _PREFILL_EXPORTS:
+        from ray_tpu.serve import prefill
+
+        return getattr(prefill, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

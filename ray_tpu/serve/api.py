@@ -58,11 +58,32 @@ class _Replica:
         return True
 
 
+def _replica_key(r) -> str:
+    """Stable identity of a replica actor across handle copies (the
+    rendezvous-hash input for cache-affinity routing)."""
+    aid = getattr(r, "_actor_id", None)
+    if aid is None:
+        return f"id:{id(r)}"
+    try:
+        return aid.hex()
+    except AttributeError:
+        return str(aid)
+
+
 class DeploymentHandle:
     """Router over a *mutable* replica set: least-loaded assignment with an
     in-flight cap, live queue metrics for the controller, and dynamic
     add/remove so autoscaling reconfigures in place (reference:
-    Router/ReplicaSet, serve/_private/router.py:62,221)."""
+    Router/ReplicaSet, serve/_private/router.py:62,221).
+
+    **Cache-affinity routing**: ``remote(..., _affinity=key)`` rendezvous-
+    hashes the key over the live replica ids (serve/prefix_cache.py), so
+    every router — driver handle and every node proxy — sends requests
+    sharing a prompt prefix to the replica already holding its cached KV
+    pages, with no shared routing state and automatic remapping when
+    autoscaling changes the set.  A saturated preferred replica falls
+    back to the normal least-loaded path (affinity is a hint, never a
+    hotspot amplifier)."""
 
     def __init__(self, name: str, replicas: List[Any],
                  max_in_flight_per_replica: int = 8):
@@ -72,21 +93,48 @@ class DeploymentHandle:
         self._rr = 0
         self._cap = max_in_flight_per_replica
         self._lock = threading.Lock()
+        self._affinity_hits = 0
+        self._affinity_misses = 0
 
-    def remote(self, *args, _method: str = "__call__", **kwargs):
+    def __reduce__(self):
+        # A handle serializes as a SNAPSHOT of its replica set (actor
+        # handles pickle; the lock and in-flight counters are
+        # per-process router state, rebuilt empty).  This is what lets
+        # a deployment handle ride bind args into another deployment's
+        # replicas — e.g. the decode engine's ``prefill=`` handle.  The
+        # copy does not see later autoscale events (the node proxies'
+        # route broadcast is the pattern for that).
+        with self._lock:
+            return (DeploymentHandle,
+                    (self.name, list(self._replicas), self._cap))
+
+    def remote(self, *args, _method: str = "__call__",
+               _affinity: Optional[str] = None, **kwargs):
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(f"deployment {self.name} has no replicas")
+            n = len(self._replicas)
+            pick = None
+            if _affinity is not None:
+                from ray_tpu.serve.prefix_cache import rendezvous_pick
+
+                i = rendezvous_pick(
+                    _affinity, [_replica_key(r) for r in self._replicas])
+                cand = self._replicas[i]
+                if self._in_flight[cand] < self._cap:
+                    pick = cand
+                    self._affinity_hits += 1
+                else:
+                    self._affinity_misses += 1
             # Round-robin start, pick the first under-cap replica; when all
             # are saturated take the least loaded (requests queue in the
             # actor's mailbox — that queue depth is the autoscaling signal).
-            n = len(self._replicas)
-            pick = None
-            for k in range(n):
-                r = self._replicas[(self._rr + k) % n]
-                if self._in_flight[r] < self._cap:
-                    pick = r
-                    break
+            if pick is None:
+                for k in range(n):
+                    r = self._replicas[(self._rr + k) % n]
+                    if self._in_flight[r] < self._cap:
+                        pick = r
+                        break
             if pick is None:
                 pick = min(self._replicas, key=lambda r: self._in_flight[r])
             self._rr = (self._rr + 1) % max(1, n)
@@ -124,7 +172,9 @@ class DeploymentHandle:
             n = max(1, len(self._replicas))
             return {"total_in_flight": float(total),
                     "avg_per_replica": total / n,
-                    "num_replicas": len(self._replicas)}
+                    "num_replicas": len(self._replicas),
+                    "affinity_hits": float(self._affinity_hits),
+                    "affinity_misses": float(self._affinity_misses)}
 
     def add_replica(self, replica):
         with self._lock:
@@ -412,7 +462,16 @@ def _make_http_handler(resolve):
                 return
             try:
                 payload = json.loads(body) if body else None
-                result = ray_tpu.get(handle.remote(payload))
+                affinity = None
+                if isinstance(payload, dict) and payload.get("tokens"):
+                    # LLM-shaped request: route by prompt-prefix affinity
+                    # so shared prefixes land on the replica that cached
+                    # their KV pages.
+                    from ray_tpu.serve.prefix_cache import affinity_key
+
+                    affinity = affinity_key(payload["tokens"])
+                result = ray_tpu.get(handle.remote(payload,
+                                                   _affinity=affinity))
                 out = json.dumps({"result": result}).encode()
                 self.send_response(200)
             except Exception as e:  # noqa: BLE001

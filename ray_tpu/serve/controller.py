@@ -76,8 +76,19 @@ class ServeController:
         from ray_tpu.serve.api import aggregate_queue_stats
 
         stats = aggregate_queue_stats(dep.name, handle, proxy_totals)
+        signal = stats["avg_per_replica"]
+        if cfg.get("metric_method"):
+            # Replica-reported load (e.g. LLMServer.autoscale_metric —
+            # in-flight work per decode slot): richer than router queue
+            # depth for engines that batch internally, where 8 queued
+            # requests on one replica may be a full batch (scale!) or
+            # an eighth of one (don't).  Best-effort: an unreachable
+            # replica falls back to the queue signal for this tick.
+            vals = self._poll_replica_metric(dep, cfg["metric_method"])
+            if vals:
+                signal = sum(vals) / len(vals)
         win = self._window.setdefault(dep.name, [])
-        win.append(stats["avg_per_replica"])
+        win.append(signal)
         look_back = max(1, int(cfg.get("look_back_polls", 3)))
         del win[:-look_back]
         avg = sum(win) / len(win)
@@ -125,6 +136,24 @@ class ServeController:
                 ray_tpu.kill(r)
             except Exception:
                 pass
+
+    def _poll_replica_metric(self, dep, method: str):
+        """One round of replica-load samples, polled concurrently with a
+        bounded wait — a slow replica costs one tick's sample, never a
+        stalled control loop."""
+        refs = []
+        for r in list(dep._replicas):
+            try:
+                refs.append(r.handle_request.remote(method, (), {}))
+            except Exception:
+                continue
+        vals = []
+        for ref in refs:
+            try:
+                vals.append(float(ray_tpu.get(ref, timeout=5)))
+            except Exception:
+                continue
+        return vals
 
     def shutdown(self):
         self._stop.set()

@@ -9,14 +9,14 @@ task/actor/object substrate, arxiv 1712.05889; engine design follows the
 continuous-batching literature — Orca's iteration-level scheduling and
 vLLM's paged KV cache).
 
-Three load-bearing ideas:
+Load-bearing ideas:
 
 1. **Fixed-slot compiled decode step.**  The decode program is compiled
    ONCE for `[max_slots]`-shaped inputs (token ids, lengths, page table,
-   active mask).  Admitting or retiring a request flips host-side state —
-   it never changes a traced shape, so the steady-state loop never
-   recompiles.  Prefill compiles per power-of-two prompt bucket (bounded:
-   log2(max_ctx) programs).
+   active mask, sampling params).  Admitting or retiring a request flips
+   host-side state — it never changes a traced shape, so the
+   steady-state loop never recompiles.  Prefill compiles per
+   power-of-two prompt bucket (bounded: log2(max_ctx) programs).
 
 2. **Token-boundary admission.**  The engine loop runs one decode step
    for ALL in-flight requests, then admits pending requests into free
@@ -33,8 +33,41 @@ Three load-bearing ideas:
    Long and short sequences share the pool without fragmentation, pages
    recycle at retirement, and when the pool runs dry the engine preempts
    the youngest request (its pages free; it restarts later from
-   prompt+generated-so-far — greedy decode is deterministic, so resumed
+   prompt+generated-so-far — decode is seed-deterministic, so resumed
    output is identical and already-streamed chunks are never re-sent).
+
+4. **Seeded sampling** (`serve/sampling.py`).  Temperature/top-p with a
+   per-request seed; the token at absolute position t is always drawn
+   with ``fold_in(PRNGKey(seed), t)``, so outputs are bitwise
+   reproducible across runs, schedules, preemption-resume, and the
+   speculative verify step.  ``temperature=0`` (default) is greedy
+   argmax — the token-identity contract with the uncached reference.
+
+5. **Speculative decoding.**  With a tiny ``draft_model``, each
+   iteration runs ``spec_tokens-1`` cheap draft steps proposing tokens,
+   then ONE target verify step over the `[max_slots, spec_tokens]`
+   window that samples the target's token at every position
+   (accept-longest-prefix).  Because sampling is position-seeded, the
+   accepted stream is *bitwise* the non-speculative stream — the draft
+   only changes how many tokens each target step yields.  The draft
+   shares the page table (its pages are a parallel set of arrays), so
+   page accounting stays single-pool.
+
+6. **Cluster-wide prefix cache** (`serve/prefix_cache.py`).  After
+   prefill, every full page's K/V is content-addressed by the blake2b
+   of the token prefix that produced it, kept in a host LRU, and
+   (optionally) published to the object plane via ``put_many`` +
+   registered in a shared PrefixDirectory actor.  Admission looks up
+   the longest cached prefix and prefills only the uncached tail
+   (a cache-aware "tail prefill" program per bucket).
+
+7. **Disaggregated prefill** (`serve/prefill.py`).  With a
+   ``prefill=`` client, admissions with a long uncached tail are
+   offloaded to dedicated prefill replicas: the engine reserves the
+   slot + pages, the remote worker computes the tail KV and streams the
+   pages back as object-plane refs (optionally int8 block-scaled via
+   ``ops/collectives``), and the engine adopts them at a later token
+   boundary — decode never stalls on a long prompt.
 
 Request/response payloads ride the object plane zero-copy: see
 ``generate_many`` (client: ``put_many`` prompts → replica:
@@ -53,6 +86,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ray_tpu.exceptions import EngineClosedError, KVPoolExhaustedError
+from ray_tpu.serve.sampling import GREEDY, SamplingParams
 
 _DEF = object()  # sentinel: constructor arg not given, consult CONFIG
 
@@ -130,6 +164,7 @@ class _Request:
     prompt: List[int]
     max_new_tokens: int
     eos_id: Optional[int]
+    sampling: SamplingParams = GREEDY
     submitted: float = dataclasses.field(default_factory=time.monotonic)
     out: List[int] = dataclasses.field(default_factory=list)
     chunks: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
@@ -138,10 +173,18 @@ class _Request:
     error: Optional[BaseException] = None
     streamed: int = 0  # tokens already pushed to the chunk stream
     admit_seq: int = -1  # preemption picks the youngest (highest seq)
+    # Consumption mark: True once the caller has the terminal state
+    # (result() returned / raised, or the None chunk was delivered).
+    # The registry's size bound only evicts consumed requests — evicting
+    # a finished-but-undrained streaming request would silently lose its
+    # tail chunks.
+    consumed: bool = False
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def context(self) -> List[int]:
         """Prompt plus generated-so-far — what a (re)admission prefills.
-        Greedy decode is deterministic, so a preempted request resumed
+        Decode is seed-deterministic, so a preempted request resumed
         from this context produces exactly the tokens it would have."""
         return self.prompt + self.out
 
@@ -158,17 +201,30 @@ class LLMEngine:
     """Replica-resident continuous-batching decode engine.
 
     ``submit()`` is thread-safe and returns immediately; a background
-    loop thread owns all device state and serializes prefill/decode.
-    ``result()`` blocks for the full output, ``stream()`` yields token
-    chunks as they are produced (chunks arrive while the request is
-    still decoding).  Greedy (argmax) decoding only — the token-identity
-    contract with the uncached reference is what the correctness gates
-    assert."""
+    flow.Stage (sink mode) owns all device state and serializes
+    prefill/decode.  ``result()`` blocks for the full output,
+    ``stream()`` yields token chunks as they are produced (chunks
+    arrive while the request is still decoding).  Default sampling is
+    greedy (argmax) — the token-identity contract with the uncached
+    reference is what the correctness gates assert; per-request
+    temperature/top-p/seed turn on real (still deterministic)
+    sampling."""
+
+    # Registry size bound: evict CONSUMED finished requests past LIMIT,
+    # down to FLOOR (a long-lived replica must not leak one _Request per
+    # call, but an undrained streaming request is never dropped).
+    REGISTRY_LIMIT = 4096
+    REGISTRY_FLOOR = 2048
 
     def __init__(self, model, params, *, max_slots=_DEF, page_size=_DEF,
                  num_pages: Optional[int] = None,
                  max_ctx: Optional[int] = None,
-                 chunk_tokens: int = 8, start: bool = True):
+                 chunk_tokens: int = 8, start: bool = True,
+                 draft_model=None, draft_params=None, spec_tokens=_DEF,
+                 draft_window: Optional[int] = None,
+                 prefix_cache=None, cache_namespace: str = "",
+                 prefix_directory=None, directory_timeout_s: float = 5.0,
+                 prefill=None, prefill_min_tokens=_DEF):
         import jax
         import jax.numpy as jnp
 
@@ -202,17 +258,104 @@ class LLMEngine:
         self._k_pages = jnp.zeros(shape, self.dtype)
         self._v_pages = jnp.zeros(shape, self.dtype)
 
+        # ---- speculative decoding (draft + verify) ----
+        self.spec_tokens = int(_cfg("serve_spec_tokens", spec_tokens,
+                                    4 if draft_model is not None else 0))
+        self._draft_model = draft_model
+        self._draft_params = draft_params
+        self._spec = draft_model is not None and self.spec_tokens >= 2
+        if draft_model is not None and not self._spec:
+            raise ValueError(
+                f"speculative decoding needs spec_tokens >= 2, got "
+                f"{self.spec_tokens}")
+        if self._spec:
+            dc = draft_model.config
+            if dc.vocab_size != c.vocab_size or \
+                    dc.max_position_embeddings < self.max_ctx:
+                raise ValueError(
+                    "draft model must share the target's vocab and cover "
+                    "its max_ctx "
+                    f"(draft vocab {dc.vocab_size} vs {c.vocab_size}, "
+                    f"positions {dc.max_position_embeddings} vs "
+                    f"{self.max_ctx})")
+            dshape = (dc.num_layers, num_pages, self.page_size,
+                      getattr(dc, "num_kv_heads", dc.num_heads), dc.head_dim)
+            self._dk_pages = jnp.zeros(dshape, dc.dtype)
+            self._dv_pages = jnp.zeros(dshape, dc.dtype)
+        # Sliding-window draft attention: the draft's page gather — the
+        # dominant per-step cost at long context — shrinks from
+        # pages_per_slot to ceil(draft_window / page_size) pages.
+        self._draft_window_pages = None
+        if draft_window is not None:
+            if not self._spec:
+                raise ValueError("draft_window needs a draft model")
+            self._draft_window_pages = max(
+                2, math.ceil(int(draft_window) / self.page_size))
+
+        # ---- prefix cache ----
+        from ray_tpu.serve import prefix_cache as pc
+
+        if prefix_cache is True:
+            prefix_cache = pc.PrefixCacheLocal(
+                int(_cfg("serve_prefix_cache_bytes", _DEF,
+                         256 * 1024 * 1024)))
+        self._prefix = prefix_cache or None
+        self._directory = prefix_directory
+        self._directory_timeout = float(directory_timeout_s)
+        if not cache_namespace:
+            cache_namespace = (f"{type(model).__name__}|{c!r}|"
+                               f"ps{self.page_size}")
+        self._namespace = cache_namespace
+        # Refs for pages this replica published: keeps the object alive
+        # across the publish handoff even if the directory is slow to
+        # pin; bounded (the directory is the durable holder).
+        self._published_refs: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+
+        # ---- disaggregated prefill ----
+        self._prefill_min = int(_cfg("serve_prefill_min_tokens",
+                                     prefill_min_tokens, 32))
+        self._prefill_client = None
+        if prefill is not None:
+            from ray_tpu.serve.prefill import as_prefill_client
+
+            self._prefill_client = as_prefill_client(prefill)
+        # (req, job, start_tokens) awaiting remote KV — NOTHING is
+        # reserved while a prefill is in flight (a held slot would
+        # starve interactive admissions behind a long-prompt burst);
+        # completed payloads park in _ready until a slot frees.
+        self._awaiting: List[tuple] = []
+        self._ready: collections.deque = collections.deque()
+        self._prefill_max_inflight = 2 * self.max_slots
+
         # Host-side slot state (the loop thread is the only writer).
         self._table = np.zeros((self.max_slots, self.pages_per_slot),
                                np.int32)
         self._lengths = np.zeros((self.max_slots,), np.int32)
         self._active = np.zeros((self.max_slots,), bool)
         self._last_tok = np.zeros((self.max_slots,), np.int32)
+        self._temps = np.zeros((self.max_slots,), np.float32)
+        self._top_ps = np.ones((self.max_slots,), np.float32)
+        self._seeds = np.zeros((self.max_slots,), np.int32)
         self._slot_pages: List[List[int]] = [[] for _ in range(self.max_slots)]
         self._slot_req: Dict[int, _Request] = {}
 
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2))
-        self._prefills: Dict[int, Any] = {}
+        self._decode = jax.jit(self._make_decode_step(model),
+                               donate_argnums=(1, 2))
+        if self._spec:
+            self._draft_decode = jax.jit(
+                self._make_decode_step(
+                    draft_model, window_pages=self._draft_window_pages),
+                donate_argnums=(1, 2))
+            self._verify = jax.jit(self._make_verify_step(model),
+                                   donate_argnums=(1, 2))
+        self._adopt = jax.jit(self._make_adopt(self.dtype),
+                              donate_argnums=(0, 1))
+        self._adopt_buf_k = np.zeros(
+            (self.num_layers, self.pages_per_slot, self.page_size,
+             self.kv_heads, self.head_dim), np.float32)
+        self._adopt_buf_v = np.zeros_like(self._adopt_buf_k)
+        self._prefills: Dict[Any, Any] = {}
 
         self._pending: collections.deque = collections.deque()
         self._requests: Dict[int, _Request] = {}
@@ -226,17 +369,27 @@ class LLMEngine:
         self._t0 = time.monotonic()
         self._metrics = None
         self._metrics_flush = 0.0
-        self._thread: Optional[threading.Thread] = None
+        self._stage = None
         if start:
-            self._thread = threading.Thread(
-                target=self._loop, name="rtpu-llm-engine", daemon=True)
-            self._thread.start()
+            # The engine loop is a sink stage on the async dataflow
+            # substrate: the tick source runs until the stage's token
+            # cancels, one fn call per engine iteration, and close()
+            # joins the worker thread through the substrate.
+            from ray_tpu.parallel import flow
+
+            self._stage = flow.Stage(
+                self._tick_source(), self._iteration, sink=True, workers=1,
+                name="llm_engine", export_metrics=False)
 
     # ------------------------------------------------------------------
     # public API (any thread)
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> int:
+               eos_id: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None,
+               temperature: Optional[float] = None,
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None) -> int:
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -244,12 +397,19 @@ class LLMEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_ctx {self.max_ctx}")
+        if sampling is None:
+            sampling = SamplingParams(
+                temperature=0.0 if temperature is None else float(temperature),
+                top_p=1.0 if top_p is None else float(top_p),
+                seed=0 if seed is None else int(seed))
+        sampling.validate()
         with self._cond:
             if self._closed:
                 raise EngineClosedError("engine is closed")
             rid = self._next_id
             self._next_id += 1
-            req = _Request(rid, prompt, max_new_tokens, eos_id)
+            req = _Request(rid, prompt, max_new_tokens, eos_id,
+                           sampling=sampling)
             self._requests[rid] = req
             self._pending.append(req)
             self._cond.notify_all()
@@ -259,6 +419,7 @@ class LLMEngine:
         req = self._requests[rid]
         if not req.done.wait(timeout):
             raise TimeoutError(f"request {rid} not done within {timeout}s")
+        req.consumed = True
         if req.error is not None:
             raise req.error
         return list(req.out)
@@ -272,13 +433,26 @@ class LLMEngine:
             if chunk is None:
                 break
             yield chunk
+        req.consumed = True
         if req.error is not None:
             raise req.error
+
+    def request_stats(self, rid: int) -> Dict[str, Any]:
+        """Per-request accounting (speculative acceptance metrics)."""
+        req = self._requests[rid]
+        return {
+            "tokens": len(req.out),
+            "spec_proposed": req.spec_proposed,
+            "spec_accepted": req.spec_accepted,
+            "spec_acceptance_rate": (req.spec_accepted / req.spec_proposed
+                                     if req.spec_proposed else 0.0),
+        }
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             n_active = int(self._active.sum())
             s = dict(self._stats)
+            n_awaiting = len(self._awaiting) + len(self._ready)
         pool = self.pool.stats()
         steps = s.get("steps", 0)
         out = {
@@ -296,7 +470,28 @@ class LLMEngine:
             "pages_free": pool["free"],
             "page_pool": pool,
             "prefill_buckets": len(self._prefills),
+            # sampling / speculative decoding
+            "spec_steps": s.get("spec_steps", 0),
+            "spec_proposed": s.get("spec_proposed", 0),
+            "spec_accepted": s.get("spec_accepted", 0),
+            "spec_acceptance_rate": (
+                s.get("spec_accepted", 0) / s.get("spec_proposed", 1)
+                if s.get("spec_proposed", 0) else 0.0),
+            # prefix cache
+            "prefix_hit_pages": s.get("prefix_hit_pages", 0),
+            "prefix_remote_hit_pages": s.get("prefix_remote_hit_pages", 0),
+            "prefix_published_pages": s.get("prefix_published_pages", 0),
+            "prefill_tokens": s.get("prefill_tokens", 0),
+            "prefill_tokens_saved": s.get("prefill_tokens_saved", 0),
+            # disaggregated prefill
+            "prefill_offloaded": s.get("prefill_offloaded", 0),
+            "prefill_inflight": n_awaiting,
+            "prefill_prefix_fallback": s.get("prefill_prefix_fallback", 0),
+            "wire_bytes": s.get("wire_bytes", 0),
+            "wire_fp32_bytes": s.get("wire_fp32_bytes", 0),
         }
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
         cache_size = getattr(self._decode, "_cache_size", None)
         if callable(cache_size):
             out["decode_cache_size"] = cache_size()
@@ -308,8 +503,8 @@ class LLMEngine:
                 return
             self._closed = True
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout)
+        if self._stage is not None:
+            self._stage.close()
         err = EngineClosedError("engine closed with requests in flight")
         for req in list(self._requests.values()):
             if not req.done.is_set():
@@ -318,56 +513,172 @@ class LLMEngine:
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
-    def _gather_cache(self, pages, table):
-        """[L, P, ps, Hkv, D] pages + [slots, pp] table → per-slot
-        contiguous [L, slots, max_ctx, Hkv, D] attention view (rows past
-        each slot's length are garbage — masked by cached_attention)."""
-        g = pages[:, table]  # [L, slots, pp, ps, Hkv, D]
-        return g.reshape(self.num_layers, table.shape[0], self.max_ctx,
-                         self.kv_heads, self.head_dim)
+    def _gather_for(self, cfg):
+        """Pages + [slots, pp] table → per-slot contiguous
+        [L, slots, max_ctx, Hkv, D] attention view (rows past each
+        slot's length are garbage — masked by cached_attention)."""
+        L = cfg.num_layers
+        hkv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        d, mc = cfg.head_dim, self.max_ctx
 
-    def _decode_impl(self, params, k_pages, v_pages, table, lengths,
-                     tokens, active):
+        def gather(pages, table):
+            g = pages[:, table]  # [L, slots, pp, ps, Hkv, D]
+            return g.reshape(L, table.shape[0], mc, hkv, d)
+
+        return gather
+
+    def _make_decode_step(self, model, window_pages: Optional[int] = None):
         """One token for every slot (fixed shapes — compiled once).
-        Inactive lanes compute garbage routed to the scratch page."""
+        Inactive lanes compute garbage routed to the scratch page.
+        Shared shape for the target and the draft model (each gets its
+        own jit over its own page arrays).
+
+        ``window_pages`` (draft only) switches the attention view to a
+        sliding window of the LAST n pages: the page gather — the
+        step's dominant cost at long context — shrinks from
+        pages_per_slot to n.  Positional information is baked into the
+        cached K/V at write time (learned embeddings at embed, rope at
+        projection), so a windowed view plus window-relative valid
+        lengths is exact windowed attention, no re-indexing.  The
+        target never does this (it must attend to everything); the
+        draft is a guesser, and the verify step catches what the
+        shortened horizon loses."""
         jnp = self._jnp
-        L = self.num_layers
-        k_cache = self._gather_cache(k_pages, table)
-        v_cache = self._gather_cache(v_pages, table)
-        kv = [(k_cache[i], v_cache[i]) for i in range(L)]
-        logits, new_kvs = self._model.apply(
-            {"params": params}, tokens[:, None], lengths[:, None], kv,
-            lengths)
-        next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        newk = jnp.stack([nk[0][:, 0] for nk in new_kvs])  # [L,slots,Hkv,D]
-        newv = jnp.stack([nk[1][:, 0] for nk in new_kvs])
-        slot_ix = jnp.arange(table.shape[0])
-        page_col = jnp.minimum(lengths // self.page_size,
-                               self.pages_per_slot - 1)
-        page_idx = jnp.where(active, table[slot_ix, page_col], 0)
-        off = lengths % self.page_size
-        k_pages = k_pages.at[:, page_idx, off].set(newk.astype(self.dtype))
-        v_pages = v_pages.at[:, page_idx, off].set(newv.astype(self.dtype))
-        return k_pages, v_pages, next_tok
+        cfg = model.config
+        L, ps, pp = cfg.num_layers, self.page_size, self.pages_per_slot
+        from ray_tpu.serve.sampling import sample_tokens
+
+        hkv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        if window_pages is None or window_pages >= pp:
+            gather = self._gather_for(cfg)
+
+            def gather_view(pages, table, lengths):
+                return gather(pages, table), lengths
+        else:
+            wp = int(window_pages)
+
+            def gather_view(pages, table, lengths):
+                # Pages [(len-1)//ps - wp + 1 .. (len-1)//ps], clamped:
+                # the newest wp pages.  Valid rows within the view are
+                # lengths - start*ps (window-relative).
+                last_page = jnp.maximum(lengths - 1, 0) // ps
+                start = jnp.maximum(last_page - (wp - 1), 0)
+                cols = start[:, None] + jnp.arange(wp)[None]
+                idx = jnp.take_along_axis(
+                    table, jnp.minimum(cols, pp - 1), axis=1)
+                g = pages[:, idx]  # [L, slots, wp, ps, Hkv, D]
+                view = g.reshape(L, table.shape[0], wp * ps, hkv,
+                                 cfg.head_dim)
+                return view, lengths - start * ps
+
+        def step(params, k_pages, v_pages, table, lengths, tokens, active,
+                 temps, top_ps, seeds):
+            k_cache, view_len = gather_view(k_pages, table, lengths)
+            v_cache, _ = gather_view(v_pages, table, lengths)
+            kv = [(k_cache[i], v_cache[i]) for i in range(L)]
+            logits, new_kvs = model.apply(
+                {"params": params}, tokens[:, None], lengths[:, None], kv,
+                view_len)
+            # The generated token sits at absolute position lengths + 1.
+            next_tok = sample_tokens(logits[:, -1], lengths + 1, temps,
+                                     top_ps, seeds)
+            newk = jnp.stack([nk[0][:, 0] for nk in new_kvs])
+            newv = jnp.stack([nk[1][:, 0] for nk in new_kvs])
+            slot_ix = jnp.arange(table.shape[0])
+            page_col = jnp.minimum(lengths // ps, pp - 1)
+            page_idx = jnp.where(active, table[slot_ix, page_col], 0)
+            off = lengths % ps
+            k_pages = k_pages.at[:, page_idx, off].set(
+                newk.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, page_idx, off].set(
+                newv.astype(v_pages.dtype))
+            return k_pages, v_pages, next_tok
+
+        return step
+
+    def _make_verify_step(self, model):
+        """Target-model verification of a [slots, k] speculative window:
+        one forward over the window, KV scattered for every position,
+        and the target's sampled token at every position — the host
+        applies accept-longest-prefix to the result."""
+        jnp = self._jnp
+        cfg = model.config
+        L, ps, pp = cfg.num_layers, self.page_size, self.pages_per_slot
+        k_win = self.spec_tokens
+        gather = self._gather_for(cfg)
+        from ray_tpu.serve.sampling import sample_tokens
+
+        def verify(params, k_pages, v_pages, table, lengths, window, active,
+                   temps, top_ps, seeds):
+            k_cache = gather(k_pages, table)
+            v_cache = gather(v_pages, table)
+            kv = [(k_cache[i], v_cache[i]) for i in range(L)]
+            positions = lengths[:, None] + jnp.arange(k_win)[None]
+            logits, new_kvs = model.apply(
+                {"params": params}, window, positions, kv, lengths)
+            newk = jnp.stack([nk[0] for nk in new_kvs])  # [L,slots,k,Hkv,D]
+            newv = jnp.stack([nk[1] for nk in new_kvs])
+            page_col = jnp.minimum(positions // ps, pp - 1)
+            page_idx = jnp.where(active[:, None],
+                                 jnp.take_along_axis(table, page_col, axis=1),
+                                 0)
+            off = positions % ps
+            k_pages = k_pages.at[:, page_idx, off].set(
+                newk.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, page_idx, off].set(
+                newv.astype(v_pages.dtype))
+            n = table.shape[0]
+            flat = logits.reshape(n * k_win, -1)
+            rep = lambda a: jnp.repeat(a, k_win)
+            sampled = sample_tokens(flat, (positions + 1).reshape(-1),
+                                    rep(temps), rep(top_ps), rep(seeds))
+            return k_pages, v_pages, sampled.reshape(n, k_win)
+
+        return verify
+
+    def _make_adopt(self, dtype):
+        """Scatter host-staged KV pages (prefix-cache hits, disaggregated
+        prefill payloads) into the device page arrays.  Fixed
+        [pages_per_slot] shape — compiled once; unused rows are routed
+        to the scratch page by the host-masked ids."""
+
+        def adopt(k_pages, v_pages, page_ids, k_new, v_new):
+            k_pages = k_pages.at[:, page_ids].set(
+                k_new.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, page_ids].set(
+                v_new.astype(v_pages.dtype))
+            return k_pages, v_pages
+
+        return adopt
 
     def _prefill_fn(self, bucket: int):
-        fn = self._prefills.get(bucket)
+        """Full-context prefill (empty cache): one program per pow2
+        bucket."""
+        key = ("full", bucket)
+        fn = self._prefills.get(key)
         if fn is not None:
             return fn
         jax, jnp = self._jax, self._jnp
+        model = self._model
         L, ps = self.num_layers, self.page_size
+        from ray_tpu.serve.sampling import sample_tokens
 
-        def prefill(params, k_pages, v_pages, row, tokens, p):
+        def prefill(params, k_pages, v_pages, row, tokens, p, temp, top_p,
+                    seed):
             """tokens: [bucket] ids padded past p; row: [pp] page table
-            row.  Returns updated pages + the greedy next token."""
+            row.  Returns updated pages + the sampled next token (the
+            token at absolute position p, key fold_in(seed, p))."""
             ids = tokens[None]
             positions = jnp.arange(bucket)[None]
             empty = [(jnp.zeros((1, 0, self.kv_heads, self.head_dim),
                                 self.dtype),) * 2 for _ in range(L)]
-            logits, new_kvs = self._model.apply(
+            logits, new_kvs = model.apply(
                 {"params": params}, ids, positions, empty,
                 jnp.zeros((1,), jnp.int32))
-            next_tok = jnp.argmax(logits[0, p - 1]).astype(jnp.int32)
+            next_tok = sample_tokens(
+                logits[0, p - 1][None], jnp.reshape(p, (1,)),
+                jnp.reshape(temp, (1,)), jnp.reshape(top_p, (1,)),
+                jnp.reshape(seed, (1,)))[0]
             t = jnp.arange(bucket)
             page_idx = jnp.where(t < p, row[t // ps], 0)
             off = t % ps
@@ -380,7 +691,95 @@ class LLMEngine:
             return k_pages, v_pages, next_tok
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
-        self._prefills[bucket] = fn
+        self._prefills[key] = fn
+        return fn
+
+    def _tail_prefill_fn(self, bucket: int):
+        """Cache-aware tail prefill: the first ``start`` tokens' KV is
+        already in the slot's pages (adopted from the prefix cache), so
+        only the tail runs through the model — the tail tokens attend to
+        the gathered cache prefix plus themselves.  One program per pow2
+        tail bucket."""
+        key = ("tail", bucket)
+        fn = self._prefills.get(key)
+        if fn is not None:
+            return fn
+        jax, jnp = self._jax, self._jnp
+        model = self._model
+        L, ps, pp = self.num_layers, self.page_size, self.pages_per_slot
+        gather = self._gather_for(model.config)
+        from ray_tpu.serve.sampling import sample_tokens
+
+        def tail_prefill(params, k_pages, v_pages, row, tokens, start, p,
+                         temp, top_p, seed):
+            """tokens: [bucket] tail ids (absolute positions start..p-1)
+            padded past p-start; returns updated pages + the sampled
+            next token at absolute position p."""
+            k_cache = gather(k_pages, row[None])  # [L, 1, max_ctx, Hkv, D]
+            v_cache = gather(v_pages, row[None])
+            kv = [(k_cache[i], v_cache[i]) for i in range(L)]
+            positions = (start + jnp.arange(bucket))[None]
+            logits, new_kvs = model.apply(
+                {"params": params}, tokens[None], positions, kv,
+                jnp.reshape(start, (1,)))
+            tail_len = p - start
+            next_tok = sample_tokens(
+                logits[0, tail_len - 1][None], jnp.reshape(p, (1,)),
+                jnp.reshape(temp, (1,)), jnp.reshape(top_p, (1,)),
+                jnp.reshape(seed, (1,)))[0]
+            t = jnp.arange(bucket)
+            abs_pos = start + t
+            page_idx = jnp.where(
+                t < tail_len, row[jnp.minimum(abs_pos // ps, pp - 1)], 0)
+            off = abs_pos % ps
+            newk = jnp.stack([nk[0][0] for nk in new_kvs])
+            newv = jnp.stack([nk[1][0] for nk in new_kvs])
+            k_pages = k_pages.at[:, page_idx, off].set(
+                newk.astype(self.dtype))
+            v_pages = v_pages.at[:, page_idx, off].set(
+                newv.astype(self.dtype))
+            return k_pages, v_pages, next_tok
+
+        fn = jax.jit(tail_prefill, donate_argnums=(1, 2))
+        self._prefills[key] = fn
+        return fn
+
+    def _draft_prefill_fn(self, bucket: int):
+        """Draft-model full prefill (KV only, no sampling): in spec mode
+        every admission warms the draft cache for the whole context —
+        the draft is tiny by construction, so this is the cheap price of
+        keeping the prefix cache and the KV wire draft-agnostic."""
+        key = ("draft", bucket)
+        fn = self._prefills.get(key)
+        if fn is not None:
+            return fn
+        jax, jnp = self._jax, self._jnp
+        model = self._draft_model
+        dc = model.config
+        L, ps = dc.num_layers, self.page_size
+        hkv = getattr(dc, "num_kv_heads", dc.num_heads)
+
+        def prefill(params, k_pages, v_pages, row, tokens, p):
+            ids = tokens[None]
+            positions = jnp.arange(bucket)[None]
+            empty = [(jnp.zeros((1, 0, hkv, dc.head_dim), dc.dtype),) * 2
+                     for _ in range(L)]
+            _, new_kvs = model.apply(
+                {"params": params}, ids, positions, empty,
+                jnp.zeros((1,), jnp.int32))
+            t = jnp.arange(bucket)
+            page_idx = jnp.where(t < p, row[t // ps], 0)
+            off = t % ps
+            newk = jnp.stack([nk[0][0] for nk in new_kvs])
+            newv = jnp.stack([nk[1][0] for nk in new_kvs])
+            k_pages = k_pages.at[:, page_idx, off].set(
+                newk.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, page_idx, off].set(
+                newv.astype(v_pages.dtype))
+            return k_pages, v_pages
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefills[key] = fn
         return fn
 
     def _bucket_for(self, p: int) -> int:
@@ -390,29 +789,46 @@ class LLMEngine:
         return min(b, self.max_ctx)
 
     # ------------------------------------------------------------------
-    # engine loop (single thread owns the device state)
+    # engine loop (one flow.Stage sink worker owns the device state)
     # ------------------------------------------------------------------
-    def _loop(self):
+    def _tick_source(self):
         while True:
             with self._cond:
-                while (not self._closed and not self._pending
-                       and not self._active.any()):
-                    self._cond.wait(0.2)
                 if self._closed:
                     return
-            try:
-                self._admit()
-                self._grow()
-                if self._active.any():
-                    self._decode_once()
-            except BaseException as e:  # noqa: BLE001 — fail loudly per req
-                self._fail_all(e)
+            if self._stage is not None and self._stage.token.cancelled:
                 return
-            self._flush_metrics()
+            yield None
+
+    def _iteration(self, _tick):
+        with self._cond:
+            while (not self._closed and not self._pending
+                   and not self._awaiting and not self._ready
+                   and not self._active.any()):
+                self._cond.wait(0.2)
+                if self._stage is not None and self._stage.token.cancelled:
+                    return
+            if self._closed:
+                return
+        try:
+            self._poll_prefill()
+            self._admit()
+            self._grow()
+            if self._active.any():
+                if self._spec:
+                    self._decode_once_spec()
+                else:
+                    self._decode_once()
+        except BaseException as e:  # noqa: BLE001 — fail loudly per req
+            self._fail_all(e)
+            return
+        self._flush_metrics()
 
     def _fail_all(self, e: BaseException):
         with self._lock:
             self._closed = True  # a dead loop must reject new submits
+            self._awaiting = []
+            self._ready.clear()
         for req in list(self._requests.values()):
             if not req.done.is_set():
                 req.finish(error=e)
@@ -422,21 +838,26 @@ class LLMEngine:
                 self._slot_pages[s] = []
         self._active[:] = False
 
+    # ------------------------------------------------------------------
+    # admission: prefix-cache lookup, local prefill or remote offload
+    # ------------------------------------------------------------------
     def _admit(self):
-        """Token-boundary admission: fill free slots from the pending
-        queue, one prefill each.  Requires prompt pages + 1 free so the
-        first decode token can't immediately force a preemption."""
+        """Token-boundary admission: activate completed remote prefills
+        first, then fill free slots from the pending queue, one prefill
+        each.  Requires prompt pages + 1 free so the first decode token
+        can't immediately force a preemption.  Offload decisions happen
+        BEFORE any slot or page is reserved — a long-prompt burst
+        streams out to the prefill replicas immediately and interactive
+        requests behind it admit without waiting."""
+        self._activate_ready()
         while True:
             with self._lock:
                 if not self._pending:
                     return
-                free = [s for s in range(self.max_slots)
-                        if not self._active[s]]
-                if not free:
-                    return
                 req = self._pending[0]
                 ctx = req.context()
-                need = math.ceil(len(ctx) / self.page_size)
+                p = len(ctx)
+                need = math.ceil(p / self.page_size)
                 if need + 1 > self.pool.capacity:
                     # Can never fit, even with the whole pool to itself —
                     # waiting would busy-spin forever.
@@ -445,6 +866,26 @@ class LLMEngine:
                         f"request {req.id} needs {need + 1} pages but the "
                         f"pool holds {self.pool.capacity}"))
                     continue
+                inflight = len(self._awaiting) + len(self._ready)
+            if (self._prefill_client is not None
+                    and inflight < self._prefill_max_inflight):
+                # Uncached tail from the LOCAL cache view only (a
+                # directory round trip at submit time would serialize
+                # admissions; remote hits engage at activation).
+                start = self._local_prefix_run(ctx)
+                if p - start >= self._prefill_min:
+                    job = self._prefill_client.submit(ctx, start,
+                                                      req.sampling)
+                    with self._lock:
+                        self._pending.popleft()
+                        self._awaiting.append((req, job, start))
+                    self._stats["prefill_offloaded"] += 1
+                    continue
+            with self._lock:
+                free = [s for s in range(self.max_slots)
+                        if not self._active[s]]
+                if not free:
+                    return
                 pages = self.pool.alloc(need + 1)
                 if pages is None:
                     return  # pool too tight right now; retry next boundary
@@ -453,41 +894,319 @@ class LLMEngine:
                 self._pending.popleft()
                 slot = free[0]
                 mid_batch = bool(self._active.any())
-            self._stats["admitted"] += 1
-            if mid_batch:
-                self._stats["admitted_mid_batch"] += 1
-            self._observe_queue_wait(time.monotonic() - req.submitted)
             self._slot_pages[slot] = pages
             row = np.zeros((self.pages_per_slot,), np.int32)
             row[:need] = pages
             self._table[slot] = row
+            # Longest cached prefix: adopt its pages, prefill the tail.
+            cached = self._lookup_prefix(ctx)
+            start = len(cached) * self.page_size
+            if cached:
+                self._adopt_pages(slot, 0, cached)
+                self._stats["prefill_tokens_saved"] += start
+            nxt = self._local_prefill(slot, req, ctx, start)
+            self._finish_admission(slot, req, p, int(nxt), mid_batch)
+
+    def _local_prefix_run(self, ctx: List[int]) -> int:
+        """Length (tokens) of the leading full-page run present in the
+        LOCAL cache — contains() only, no fetch, no directory RPC."""
+        if self._prefix is None:
+            return 0
+        from ray_tpu.serve import prefix_cache as pc
+
+        keys = pc.prefix_page_keys(
+            self._namespace, ctx, self.page_size,
+            max_pages=(len(ctx) - 1) // self.page_size)
+        n = 0
+        for key in keys:
+            if not self._prefix.contains(key):
+                break
+            n += 1
+        return n * self.page_size
+
+    def _activate_ready(self):
+        """Admit completed remote prefills into free slots: allocate the
+        slot + pages now, re-adopt the cached prefix, adopt the streamed
+        tail pages, activate.  If the prefix was evicted during the
+        round trip (rare), fall back to a full local prefill — the tail
+        payload alone can't cover the missing positions."""
+        while self._ready:
+            req, result, start = self._ready[0]
+            ctx = req.context()
             p = len(ctx)
+            need = math.ceil(p / self.page_size)
+            with self._lock:
+                free = [s for s in range(self.max_slots)
+                        if not self._active[s]]
+                if not free:
+                    return
+                pages = self.pool.alloc(need + 1)
+                if pages is None:
+                    return
+                self.pool.free(pages[need:])
+                pages = pages[:need]
+                slot = free[0]
+                mid_batch = bool(self._active.any())
+                self._ready.popleft()
+            self._slot_pages[slot] = pages
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            row[:need] = pages
+            self._table[slot] = row
+            k_np, v_np, next_tok, meta = result
+            first_page = start // self.page_size
+            if start:
+                cached = self._lookup_prefix(ctx, max_pages=first_page)
+                if len(cached) < first_page:
+                    self._stats["prefill_prefix_fallback"] += 1
+                    hit = len(cached) * self.page_size
+                    if cached:
+                        self._adopt_pages(slot, 0, cached)
+                        self._stats["prefill_tokens_saved"] += hit
+                    nxt = self._local_prefill(slot, req, ctx, hit)
+                    self._finish_admission(slot, req, p, int(nxt),
+                                           mid_batch)
+                    continue
+                self._adopt_pages(slot, 0, cached)
+                self._stats["prefill_tokens_saved"] += start
+            self._adopt_pages(
+                slot, first_page,
+                [(k_np[:, j], v_np[:, j]) for j in range(k_np.shape[1])])
+            self._stats["wire_bytes"] += int(meta.get("wire_bytes", 0))
+            self._stats["wire_fp32_bytes"] += int(meta.get("fp32_bytes", 0))
+            if meta.get("exact", True):
+                self._publish_prefix(ctx, slot)
+            self._finish_admission(slot, req, p, int(next_tok), mid_batch)
+
+    def _local_prefill(self, slot: int, req: _Request, ctx: List[int],
+                       start: int):
+        """Run the (full or cache-aware tail) prefill into the slot's
+        pages; returns the sampled next token."""
+        p = len(ctx)
+        row = self._table[slot]
+        s = req.sampling
+        tail_len = p - start
+        self._stats["prefill_tokens"] += tail_len
+        if start == 0:
             bucket = self._bucket_for(p)
             toks = np.zeros((bucket,), np.int32)
             toks[:p] = ctx
             fn = self._prefill_fn(bucket)
             self._k_pages, self._v_pages, nxt = fn(
                 self._params, self._k_pages, self._v_pages, row, toks,
-                np.int32(p))
-            tok = int(nxt)
-            self._lengths[slot] = p
-            self._last_tok[slot] = tok
-            req.admit_seq = self._admit_counter
-            self._admit_counter += 1
-            with self._lock:
-                self._active[slot] = True
-            self._slot_req[slot] = req
-            self._append_token(slot, req, tok)
+                np.int32(p), np.float32(s.temperature), np.float32(s.top_p),
+                np.int32(s.seed))
+        else:
+            bucket = self._bucket_for(tail_len)
+            toks = np.zeros((bucket,), np.int32)
+            toks[:tail_len] = ctx[start:]
+            fn = self._tail_prefill_fn(bucket)
+            self._k_pages, self._v_pages, nxt = fn(
+                self._params, self._k_pages, self._v_pages, row, toks,
+                np.int32(start), np.int32(p), np.float32(s.temperature),
+                np.float32(s.top_p), np.int32(s.seed))
+        self._publish_prefix(ctx, slot)
+        return nxt
 
+    def _finish_admission(self, slot: int, req: _Request, p: int,
+                          next_tok: int, mid_batch: bool):
+        """Shared tail of every admission path: the slot's KV covers
+        positions [0, p) and ``next_tok`` is the sampled token at p."""
+        if self._spec:
+            self._warm_draft(slot, req.context())
+        s = req.sampling
+        self._stats["admitted"] += 1
+        if mid_batch:
+            self._stats["admitted_mid_batch"] += 1
+        self._observe_queue_wait(time.monotonic() - req.submitted)
+        self._lengths[slot] = p
+        self._last_tok[slot] = next_tok
+        self._temps[slot] = s.temperature
+        self._top_ps[slot] = s.top_p
+        self._seeds[slot] = s.seed
+        req.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        with self._lock:
+            self._active[slot] = True
+        self._slot_req[slot] = req
+        self._append_token(slot, req, next_tok)
+
+    def _warm_draft(self, slot: int, ctx: List[int]):
+        """Spec mode: full draft prefill of the context into the draft
+        page arrays (same table row as the target)."""
+        p = len(ctx)
+        bucket = self._bucket_for(p)
+        toks = np.zeros((bucket,), np.int32)
+        toks[:p] = ctx
+        fn = self._draft_prefill_fn(bucket)
+        self._dk_pages, self._dv_pages = fn(
+            self._draft_params, self._dk_pages, self._dv_pages,
+            self._table[slot], toks, np.int32(p))
+
+    # ------------------------------------------------------------------
+    # prefix cache: lookup / adopt / publish
+    # ------------------------------------------------------------------
+    def _lookup_prefix(self, ctx: List[int],
+                       max_pages: Optional[int] = None) -> List[tuple]:
+        """(k, v) host arrays for the longest cached run of leading full
+        pages — local LRU first, then the cluster directory (refs
+        fetched with one get_many and written through to the local
+        cache).  Capped at (len-1)//page_size so at least one position
+        is always freshly computed (the sampled next token needs a
+        logits row).  Never raises: a broken directory is a miss."""
+        if self._prefix is None:
+            return []
+        from ray_tpu.serve import prefix_cache as pc
+
+        p = len(ctx)
+        cap = (p - 1) // self.page_size
+        if max_pages is not None:
+            cap = min(cap, max_pages)
+        keys = pc.prefix_page_keys(self._namespace, ctx, self.page_size,
+                                   max_pages=cap)
+        out: List[tuple] = []
+        miss_at = len(keys)
+        for i, key in enumerate(keys):
+            entry = self._prefix.get(key)
+            if entry is None:
+                miss_at = i
+                break
+            out.append(entry)
+        if out:
+            self._stats["prefix_hit_pages"] += len(out)
+        if miss_at >= len(keys) or self._directory is None:
+            return out
+        try:
+            import ray_tpu
+
+            rest = keys[miss_at:]
+            entries = ray_tpu.get(
+                self._directory.lookup_many.remote(rest),
+                timeout=self._directory_timeout)
+            run = []
+            for e in entries:
+                if e is None:
+                    break
+                run.append(e)
+            if not run:
+                return out
+            refs = [r for e in run for r in e]
+            vals = ray_tpu.get_many(refs, timeout=self._directory_timeout)
+            for j in range(len(run)):
+                k_np, v_np = vals[2 * j], vals[2 * j + 1]
+                self._prefix.put(rest[j], k_np, v_np)
+                out.append((k_np, v_np))
+            self._stats["prefix_hit_pages"] += len(run)
+            self._stats["prefix_remote_hit_pages"] += len(run)
+        except Exception:
+            pass  # the cache is an optimization, never a failure source
+        return out
+
+    def _adopt_pages(self, slot: int, first_page: int, pages: List[tuple]):
+        """Scatter host (k, v) page arrays into the slot's device pages
+        starting at page index ``first_page`` (one fixed-shape compiled
+        scatter; unused rows route to scratch)."""
+        n = len(pages)
+        if n == 0:
+            return
+        ids = np.zeros((self.pages_per_slot,), np.int32)
+        ids[:n] = self._table[slot, first_page:first_page + n]
+        bk, bv = self._adopt_buf_k, self._adopt_buf_v
+        for j, (k_np, v_np) in enumerate(pages):
+            bk[:, j] = k_np
+            bv[:, j] = v_np
+        bk[:, n:] = 0
+        bv[:, n:] = 0
+        self._k_pages, self._v_pages = self._adopt(
+            self._k_pages, self._v_pages, ids, bk, bv)
+
+    def _publish_prefix(self, ctx: List[int], slot: int):
+        """Snapshot every full page of ``ctx`` into the local LRU and
+        (when a directory is attached) the object plane.  Pages are
+        immutable once full — the snapshot is a host copy, later decode
+        writes touch later pages."""
+        if self._prefix is None:
+            return
+        from ray_tpu.serve import prefix_cache as pc
+
+        p = len(ctx)
+        n_full = p // self.page_size
+        if n_full == 0:
+            return
+        keys = pc.prefix_page_keys(self._namespace, ctx, self.page_size,
+                                   max_pages=n_full)
+        to_publish = []
+        for i, key in enumerate(keys):
+            if self._prefix.contains(key):
+                continue
+            page_id = int(self._table[slot, i])
+            k_np = np.asarray(self._k_pages[:, page_id])
+            v_np = np.asarray(self._v_pages[:, page_id])
+            self._prefix.put(key, k_np, v_np)
+            self._stats["prefix_published_pages"] += 1
+            to_publish.append((key, k_np, v_np))
+        if self._directory is None or not to_publish:
+            return
+        try:
+            import ray_tpu
+
+            arrays = [a for _, k_np, v_np in to_publish
+                      for a in (k_np, v_np)]
+            refs = ray_tpu.put_many(arrays)
+            for j, (key, _, _) in enumerate(to_publish):
+                k_ref, v_ref = refs[2 * j], refs[2 * j + 1]
+                # Hold our refs across the publish handoff (bounded; the
+                # directory is the durable holder once it pins them).
+                self._published_refs[key] = (k_ref, v_ref)
+                while len(self._published_refs) > 256:
+                    self._published_refs.popitem(last=False)
+                # Refs nested in a list: a top-level ref arg would
+                # be materialized by the task runtime (see
+                # PrefixDirectory.publish).
+                self._directory.publish.remote(key, [k_ref, v_ref])
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill: poll + adopt streamed KV pages
+    # ------------------------------------------------------------------
+    def _poll_prefill(self):
+        """Collect completed remote prefills into the ready queue; decode
+        for already-active slots never waits on these, and activation
+        happens at the next token boundary with a free slot."""
+        with self._lock:
+            awaiting = list(self._awaiting)
+        for entry in awaiting:
+            req, job, start = entry
+            try:
+                result = job.poll()
+            except Exception as e:  # noqa: BLE001 — typed per-request fail
+                with self._lock:
+                    if entry in self._awaiting:
+                        self._awaiting.remove(entry)
+                req.finish(error=e)
+                continue
+            if result is None:
+                continue
+            with self._lock:
+                self._awaiting.remove(entry)
+                self._ready.append((req, result, start))
+
+    # ------------------------------------------------------------------
+    # decode steps
+    # ------------------------------------------------------------------
     def _grow(self):
-        """Allocate the next page for every active slot whose write head
-        crossed a page boundary; preempt the youngest other request when
-        the pool is dry (vLLM-style recompute preemption)."""
+        """Allocate pages for every active slot whose write horizon
+        crosses a page boundary; preempt the youngest other request when
+        the pool is dry (vLLM-style recompute preemption).  The horizon
+        is one token, or ``spec_tokens`` positions in spec mode (the
+        verify step scatters the whole window)."""
+        horizon = self.spec_tokens if self._spec else 1
         for slot in range(self.max_slots):
             if not self._active[slot]:
                 continue
             pos = int(self._lengths[slot])
-            page_needed = pos // self.page_size
+            page_needed = min(pos + horizon - 1,
+                              self.max_ctx - 1) // self.page_size
             while page_needed >= len(self._slot_pages[slot]):
                 got = self.pool.alloc(1)
                 if got is not None:
@@ -530,7 +1249,8 @@ class LLMEngine:
         n_active = int(self._active.sum())
         self._k_pages, self._v_pages, nxt = self._decode(
             self._params, self._k_pages, self._v_pages, self._table,
-            self._lengths, self._last_tok, self._active)
+            self._lengths, self._last_tok, self._active, self._temps,
+            self._top_ps, self._seeds)
         nxt = np.asarray(nxt)
         self._stats["steps"] += 1
         self._stats["tokens"] += n_active
@@ -543,6 +1263,63 @@ class LLMEngine:
             tok = int(nxt[slot])
             self._last_tok[slot] = tok
             self._append_token(slot, req, tok)
+
+    def _decode_once_spec(self):
+        """Draft k-1 proposals per slot, verify the [slots, k] window in
+        ONE target step, accept the longest matching prefix plus the
+        target's correction token.  Because sampling keys depend only on
+        (seed, absolute position), the emitted stream is bitwise the
+        non-speculative stream — the draft only sets the tokens/step."""
+        k = self.spec_tokens
+        n_active = int(self._active.sum())
+        proposals = np.zeros((self.max_slots, k - 1), np.int32)
+        d_last = self._last_tok.copy()
+        for j in range(k - 1):
+            self._dk_pages, self._dv_pages, nxt = self._draft_decode(
+                self._draft_params, self._dk_pages, self._dv_pages,
+                self._table, self._lengths + j, d_last, self._active,
+                self._temps, self._top_ps, self._seeds)
+            d_last = np.asarray(nxt)
+            proposals[:, j] = d_last
+        # Catch-up step: write the LAST proposal's draft KV (position
+        # len+k-1).  On full acceptance that position becomes part of
+        # the valid cache next iteration, and without this write the
+        # draft would read a stale row and desync; on partial
+        # acceptance the row sits beyond kv_lengths and is overwritten
+        # before it is ever read.  The sampled output is discarded.
+        self._dk_pages, self._dv_pages, _ = self._draft_decode(
+            self._draft_params, self._dk_pages, self._dv_pages,
+            self._table, self._lengths + (k - 1), d_last, self._active,
+            self._temps, self._top_ps, self._seeds)
+        window = np.concatenate(
+            [self._last_tok[:, None], proposals], axis=1)
+        self._k_pages, self._v_pages, sampled = self._verify(
+            self._params, self._k_pages, self._v_pages, self._table,
+            self._lengths, window, self._active, self._temps, self._top_ps,
+            self._seeds)
+        sampled = np.asarray(sampled)  # [slots, k]: tokens at len+1..len+k
+        self._stats["steps"] += 1
+        self._stats["spec_steps"] += 1
+        self._occupancy_sum += n_active / self.max_slots
+        for slot in range(self.max_slots):
+            if not self._active[slot]:
+                continue
+            req = self._slot_req[slot]
+            m = 0
+            while m < k - 1 and proposals[slot, m] == sampled[slot, m]:
+                m += 1
+            emit = m + 1  # matched proposals + the target's own token
+            self._stats["spec_proposed"] += k - 1
+            self._stats["spec_accepted"] += m
+            req.spec_proposed += k - 1
+            req.spec_accepted += m
+            self._stats["tokens"] += emit
+            self._lengths[slot] += emit
+            self._last_tok[slot] = int(sampled[slot, emit - 1])
+            for j in range(emit):
+                self._append_token(slot, req, int(sampled[slot, j]))
+                if not self._active[slot]:
+                    break  # retired mid-window (EOS / max_new_tokens)
 
     def _append_token(self, slot: int, req: _Request, tok: int):
         req.out.append(tok)
@@ -563,17 +1340,24 @@ class LLMEngine:
         self._slot_req.pop(slot, None)
         with self._lock:
             self._active[slot] = False
-            # Bound the registry: drop the oldest finished requests once
-            # past 4096 entries (a long-lived replica must not leak one
-            # _Request per call).
-            if len(self._requests) > 4096:
-                for rid in list(self._requests):
-                    if len(self._requests) <= 2048:
-                        break
-                    if self._requests[rid].done.is_set():
-                        del self._requests[rid]
+            self._evict_consumed_locked()
         self._stats["completed"] += 1
         req.finish(error=error)
+
+    def _evict_consumed_locked(self):
+        """Bound the registry without losing undrained streams: only
+        finished requests whose consumer has the terminal state
+        (``consumed``) are dropped — a finished streaming request whose
+        chunk queue hasn't been drained survives, so late ``next_chunk``
+        pulls never lose tail chunks (regression: ISSUE 13)."""
+        if len(self._requests) <= self.REGISTRY_LIMIT:
+            return
+        for rid in list(self._requests):
+            if len(self._requests) <= self.REGISTRY_FLOOR:
+                break
+            r = self._requests[rid]
+            if r.done.is_set() and r.consumed:
+                del self._requests[rid]
 
     # ------------------------------------------------------------------
     # metrics (best-effort: the engine also runs without a ray runtime)
@@ -597,6 +1381,13 @@ class LLMEngine:
                                        "KV cache pages free"),
                 "tokens_per_s": um.Gauge("serve_tokens_per_s",
                                          "Engine decode throughput"),
+                "prefix_hits": um.Meter(
+                    "serve_prefix_hit_pages",
+                    "KV pages adopted from the prefix cache"),
+                "spec_accept": um.Gauge(
+                    "serve_spec_acceptance",
+                    "Speculative-decode acceptance rate (accepted / "
+                    "proposed draft tokens)"),
                 "queue_wait": um.Histogram(
                     "serve_queue_wait_s", "Submit-to-admission wait",
                     boundaries=(0.001, 0.01, 0.1, 1.0, 10.0)),
@@ -619,6 +1410,11 @@ class LLMEngine:
             m, st = self._metrics, self._stats
             m["tokens"].mark(st["tokens"] - m["tokens"].total())
             m["requests"].mark(st["completed"] - m["requests"].total())
+            m["prefix_hits"].mark(
+                st["prefix_hit_pages"] - m["prefix_hits"].total())
+            if st.get("spec_proposed", 0):
+                m["spec_accept"].set(
+                    st["spec_accepted"] / st["spec_proposed"])
             with self._lock:
                 inflight = int(self._active.sum()) + len(self._pending)
                 occ = float(self._active.sum()) / self.max_slots
@@ -629,7 +1425,7 @@ class LLMEngine:
             m["pages_free"].set(pool["free"])
             m["tokens_per_s"].set(st["tokens"] / max(1e-9,
                                                      now - self._t0))
-            for meter in (m["tokens"], m["requests"]):
+            for meter in (m["tokens"], m["requests"], m["prefix_hits"]):
                 meter.flush()
         except Exception:
             pass
@@ -643,30 +1439,42 @@ class NaiveLM:
     re-runs the full-context forward pass at a fixed padded width (one
     compile; padding is exact under the causal mask).  This is the
     reference the engine must be token-identical to, and the denominator
-    of the continuous-batching speedup in bench.py."""
+    of the continuous-batching speedup in bench.py.  ``sampling`` makes
+    it the seeded-sampling reference too: it draws with the same
+    ``fold_in(PRNGKey(seed), position)`` keys over full-context logits,
+    so engine sampling must reproduce it bitwise."""
 
     def __init__(self, model, params, width: int):
         import jax
         import jax.numpy as jnp
 
+        from ray_tpu.serve.sampling import sample_tokens
+
         self.params = params
         self.width = width
 
-        def step(params, ids, n):
+        def step(params, ids, n, temp, top_p, seed):
             logits = model.apply({"params": params}, ids)
-            return jnp.argmax(logits[0, n - 1]).astype(jnp.int32)
+            return sample_tokens(
+                logits[0, n - 1][None], jnp.reshape(n, (1,)),
+                jnp.reshape(temp, (1,)), jnp.reshape(top_p, (1,)),
+                jnp.reshape(seed, (1,)))[0]
 
         self._step = jax.jit(step)
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
-                 eos_id: Optional[int] = None) -> List[int]:
+                 eos_id: Optional[int] = None,
+                 sampling: Optional[SamplingParams] = None) -> List[int]:
+        s = sampling or GREEDY
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         buf = np.zeros((1, self.width), np.int32)
         buf[0, :len(prompt)] = prompt
         n = len(prompt)
         out: List[int] = []
         for _ in range(max_new_tokens):
-            tok = int(self._step(self.params, buf, np.int32(n)))
+            tok = int(self._step(self.params, buf, np.int32(n),
+                                 np.float32(s.temperature),
+                                 np.float32(s.top_p), np.int32(s.seed)))
             out.append(tok)
             if n < self.width:
                 buf[0, n] = tok
@@ -702,6 +1510,16 @@ def build_model(model_kind: str, config_kw: Optional[dict] = None,
     return model, params
 
 
+def cache_namespace_for(model_kind: str, config_kw: Optional[dict],
+                        seed: int, page_size: int) -> str:
+    """Stable prefix-cache namespace: everything that changes a page's
+    bytes (model family, config, init seed, page geometry) must be in
+    the address, so deployments sharing an object plane can't poison
+    each other."""
+    kw = sorted((config_kw or {}).items())
+    return f"{model_kind}|{kw!r}|seed{seed}|ps{page_size}"
+
+
 class LLMServer:
     """Serve deployment callable hosting one LLMEngine per replica.
 
@@ -710,56 +1528,107 @@ class LLMServer:
     scales replicas up through the normal controller loop.  Three entry
     points:
 
-    - ``__call__({"tokens": [...], "max_new_tokens": n})`` — JSON/HTTP.
+    - ``__call__({"tokens": [...], "max_new_tokens": n, "temperature":
+      t, "top_p": p, "seed": s})`` — JSON/HTTP.
     - ``generate_batch(refs, ...)`` — the zero-copy object-plane path
       (prompt refs in via ``get_many``, output refs back via
       ``put_many``); pair with :func:`generate_many` client-side.
     - ``submit_stream``/``next_chunk`` — pull-based token streaming.
+
+    Serving-tier knobs: ``draft_config_kw`` + ``spec_tokens`` enable
+    speculative decoding (the draft is built from the same seed, so
+    replicas agree); ``prefix_cache=True`` turns on the local prefix
+    cache, ``prefix_directory=`` (a ``prefix_cache.create_directory()``
+    handle) shares it cluster-wide; ``prefill=`` (a PrefillWorker
+    deployment handle) disaggregates prefill.
     """
 
     def __init__(self, model_kind: str = "gpt2",
                  config_kw: Optional[dict] = None, seed: int = 0,
+                 draft_config_kw: Optional[dict] = None,
+                 spec_tokens=_DEF, prefix_cache=None,
+                 prefix_directory=None, prefill=None,
                  **engine_kw):
         model, params = build_model(model_kind, config_kw, seed)
-        self.engine = LLMEngine(model, params, **engine_kw)
+        draft_model = draft_params = None
+        if draft_config_kw is not None:
+            draft_model, draft_params = build_model(
+                model_kind, draft_config_kw, seed)
+        page_size = int(_cfg("serve_page_size",
+                             engine_kw.get("page_size", _DEF), 16))
+        self.engine = LLMEngine(
+            model, params, draft_model=draft_model,
+            draft_params=draft_params, spec_tokens=spec_tokens,
+            prefix_cache=prefix_cache, prefix_directory=prefix_directory,
+            prefill=prefill,
+            cache_namespace=cache_namespace_for(model_kind, config_kw,
+                                                seed, page_size),
+            **engine_kw)
+
+    @staticmethod
+    def _sampling_of(request: dict) -> SamplingParams:
+        return SamplingParams(
+            temperature=float(request.get("temperature", 0.0)),
+            top_p=float(request.get("top_p", 1.0)),
+            seed=int(request.get("seed", 0)))
 
     def __call__(self, request: dict) -> dict:
         rid = self.engine.submit(request["tokens"],
                                  int(request.get("max_new_tokens", 16)),
-                                 request.get("eos_id"))
+                                 request.get("eos_id"),
+                                 sampling=self._sampling_of(request))
         return {"tokens": self.engine.result(rid, timeout=120.0)}
 
     def generate_batch(self, prompts, max_new_tokens: int = 16,
-                       eos_id: Optional[int] = None, as_refs: bool = True):
+                       eos_id: Optional[int] = None, as_refs: bool = True,
+                       sampling: Optional[list] = None):
         import ray_tpu
 
         if prompts and isinstance(prompts[0], ray_tpu.ObjectRef):
             prompts = ray_tpu.get_many(list(prompts))
-        rids = [self.engine.submit(p, max_new_tokens, eos_id)
-                for p in prompts]
+        if sampling is None:
+            sampling = [None] * len(prompts)
+        rids = [self.engine.submit(p, max_new_tokens, eos_id, sampling=s)
+                for p, s in zip(prompts, sampling)]
         outs = [self.engine.result(r, timeout=120.0) for r in rids]
         if not as_refs:
             return outs
         return ray_tpu.put_many([np.asarray(o, np.int32) for o in outs])
 
     def submit_stream(self, prompt, max_new_tokens: int = 16,
-                      eos_id: Optional[int] = None) -> int:
+                      eos_id: Optional[int] = None,
+                      sampling: Optional[SamplingParams] = None) -> int:
         import ray_tpu
 
         if isinstance(prompt, ray_tpu.ObjectRef):
             prompt = ray_tpu.get(prompt)
-        return self.engine.submit(prompt, max_new_tokens, eos_id)
+        return self.engine.submit(prompt, max_new_tokens, eos_id,
+                                  sampling=sampling)
 
     def next_chunk(self, rid: int, timeout: float = 60.0):
         """Next streamed token chunk, or None when the request retired."""
         req = self.engine._requests[rid]
         try:
-            return req.chunks.get(timeout=timeout)
+            chunk = req.chunks.get(timeout=timeout)
         except queue.Empty:
             raise TimeoutError(f"no chunk for request {rid} in {timeout}s")
+        if chunk is None:
+            req.consumed = True
+        return chunk
 
     def stats(self) -> dict:
         return self.engine.stats()
+
+    def request_stats(self, rid: int) -> dict:
+        return self.engine.request_stats(rid)
+
+    def autoscale_metric(self) -> float:
+        """Engine-load signal for the controller's ``metric_method``
+        autoscaling mode: in-flight work per decode slot (1.0 = the
+        replica's compiled batch is exactly full)."""
+        st = self.engine.stats()
+        return (st["active"] + st["pending"]
+                + st["prefill_inflight"]) / self.engine.max_slots
 
     def drain(self):
         """Teardown hook: close the engine (fails in-flight requests with
@@ -773,14 +1642,31 @@ class LLMServer:
 
 def generate_many(handle, prompts, max_new_tokens: int = 16,
                   eos_id: Optional[int] = None,
+                  sampling: Optional[List[SamplingParams]] = None,
                   timeout: float = 120.0) -> List[List[int]]:
     """Client half of the zero-copy request path: one ``put_many`` for
     the prompt batch (one coalesced control-plane notify), one actor call
-    carrying refs, one ``get_many`` gather of the responses."""
+    carrying refs per affinity group, one ``get_many`` gather of the
+    responses.  Prompts are grouped by their prefix affinity key so
+    shared-prefix requests land on the replica already holding the
+    cached KV pages (see serve/prefix_cache.py)."""
     import ray_tpu
+    from ray_tpu.serve.prefix_cache import affinity_key
 
-    refs = ray_tpu.put_many([np.asarray(p, np.int32) for p in prompts])
-    out_refs = ray_tpu.get(
-        handle.method("generate_batch").remote(refs, max_new_tokens, eos_id),
-        timeout=timeout)
-    return [[int(t) for t in a] for a in ray_tpu.get_many(out_refs)]
+    groups: Dict[str, List[int]] = {}
+    for i, p in enumerate(prompts):
+        groups.setdefault(affinity_key(p), []).append(i)
+    out: List[Optional[List[int]]] = [None] * len(prompts)
+    calls = []
+    for key, idxs in groups.items():
+        refs = ray_tpu.put_many(
+            [np.asarray(prompts[i], np.int32) for i in idxs])
+        samp = [sampling[i] for i in idxs] if sampling else None
+        calls.append((idxs, handle.method("generate_batch").remote(
+            refs, max_new_tokens, eos_id, True, samp, _affinity=key)))
+    for idxs, call in calls:
+        out_refs = ray_tpu.get(call, timeout=timeout)
+        vals = ray_tpu.get_many(out_refs)
+        for i, v in zip(idxs, vals):
+            out[i] = [int(t) for t in v]
+    return out

@@ -1,0 +1,230 @@
+"""Cluster-wide content-addressed KV prefix cache for the serving tier.
+
+The observation that makes serving millions of users affordable: real
+traffic shares prompt prefixes (system prompts, few-shot preambles,
+conversation history), and the KV cache of a token prefix depends ONLY
+on that prefix — attention is causal, positions are absolute, and every
+replica materializes identical weights from the same seed.  So a KV
+page whose token span is complete is an **immutable, content-addressed
+value**: hash the token prefix that produced it and any replica may
+reuse it.
+
+Three layers, mirroring the checkpoint chunk store's design
+(``checkpoint/chunks.py``: blake2b-160 content addressing, dedup by
+hash) applied to device KV pages:
+
+- :func:`page_key` — blake2b-160 over (namespace, tokens[:page_end]).
+  The namespace folds in everything that changes the bytes (model
+  config, init seed, page size, dtype) so two deployments can share an
+  object plane without poisoning each other.
+- :class:`PrefixCacheLocal` — per-replica host-memory LRU of unpacked
+  pages.  Pure data structure; the engine consults it first, so a
+  replica that already served a prefix pays one host→device copy
+  instead of a prefill.
+- :class:`PrefixDirectory` — the cluster half: a tiny actor mapping
+  page key → object-plane refs (pages are published with ``put_many``
+  after prefill and fetched with ``get_many`` on a remote hit — the
+  PR 3 object plane is the transport, exactly as ROADMAP prescribes).
+  The directory holds the refs, which keeps the published objects
+  alive; eviction drops them and distributed ref-counting reclaims the
+  store bytes.
+
+**Cache-affinity routing** rides the same hashes: :func:`affinity_key`
+digests the first page's worth of tokens, and the serve router
+(``api.DeploymentHandle``) rendezvous-hashes that key over the live
+replica set — requests sharing a prefix land on the replica already
+holding those pages, with no routing state to migrate when autoscaling
+changes the set.
+
+This module stays import-light (numpy + hashlib) — no jax at module
+scope — so routers and proxies can hash without touching a model.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Tokens hashed for the router affinity key.  Any fixed count works (all
+# parties just need to agree); one default page is a natural prefix unit.
+AFFINITY_PREFIX_TOKENS = 16
+
+
+def page_key(namespace: str, tokens) -> str:
+    """Content address of the KV page covering ``tokens`` — the blake2b
+    idiom from ``checkpoint/chunks.py:hash_chunk`` over the *token
+    prefix* (every token up to the page's end, because causal attention
+    makes earlier tokens part of the page's value)."""
+    h = hashlib.blake2b(digest_size=20)
+    h.update(namespace.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(np.ascontiguousarray(tokens, dtype=np.int32).tobytes())
+    return h.hexdigest()
+
+
+def prefix_page_keys(namespace: str, tokens, page_size: int,
+                     max_pages: Optional[int] = None) -> List[str]:
+    """Keys for every FULL page of ``tokens``: key i covers tokens
+    ``[0, (i+1)*page_size)``.  ``max_pages`` truncates (admission caps
+    at ``(len - 1) // page_size`` so the sampled next token always has
+    at least one freshly-computed position behind it)."""
+    toks = np.ascontiguousarray(tokens, dtype=np.int32)
+    n = len(toks) // page_size
+    if max_pages is not None:
+        n = min(n, max_pages)
+    return [page_key(namespace, toks[:(i + 1) * page_size])
+            for i in range(n)]
+
+
+def affinity_key(tokens, n_tokens: int = AFFINITY_PREFIX_TOKENS) -> str:
+    """Stable routing key for cache-affinity: digest of the first
+    ``n_tokens`` tokens (shorter prompts hash what they have)."""
+    toks = np.ascontiguousarray(tokens, dtype=np.int32)[:n_tokens]
+    return hashlib.blake2b(toks.tobytes(), digest_size=8).hexdigest()
+
+
+def rendezvous_pick(key: str, candidates: List[str]) -> Optional[int]:
+    """Index of the highest-scoring candidate under rendezvous (HRW)
+    hashing — every router maps the same key to the same replica with no
+    shared state, and replica-set changes only remap the keys that
+    scored highest on the changed replica."""
+    if not candidates:
+        return None
+    best, best_score = 0, b""
+    for i, cand in enumerate(candidates):
+        score = hashlib.blake2b((key + "|" + cand).encode("utf-8"),
+                                digest_size=8).digest()
+        if score > best_score:
+            best, best_score = i, score
+    return best
+
+
+class PrefixCacheLocal:
+    """Byte-bounded LRU of unpacked KV pages, host memory, thread-safe.
+
+    Values are ``(k, v)`` numpy arrays of shape [L, page_size, Hkv, D]
+    in the engine's cache dtype — exactly what the engine's page-adopt
+    program scatters back onto the device, so a local hit is one H2D
+    copy."""
+
+    def __init__(self, max_bytes: int = 256 * 1024 * 1024):
+        self.max_bytes = int(max_bytes)
+        self._entries: "collections.OrderedDict[str, Tuple]" = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: str):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0], entry[1]
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def put(self, key: str, k: np.ndarray, v: np.ndarray) -> None:
+        nbytes = int(k.nbytes + v.nbytes)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[2]
+            self._entries[key] = (k, v, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, (_, _, freed) = self._entries.popitem(last=False)
+                self._bytes -= freed
+                self.evictions += 1
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+class PrefixDirectory:
+    """Cluster-wide page-key → object-plane-refs map.
+
+    Deploy as an actor (``create_directory()``) shared by every replica
+    of a deployment: publishers ``put_many`` a page's (k, v) arrays and
+    register the refs here; a replica missing a prefix locally looks the
+    keys up and ``get_many``s the winners.  Holding the ref objects in
+    this actor keeps the published pages alive in the object plane
+    (distributed ref counting); ``max_entries`` LRU-drops the oldest,
+    which releases the store bytes.
+    """
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = int(max_entries)
+        self._refs: "collections.OrderedDict[str, Tuple]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._published = 0
+        self._lookups = 0
+        self._hits = 0
+
+    def publish(self, key: str, refs) -> bool:
+        """Register one page; ``refs`` is the [k_ref, v_ref] pair —
+        NESTED in a list on purpose: a top-level ObjectRef arg would be
+        materialized by the task runtime, while refs inside a value arg
+        arrive as refs (and ride the contained-ref pinning that keeps
+        them alive through the handoff).  Returns False on a dedup hit
+        (callers drop their duplicate refs and the duplicate object is
+        reclaimed)."""
+        k_ref, v_ref = refs
+        with self._lock:
+            if key in self._refs:
+                self._refs.move_to_end(key)
+                return False
+            self._refs[key] = (k_ref, v_ref)
+            self._published += 1
+            while len(self._refs) > self.max_entries:
+                self._refs.popitem(last=False)
+            return True
+
+    def lookup_many(self, keys: List[str]) -> List[Optional[Tuple]]:
+        """(k_ref, v_ref) per key, None on miss — one round trip for the
+        whole ladder of prefix keys.  The refs ride nested inside the
+        result value, so the caller receives ObjectRefs to get_many."""
+        out = []
+        with self._lock:
+            self._lookups += len(keys)
+            for key in keys:
+                entry = self._refs.get(key)
+                if entry is not None:
+                    self._refs.move_to_end(key)
+                    self._hits += 1
+                out.append(entry)
+        return out
+
+    def contains_many(self, keys: List[str]) -> List[bool]:
+        with self._lock:
+            return [k in self._refs for k in keys]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"entries": len(self._refs),
+                    "published": self._published,
+                    "lookups": self._lookups, "hits": self._hits}
+
+
+def create_directory(max_entries: int = 4096):
+    """Spawn a PrefixDirectory actor (requires a connected runtime).
+    Pass the returned handle to every replica via deployment bind args —
+    actor handles serialize, and one directory serves a deployment."""
+    import ray_tpu
+
+    actor_cls = ray_tpu.remote(PrefixDirectory)
+    return actor_cls.remote(max_entries)

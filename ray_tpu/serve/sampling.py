@@ -1,0 +1,104 @@
+"""Seeded sampling for the LLM decode engine.
+
+Real serving is not greedy-only: temperature and nucleus (top-p)
+sampling are table stakes.  The constraint that makes them compatible
+with this engine's correctness machinery — recompute preemption,
+speculative-decode verification, and token-identity test gates — is
+**determinism**: the token sampled at absolute position ``t`` of a
+request must depend only on ``(request seed, t, logits)``, never on how
+the engine happened to batch or schedule the step that produced it.
+
+The rule: ``key(t) = fold_in(PRNGKey(seed), t)`` where ``t`` is the
+absolute position of the token being *generated*.  A preempted request
+re-prefilled from ``prompt + generated-so-far`` resumes at the same
+absolute positions, so it re-draws the exact tokens it would have
+produced; a speculative verify step samples positions ``len+1..len+k``
+with the same keys the plain decode loop would have used, which is what
+lets the accept-longest-prefix rule emit *bitwise* the non-speculative
+stream.
+
+``temperature == 0`` selects argmax (greedy) — the engine default, and
+the contract every pre-existing token-identity gate asserts.
+
+Everything here is jit-inlinable jnp code over fixed ``[N]``/``[N, V]``
+shapes, so adding sampling to the engine's compiled steps does not add
+recompiles.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs.
+
+    temperature: 0.0 = greedy argmax; > 0 softmax-temperature sampling.
+    top_p: nucleus truncation — sample only from the smallest set of
+        tokens whose cumulative probability reaches ``top_p`` (1.0 = no
+        truncation).  Applied after temperature scaling.
+    seed: the per-request PRNG seed; the token at absolute position t is
+        drawn with ``fold_in(PRNGKey(seed), t)``, making decode
+        deterministic across runs, schedules, and preemption-resume.
+    """
+
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def validate(self) -> "SamplingParams":
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{self.temperature}")
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        return self
+
+
+GREEDY = SamplingParams()
+
+
+def top_p_mask(logits, top_p):
+    """Boolean [.., V] nucleus mask: True for tokens in the smallest set
+    whose cumulative probability (descending order) reaches ``top_p``.
+
+    The highest-probability token is always kept (its cumulative mass
+    *before* itself is 0 < top_p), so the mask can never be empty.
+    Ties are broken by sort order, which jnp.argsort makes stable —
+    the numpy reference in tests mirrors it exactly.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    order = jnp.argsort(-probs, axis=-1)  # descending, stable
+    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+    csum = jnp.cumsum(sorted_probs, axis=-1)
+    # Keep a token while the mass accumulated BEFORE it is < top_p.
+    keep_sorted = (csum - sorted_probs) < top_p[..., None]
+    inv = jnp.argsort(order, axis=-1)
+    return jnp.take_along_axis(keep_sorted, inv, axis=-1)
+
+
+def sample_tokens(logits, positions, temperature, top_p, seeds):
+    """Draw one token per row.  All jnp, fixed shapes, jit-inlinable.
+
+    logits: [N, V] fp32; positions: [N] absolute position of the token
+    being generated; temperature/top_p: [N] f32; seeds: [N] int32.
+    Rows with ``temperature <= 0`` take the argmax instead (greedy and
+    sampled requests share one compiled step).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(temperature, 1e-6)[..., None]
+    scaled = logits.astype(jnp.float32) / temp
+    masked = jnp.where(top_p_mask(scaled, top_p), scaled, -jnp.inf)
+
+    def draw(row_logits, pos, seed):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        return jax.random.categorical(key, row_logits).astype(jnp.int32)
+
+    sampled = jax.vmap(draw)(masked, positions, seeds)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -88,6 +88,15 @@ def test_serving_smoke():
     assert out["admitted_mid_batch"] >= 1, f"batch drained to admit: {out}"
     assert out["decode_cache_size"] == 1, f"decode step recompiled: {out}"
     assert out["pages_leaked"] == 0, out
+    # Serving tier (ISSUE 13): a prefix-cache hit must skip prefill
+    # work, speculative decoding must accept tokens without changing
+    # the stream, and the disaggregated handoff must leak zero pages.
+    assert out["prefix_hit_pages"] >= 1, out
+    assert out["prefix_tail_tokens"] < 17, out  # tail-only prefill
+    assert out["spec_accepted"] >= 1, out
+    assert out["spec_token_identical"], out
+    assert out["prefill_offloaded"] >= 2, out
+    assert out["disagg_pages_leaked"] == 0, out
     assert out["ok"], out
 
 

@@ -1,0 +1,497 @@
+"""Serving-for-millions tier (ISSUE 13): seeded sampling, speculative
+decoding, the cluster-wide prefix cache, and disaggregated prefill.
+
+The load-bearing contracts:
+
+- **Sampling determinism**: the token at absolute position t depends
+  only on (seed, t, logits) — bitwise reproducible across runs, across
+  engine scheduling, and across recompute-preemption resume; the
+  independent reference is NaiveLM's full-context forward driving the
+  same seeded sampler.
+- **Speculative decode = plain decode**: the accept-longest-prefix rule
+  over position-seeded samples emits bitwise the non-speculative
+  stream, for ANY draft model — the draft only changes tokens/step.
+- **Prefix cache exactness**: pages adopted from the cache (local LRU
+  or the object-plane directory) produce token-identical output while
+  measurably skipping prefill work.
+- **Disaggregated prefill**: pages streamed from a PrefillWorker adopt
+  into the paged pool with zero leaks; the native wire is exact, the
+  int8 wire is >= 3x smaller.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.serve.sampling import SamplingParams
+
+
+def _gpt2_tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT2, GPT2Config
+
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    model = GPT2(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def gpt2():
+    return _gpt2_tiny()
+
+
+@pytest.fixture(scope="module")
+def naive(gpt2):
+    from ray_tpu.serve.llm_engine import NaiveLM
+
+    model, params, _ = gpt2
+    return NaiveLM(model, params, width=64)
+
+
+def _prompts(vocab, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, vocab, size=n))) for n in sizes]
+
+
+SP = SamplingParams(temperature=0.8, top_p=0.9, seed=7)
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+def test_top_p_mask_matches_numpy_reference():
+    """Nucleus truncation against an independent numpy implementation:
+    keep the smallest descending-probability set whose mass reaches p."""
+    import jax.numpy as jnp
+
+    from ray_tpu.serve.sampling import top_p_mask
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(scale=2.0, size=(16, 33)).astype(np.float32)
+    top_p = rng.uniform(0.05, 1.0, size=(16,)).astype(np.float32)
+    got = np.asarray(top_p_mask(jnp.asarray(logits), jnp.asarray(top_p)))
+    x = logits - logits.max(axis=-1, keepdims=True)
+    probs = np.exp(x) / np.exp(x).sum(axis=-1, keepdims=True)
+    for b in range(16):
+        order = np.argsort(-probs[b], kind="stable")
+        csum = np.cumsum(probs[b][order])
+        keep_sorted = (csum - probs[b][order]) < top_p[b]
+        want = np.zeros(33, bool)
+        want[order] = keep_sorted
+        assert (got[b] == want).all(), f"row {b} mask mismatch"
+        assert want[order[0]], "top-1 token must always survive"
+
+
+def test_sampled_decode_reproducible_and_matches_reference(gpt2, naive):
+    """Seeded temperature/top-p decode is bitwise reproducible across
+    runs and equals the independent full-context sampled reference;
+    different seeds diverge; temperature=0 still equals greedy."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=4, page_size=8, max_ctx=64)
+    try:
+        (p,) = _prompts(cfg.vocab_size, (9,), seed=41)
+        a = eng.result(eng.submit(p, 14, sampling=SP), timeout=120)
+        b = eng.result(eng.submit(p, 14, sampling=SP), timeout=120)
+        assert a == b, "same seed must reproduce bitwise"
+        assert a == naive.generate(p, 14, sampling=SP)
+        c = eng.result(eng.submit(
+            p, 14, sampling=SamplingParams(0.8, 0.9, seed=8)), timeout=120)
+        assert c != a, "different seed should diverge"
+        g = eng.result(eng.submit(p, 14), timeout=120)
+        assert g == naive.generate(p, 14), "temperature=0 must stay greedy"
+        # Mixed greedy + sampled slots share one compiled decode step.
+        assert eng.stats()["decode_cache_size"] == 1
+    finally:
+        eng.close()
+
+
+def test_sampled_decode_survives_preemption_resume(gpt2, naive):
+    """Recompute preemption re-prefills prompt+generated and re-draws
+    with position-folded keys — the resumed stream is the uninterrupted
+    stream, bitwise, under real sampling."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    model, params, cfg = gpt2
+    # 9 usable pages of 4 tokens; both requests grow to 24 tokens = 6
+    # pages, so the pair MUST collide and preempt (ISSUE 8 geometry).
+    eng = LLMEngine(model, params, max_slots=2, page_size=4, max_ctx=32,
+                    num_pages=10)
+    try:
+        prompts = _prompts(cfg.vocab_size, (8, 8), seed=17)
+        samp = [SamplingParams(0.7, 0.95, seed=i) for i in range(2)]
+        rids = [eng.submit(p, 16, sampling=s)
+                for p, s in zip(prompts, samp)]
+        outs = [eng.result(r, timeout=120) for r in rids]
+        assert eng.stats()["preemptions"] >= 1, eng.stats()
+        assert outs == [naive.generate(p, 16, sampling=s)
+                        for p, s in zip(prompts, samp)]
+        assert eng.stats()["pages_in_use"] == 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding
+# ---------------------------------------------------------------------------
+def test_spec_decode_self_draft_identical_full_acceptance(gpt2, naive):
+    """Draft == target: every proposal verifies, so acceptance is 1.0
+    and each verify step emits the full window — and the output is
+    (trivially) the plain sampled stream."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    draft_model=model, draft_params=params, spec_tokens=4)
+    try:
+        prompts = _prompts(cfg.vocab_size, (6, 12), seed=5)
+        outs = [eng.result(eng.submit(p, 12, sampling=SP), timeout=120)
+                for p in prompts]
+        assert outs == [naive.generate(p, 12, sampling=SP)
+                        for p in prompts]
+        st = eng.stats()
+        assert st["spec_acceptance_rate"] == 1.0, st
+        assert st["spec_steps"] >= 1 and st["pages_in_use"] == 0
+    finally:
+        eng.close()
+
+
+def test_spec_decode_tiny_draft_distribution_identical(gpt2, naive):
+    """A 1-layer random-weight draft: acceptance is partial, but the
+    emitted stream is STILL bitwise the non-speculative sampled stream
+    at the same seed (the verify step samples with the target's
+    position keys) — greedy too.  Per-request acceptance is tracked."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import GPT2, GPT2Config
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    model, params, cfg = gpt2
+    dcfg = GPT2Config.draft_of(cfg)
+    assert dcfg.vocab_size == cfg.vocab_size and dcfg.num_layers == 1
+    dmodel = GPT2(dcfg)
+    dparams = dmodel.init(jax.random.PRNGKey(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    draft_model=dmodel, draft_params=dparams, spec_tokens=3)
+    try:
+        prompts = _prompts(cfg.vocab_size, (7, 10), seed=13)
+        rids = [eng.submit(p, 12, sampling=SP) for p in prompts]
+        outs = [eng.result(r, timeout=120) for r in rids]
+        assert outs == [naive.generate(p, 12, sampling=SP)
+                        for p in prompts]
+        g = eng.result(eng.submit(prompts[0], 12), timeout=120)
+        assert g == naive.generate(prompts[0], 12)
+        st = eng.stats()
+        assert st["spec_proposed"] > 0
+        rs = eng.request_stats(rids[0])
+        assert rs["spec_proposed"] > 0
+        assert 0.0 <= rs["spec_acceptance_rate"] <= 1.0
+        assert st["pages_in_use"] == 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# prefix cache
+# ---------------------------------------------------------------------------
+def test_prefix_cache_hit_skips_prefill_token_identical(gpt2, naive):
+    """Second request sharing a prefix adopts cached pages: its local
+    prefill covers only the uncached tail, output stays token-identical,
+    and the accounting proves the skip."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    model, params, cfg = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    prefix_cache=True)
+    try:
+        rng = np.random.default_rng(23)
+        shared = list(map(int, rng.integers(0, cfg.vocab_size, size=24)))
+        p1 = shared + [3, 1]
+        p2 = shared + [5]
+        o1 = eng.result(eng.submit(p1, 6), timeout=120)
+        t1 = eng.stats()["prefill_tokens"]
+        o2 = eng.result(eng.submit(p2, 6, sampling=SP), timeout=120)
+        st = eng.stats()
+        assert o1 == naive.generate(p1, 6)
+        assert o2 == naive.generate(p2, 6, sampling=SP)
+        assert st["prefix_hit_pages"] >= 3, st
+        assert st["prefill_tokens_saved"] >= 24, st
+        # The second admission prefilled only the tail.
+        assert st["prefill_tokens"] - t1 == len(p2) - 24, st
+        assert st["prefix_published_pages"] >= 3
+        assert st["prefix_cache"]["entries"] >= 3
+        assert st["pages_in_use"] == 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill (in-process worker; cluster path in the slow
+# tests below and in tools/perf_smoke.run_serving_smoke)
+# ---------------------------------------------------------------------------
+def test_disaggregated_prefill_inline_exact(gpt2, naive):
+    """Native-wire handoff from an in-process PrefillWorker: admission
+    offloads, pages adopt, outputs token-identical, zero leaked pages,
+    and the worker saw only the uncached tail when combined with a
+    prefix-cache hit."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+    from ray_tpu.serve.prefill import PrefillWorker
+
+    model, params, cfg = gpt2
+    worker = PrefillWorker("gpt2", {"tiny": True, "dtype": "float32"}, 0,
+                           page_size=8, use_object_plane=False)
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    prefix_cache=True, prefill=worker,
+                    prefill_min_tokens=8)
+    try:
+        rng = np.random.default_rng(29)
+        shared = list(map(int, rng.integers(0, cfg.vocab_size, size=16)))
+        p1 = shared + [2, 4, 6, 8, 10, 12, 14, 1]
+        p2 = shared + [9] * 12
+        o1 = eng.result(eng.submit(p1, 6, sampling=SP), timeout=120)
+        o2 = eng.result(eng.submit(p2, 6), timeout=120)
+        assert o1 == naive.generate(p1, 6, sampling=SP)
+        assert o2 == naive.generate(p2, 6)
+        st = eng.stats()
+        assert st["prefill_offloaded"] == 2, st
+        assert st["wire_bytes"] > 0
+        assert st["prefix_hit_pages"] >= 2, st  # p2 reused p1's prefix
+        assert st["pages_in_use"] == 0 and st["prefill_inflight"] == 0
+        # The second offload shipped only tail pages (start=16 → 2 of 4).
+        wst = worker.stats()
+        assert wst["requests"] == 2 and wst["tokens"] == len(p1) + (
+            len(p2) - 16)
+    finally:
+        eng.close()
+
+
+def test_disaggregated_prefill_int8_wire(gpt2):
+    """int8 block-scaled wire: >= 3x fewer bytes than fp32, decode
+    completes through the approximate pages, nothing leaks.  Also pins
+    the numpy wire quantizer to the jax collectives format."""
+    import jax.numpy as jnp
+
+    from ray_tpu.ops import collectives as C
+    from ray_tpu.serve.llm_engine import LLMEngine
+    from ray_tpu.serve.prefill import PrefillWorker
+
+    x = np.random.default_rng(3).normal(size=(4, 70)).astype(np.float32)
+    qn, sn = C.quantize_block_int8_np(x, 32)
+    qj, sj = C.quantize_block_int8(jnp.asarray(x), 32)
+    assert (qn == np.asarray(qj)).all() and np.allclose(sn, np.asarray(sj))
+    assert np.allclose(C.dequantize_block_int8_np(qn, sn, 70),
+                       np.asarray(C.dequantize_block_int8(qj, sj, 70)))
+
+    model, params, cfg = gpt2
+    worker = PrefillWorker("gpt2", {"tiny": True, "dtype": "float32"}, 0,
+                           page_size=8, wire_dtype="int8",
+                           use_object_plane=False)
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    prefill=worker, prefill_min_tokens=8)
+    try:
+        (p,) = _prompts(cfg.vocab_size, (21,), seed=31)
+        out = eng.result(eng.submit(p, 6), timeout=120)
+        st = eng.stats()
+        assert len(out) == 6
+        assert st["prefill_offloaded"] == 1
+        assert st["wire_fp32_bytes"] / st["wire_bytes"] >= 3.0, st
+        assert st["pages_in_use"] == 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# registry eviction (the streaming-consumer regression)
+# ---------------------------------------------------------------------------
+def test_request_eviction_keeps_undrained_streams(gpt2):
+    """The registry bound only evicts CONSUMED finished requests: a
+    finished streaming request whose chunk queue hasn't been drained
+    survives eviction, so late next_chunk pulls never lose the tail."""
+    from ray_tpu.serve.llm_engine import LLMEngine, _Request
+
+    model, params, _ = gpt2
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    start=False)
+    eng.REGISTRY_LIMIT = 8
+    eng.REGISTRY_FLOOR = 4
+    undrained = _Request(10_000, [1], 4, None)
+    undrained.out = [7, 8, 9]
+    undrained.finish()  # queues the tail chunk + None, consumed=False
+    inflight = _Request(10_001, [1], 4, None)  # not even finished
+    eng._requests[undrained.id] = undrained
+    eng._requests[inflight.id] = inflight
+    for i in range(12):
+        r = _Request(i, [1], 4, None)
+        r.finish()
+        r.consumed = True  # result()/stream() delivered terminal state
+        eng._requests[r.id] = r
+    with eng._lock:
+        eng._evict_consumed_locked()
+    assert undrained.id in eng._requests, "undrained stream was evicted"
+    assert inflight.id in eng._requests, "unfinished request was evicted"
+    assert len(eng._requests) <= eng.REGISTRY_FLOOR + 2
+    # The late consumer still gets the tail, then the terminal None.
+    assert undrained.chunks.get_nowait() == [7, 8, 9]
+    assert undrained.chunks.get_nowait() is None
+
+
+def test_draft_of_llama_config_shapes():
+    from ray_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    d = LlamaConfig.draft_of(cfg)
+    assert d.vocab_size == cfg.vocab_size
+    assert d.max_position_embeddings == cfg.max_position_embeddings
+    assert d.num_layers == 1
+    assert d.num_heads % d.num_kv_heads == 0
+    assert d.hidden_size % d.num_heads == 0
+
+
+# ---------------------------------------------------------------------------
+# cluster integration (ray runtime): directory sharing, affinity
+# routing, disaggregated deployment, metric-driven autoscaling
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def serve_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SERVE_CONTROL_INTERVAL_S", "0.2")
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.serve.controller import reset_controller
+
+    CONFIG.reset()
+    reset_controller()
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024**2)
+    from ray_tpu import serve  # noqa: F401
+
+    yield
+    from ray_tpu import serve as _s
+
+    _s.shutdown()
+    ray_tpu.shutdown()
+    CONFIG.reset()
+
+
+@pytest.mark.slow
+def test_prefix_directory_shares_pages_across_engines(serve_cluster, gpt2,
+                                                      naive):
+    """Replica B hits pages replica A published: the directory hands out
+    object-plane refs, B adopts them remotely, output token-identical,
+    and B's local prefill covered only the tail."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+    from ray_tpu.serve.prefix_cache import create_directory
+
+    model, params, cfg = gpt2
+    directory = create_directory()
+    engines = [LLMEngine(model, params, max_slots=2, page_size=8,
+                         max_ctx=64, prefix_cache=True,
+                         prefix_directory=directory,
+                         cache_namespace="shared-test")
+               for _ in range(2)]
+    try:
+        rng = np.random.default_rng(37)
+        shared = list(map(int, rng.integers(0, cfg.vocab_size, size=24)))
+        p1, p2 = shared + [1, 2], shared + [3]
+        o1 = engines[0].result(engines[0].submit(p1, 6), timeout=120)
+        o2 = engines[1].result(engines[1].submit(p2, 6), timeout=120)
+        assert o1 == naive.generate(p1, 6)
+        assert o2 == naive.generate(p2, 6)
+        st = engines[1].stats()
+        assert st["prefix_remote_hit_pages"] >= 3, st
+        assert st["prefill_tokens"] == len(p2) - 24, st
+        dstats = ray_tpu.get(directory.stats.remote(), timeout=30)
+        assert dstats["published"] >= 3 and dstats["hits"] >= 3
+    finally:
+        for e in engines:
+            e.close()
+
+
+@pytest.mark.slow
+def test_serve_disaggregated_prefill_end_to_end(serve_cluster, gpt2, naive):
+    """Full serve-plane composition: a PrefillWorker deployment feeds an
+    LLMServer deployment over put_many/get_many ref chains; outputs are
+    token-identical and the engine accounts the offloads."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm_engine import LLMServer, generate_many
+    from ray_tpu.serve.prefill import PrefillWorker
+
+    model, params, cfg = gpt2
+    pf_dep = serve.deployment(PrefillWorker, name="prefill")
+    pf_handle = serve.run(pf_dep.bind(
+        "gpt2", {"tiny": True, "dtype": "float32"}, 0, page_size=8))
+    dep = serve.deployment(LLMServer, name="llm_disagg")
+    handle = serve.run(dep.bind(
+        "gpt2", {"tiny": True, "dtype": "float32"}, 0,
+        prefix_cache=True, prefill=pf_handle,
+        max_slots=4, page_size=8, max_ctx=64, prefill_min_tokens=8))
+    rng = np.random.default_rng(43)
+    shared = list(map(int, rng.integers(0, cfg.vocab_size, size=16)))
+    prompts = [shared + list(map(int, rng.integers(0, cfg.vocab_size,
+                                                   size=8)))
+               for _ in range(4)]
+    outs = generate_many(handle, prompts, max_new_tokens=6)
+    assert outs == [naive.generate(p, 6) for p in prompts]
+    st = ray_tpu.get(handle.method("stats").remote(), timeout=30)
+    assert st["prefill_offloaded"] >= 1, st
+    assert st["pages_in_use"] == 0 and st["prefill_inflight"] == 0
+    serve.delete("llm_disagg")
+    serve.delete("prefill")
+
+
+@pytest.mark.slow
+def test_affinity_routing_sticks_and_spills(serve_cluster):
+    """Same affinity key → same replica across calls (rendezvous over
+    actor ids); no key → requests spread.  The handle accounts hits."""
+    import os
+
+    from ray_tpu import serve
+
+    class WhoAmI:
+        def __call__(self, _req):
+            return os.getpid()
+
+    dep = serve.deployment(WhoAmI, name="who", num_replicas=2)
+    handle = serve.run(dep.bind())
+    picked = {ray_tpu.get(handle.remote(None, _affinity="prefix-A"),
+                          timeout=30) for _ in range(6)}
+    assert len(picked) == 1, f"affinity key fanned out: {picked}"
+    other = {ray_tpu.get(handle.remote(None, _affinity=f"k{i}"),
+                         timeout=30) for i in range(8)}
+    assert len(other) == 2, "rendezvous should spread distinct keys"
+    st = handle.queue_stats()
+    assert st["affinity_hits"] >= 14
+    serve.delete("who")
+
+
+@pytest.mark.slow
+def test_metric_method_autoscaling(serve_cluster):
+    """A deployment whose replicas report overload through
+    ``metric_method`` scales up even with an empty router queue."""
+    from ray_tpu import serve
+
+    class Busy:
+        def load(self):
+            return 5.0  # always overloaded per replica
+
+        def __call__(self, _req):
+            return "ok"
+
+    dep = serve.deployment(
+        Busy, name="busy",
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "metric_method": "load",
+                            "target_num_ongoing_requests_per_replica": 1.0,
+                            "look_back_polls": 1})
+    handle = serve.run(dep.bind())
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and handle.num_replicas < 3:
+        time.sleep(0.2)
+    assert handle.num_replicas == 3, "metric_method never drove scale-up"
+    serve.delete("busy")

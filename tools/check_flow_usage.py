@@ -31,8 +31,6 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # When one of these is rebased on flow primitives, delete its line (the
 # check fails on stale entries to force that).
 ALLOWLIST = {
-    # continuous-batching engine loop: admission queue + decode thread
-    "ray_tpu/serve/llm_engine.py",
     # train worker-group result plumbing
     "ray_tpu/train/_internal/worker_group.py",
     # tune trial-runner event queue

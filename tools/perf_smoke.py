@@ -905,9 +905,91 @@ def run_serving_smoke(max_new: int = 10) -> dict:
                          and out["admitted_mid_batch"] >= 1
                          and out["decode_cache_size"] == 1
                          and out["pages_leaked"] == 0)
-        return out
     finally:
         eng.close()
+
+    # ---- serving tier (ISSUE 13): prefix cache, speculative decode,
+    # disaggregated prefill — each gate is cheap and deterministic.
+    from ray_tpu.serve.sampling import SamplingParams
+
+    rng2 = np.random.default_rng(1)
+    shared = list(map(int, rng2.integers(0, cfg.vocab_size, size=16)))
+    p1 = shared + [1, 2, 3]
+    p2 = shared + [4]
+
+    # 4. **Prefix cache skips prefill**: the second shared-prefix
+    # request adopts cached pages and prefills only the tail, with
+    # token identity intact.
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    prefix_cache=True)
+    try:
+        o1 = eng.result(eng.submit(p1, max_new), timeout=120)
+        t1 = eng.stats()["prefill_tokens"]
+        o2 = eng.result(eng.submit(p2, max_new), timeout=120)
+        st = eng.stats()
+        out["prefix_hit_pages"] = st["prefix_hit_pages"]
+        out["prefill_tokens_saved"] = st["prefill_tokens_saved"]
+        out["prefix_tail_tokens"] = st["prefill_tokens"] - t1
+        out["prefix_token_identical"] = bool(
+            o1 == naive.generate(p1, max_new)
+            and o2 == naive.generate(p2, max_new))
+        out["ok"] = bool(out["ok"] and out["prefix_token_identical"]
+                         and st["prefix_hit_pages"] >= 1
+                         and out["prefix_tail_tokens"] < len(p2)
+                         and st["pages_in_use"] == 0)
+    finally:
+        eng.close()
+
+    # 5. **Speculative decoding**: self-draft acceptance is total, the
+    # sampled stream is bitwise the plain sampled stream.
+    sp = SamplingParams(temperature=0.8, top_p=0.9, seed=7)
+    eng = LLMEngine(model, params, max_slots=2, page_size=8, max_ctx=64,
+                    draft_model=model, draft_params=params, spec_tokens=3)
+    try:
+        o = eng.result(eng.submit(p1, max_new, sampling=sp), timeout=120)
+        st = eng.stats()
+        out["spec_accepted"] = st["spec_accepted"]
+        out["spec_acceptance_rate"] = round(st["spec_acceptance_rate"], 3)
+        out["spec_token_identical"] = bool(
+            o == naive.generate(p1, max_new, sampling=sp))
+        out["ok"] = bool(out["ok"] and out["spec_token_identical"]
+                         and st["spec_accepted"] >= 1
+                         and st["pages_in_use"] == 0)
+    finally:
+        eng.close()
+
+    # 6. **Disaggregated prefill**: KV pages stream worker→engine over
+    # the object plane (put_many refs → get_many), outputs identical,
+    # zero KV pages leaked after the handoff.
+    import ray_tpu
+    from ray_tpu.serve.prefill import PrefillWorker
+
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024**2)
+    try:
+        worker = PrefillWorker("gpt2", {"tiny": True, "dtype": "float32"},
+                               0, page_size=8, use_object_plane=True)
+        eng = LLMEngine(model, params, max_slots=2, page_size=8,
+                        max_ctx=64, prefill=worker, prefill_min_tokens=8)
+        try:
+            o1 = eng.result(eng.submit(p1, max_new), timeout=120)
+            o2 = eng.result(eng.submit(p2, max_new), timeout=120)
+            st = eng.stats()
+            out["prefill_offloaded"] = st["prefill_offloaded"]
+            out["disagg_wire_bytes"] = st["wire_bytes"]
+            out["disagg_pages_leaked"] = st["pages_in_use"]
+            out["disagg_token_identical"] = bool(
+                o1 == naive.generate(p1, max_new)
+                and o2 == naive.generate(p2, max_new))
+            out["ok"] = bool(out["ok"] and out["disagg_token_identical"]
+                             and st["prefill_offloaded"] >= 2
+                             and st["wire_bytes"] > 0
+                             and st["prefill_inflight"] == 0
+                             and st["pages_in_use"] == 0)
+        finally:
+            eng.close()
+    finally:
+        ray_tpu.shutdown()
+    return out
 
 
 def _flow_smoke_reader(path, columns):
