@@ -10,6 +10,7 @@ from ray_tpu.models.gpt2 import (  # noqa: F401
     GPT2,
     GPT2Config,
     GPT2Stage,
+    GPT2WithValue,
     gpt2_loss_fn,
     split_stages,
 )

@@ -156,9 +156,13 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, positions: jax.Array = None,
-                 kv_caches=None, kv_lengths: jax.Array = None):
+                 kv_caches=None, kv_lengths: jax.Array = None,
+                 return_hidden: bool = False):
         """Training/full-context: input_ids [B, L] int32 → logits
-        [B, L, vocab] (unchanged contract).
+        [B, L, vocab] (unchanged contract).  ``return_hidden=True``
+        (full-context only) additionally returns the post-ln_f hidden
+        states [B, L, hidden] — the value head's input in the RLHF
+        stack (:class:`GPT2WithValue`).
 
         Incremental decode (``kv_caches`` given): ``positions`` [B, L]
         are the absolute positions of the new tokens, ``kv_caches`` is a
@@ -203,8 +207,53 @@ class GPT2(nn.Module):
                             wte.astype(c.dtype))
         logits = logits.astype(jnp.float32)
         if decode:
+            if return_hidden:
+                raise NotImplementedError(
+                    "return_hidden is a full-context (training) path")
             return logits, new_kvs
+        if return_hidden:
+            return logits, x
         return logits
+
+
+class GPT2WithValue(nn.Module):
+    """GPT-2 plus a scalar value head — the RLHF actor-critic.
+
+    The policy half is a plain :class:`GPT2` submodule named ``lm``, so
+    ``params["lm"]`` is EXACTLY the param tree a serving
+    ``LLMEngine``/``NaiveLM`` built on the same config accepts: the
+    RLHF learner trains this module and hot-swaps ``params["lm"]`` into
+    the generation engine with no renaming or surgery.  The value head
+    is one fp32 linear over the post-ln_f hidden states (the standard
+    PPO-for-LLMs shape), initialized near zero so early value estimates
+    don't swamp the policy gradient.
+
+    ``__call__(input_ids) -> (logits [B, L, V] f32, values [B, L] f32)``
+    where ``values[:, t]`` estimates the return from the state AFTER
+    consuming token t — the baseline for the token sampled at t+1.
+    """
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array):
+        logits, hidden = GPT2(self.config, name="lm")(
+            input_ids, return_hidden=True)
+        v = nn.Dense(1, dtype=jnp.float32, name="value_head",
+                     kernel_init=nn.initializers.normal(0.01))(
+            hidden.astype(jnp.float32))
+        return logits, v[..., 0]
+
+    def init_from_lm(self, rng, lm_params, example_len: int = 8):
+        """Params with the ``lm`` subtree REPLACED by ``lm_params`` —
+        the RLHF entry point: start the actor-critic from the exact
+        weights the serving engine already holds (the value head alone
+        is freshly initialized)."""
+        ids = jnp.zeros((1, example_len), jnp.int32)
+        params = self.init(rng, ids)["params"]
+        params = dict(params)
+        params["lm"] = lm_params
+        return params
 
 
 def gpt2_loss_fn(params, apply_fn, batch) -> jax.Array:

@@ -1,0 +1,28 @@
+"""RLHF: PPO fine-tuning of an LM whose rollouts run through the
+serving engine (``ray_tpu.serve.llm_engine``).
+
+The Podracer thesis at LLM scale on this repo's own planes: generation
+rides the continuous-batching decode engine (behavior logprobs captured
+per token, weight versions stamped per token), learning rides the
+``run_ppo_sgd``/``build_update_plan`` training plane, and fresh weights
+flow learner -> engine through ``LLMEngine.swap_weights`` — a
+token-boundary hot swap off the versioned one-put broadcast.  See
+``docs/RLHF.md``.
+"""
+from ray_tpu.rllib.algorithms.rlhf.ppo_seq import (  # noqa: F401
+    SeqPPOLearner,
+    sequence_ppo_loss,
+)
+from ray_tpu.rllib.algorithms.rlhf.reward import (  # noqa: F401
+    RewardScorer,
+    target_token_reward,
+    token_set_reward,
+)
+from ray_tpu.rllib.algorithms.rlhf.loop import (  # noqa: F401
+    RLHFConfig,
+    RLHFLoop,
+)
+from ray_tpu.rllib.algorithms.rlhf.rollout_engine import (  # noqa: F401
+    EngineHost,
+    RemoteEngine,
+)

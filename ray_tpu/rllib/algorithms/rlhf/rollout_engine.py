@@ -1,0 +1,116 @@
+"""Process-separated rollout engine for the RLHF loop.
+
+In-process, the generation engine and the learner share one XLA CPU
+runtime, so a long SGD program starves the decode steps — the same
+single-host contention the disaggregated-prefill bench documented (its
+fix too): the real deployment shape gives each plane its own process.
+:class:`EngineHost` is the actor body hosting one ``LLMEngine`` replica
+(weights materialized seeded-identical from ``build_model``, the
+serving-replica idiom — the learner starts from the same seed via
+``GPT2WithValue.init_from_lm``), and :class:`RemoteEngine` is the
+duck-typed driver-side client exposing exactly the surface
+:class:`~ray_tpu.rllib.algorithms.rlhf.loop.RLHFLoop` uses
+(``generate_rollouts`` / ``swap_weights`` / ``stats`` /
+``recent_step_stamps`` / ``weight_version``), so the loop runs
+unchanged against either.
+
+The weight path is the versioned one-put broadcast: the loop ``put``s
+the new lm params ONCE; the ref rides ``swap_weights.remote`` to every
+engine replica (the task runtime materializes it actor-side — one
+transfer per replica, one ``device_put`` per version inside the
+engine).  Decode-step wall stamps compare across processes because
+``time.monotonic`` is CLOCK_MONOTONIC, which is system-wide on Linux.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class EngineHost:
+    """Actor body: one LLMEngine replica in its own process."""
+
+    def __init__(self, model_kind: str = "gpt2",
+                 config_kw: Optional[dict] = None, seed: int = 0,
+                 **engine_kw):
+        from ray_tpu.serve.llm_engine import LLMEngine, build_model
+
+        model, params = build_model(model_kind, config_kw, seed)
+        self.engine = LLMEngine(model, params, **engine_kw)
+
+    def generate_rollouts(self, prompts, max_new_tokens: int = 16,
+                          eos_id: Optional[int] = None,
+                          sampling: Optional[list] = None
+                          ) -> List[Dict[str, Any]]:
+        return self.engine.generate_rollouts(prompts, max_new_tokens,
+                                             eos_id, sampling=sampling)
+
+    def swap_weights(self, params, version: int) -> int:
+        return self.engine.swap_weights(params, version, timeout=120.0)
+
+    def weight_version(self) -> int:
+        return self.engine.weight_version
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+    def recent_step_stamps(self) -> List[float]:
+        return self.engine.recent_step_stamps()
+
+    def drain(self) -> bool:
+        self.engine.close()
+        return True
+
+
+class RemoteEngine:
+    """Driver-side client over an :class:`EngineHost` actor.
+
+    ``max_concurrency`` on the actor lets ``swap_weights``/``stats``
+    land while a ``generate_rollouts`` call is mid-decode — the hot
+    swap must reach the engine loop *during* generation, not after."""
+
+    def __init__(self, model_kind: str = "gpt2",
+                 config_kw: Optional[dict] = None, seed: int = 0,
+                 **engine_kw):
+        import ray_tpu
+
+        self._actor = ray_tpu.remote(EngineHost).options(
+            max_concurrency=8).remote(model_kind, config_kw, seed,
+                                      **engine_kw)
+        self._ray = ray_tpu
+
+    def generate_rollouts(self, prompts, max_new_tokens: int = 16,
+                          eos_id: Optional[int] = None,
+                          sampling: Optional[list] = None,
+                          timeout: float = 600.0):
+        return self._ray.get(
+            self._actor.generate_rollouts.remote(
+                prompts, max_new_tokens, eos_id, sampling),
+            timeout=timeout)
+
+    def swap_weights(self, params, version: int,
+                     timeout: float = 120.0) -> int:
+        return self._ray.get(
+            self._actor.swap_weights.remote(params, version),
+            timeout=timeout)
+
+    @property
+    def weight_version(self) -> int:
+        return self._ray.get(self._actor.weight_version.remote(),
+                             timeout=60.0)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._ray.get(self._actor.stats.remote(), timeout=60.0)
+
+    def recent_step_stamps(self) -> List[float]:
+        return self._ray.get(self._actor.recent_step_stamps.remote(),
+                             timeout=60.0)
+
+    def close(self):
+        try:
+            self._ray.get(self._actor.drain.remote(), timeout=30.0)
+        except Exception:
+            pass
+        try:
+            self._ray.kill(self._actor)
+        except Exception:
+            pass

@@ -69,6 +69,23 @@ Load-bearing ideas:
    ``ops/collectives``), and the engine adopts them at a later token
    boundary — decode never stalls on a long prompt.
 
+8. **Token-boundary hot weight swap** (``swap_weights``).  The RLHF
+   close-the-loop primitive: new params install *between* decode steps
+   — one ``device_put`` per version (params are a plain argument of
+   the compiled steps, so a swap never recompiles and
+   ``decode_cache_size`` stays 1), zero in-flight requests dropped.
+   In-flight slots are recycled through the recompute-preemption path
+   so their KV is rebuilt under the NEW weights (their already-sampled
+   tokens are data and survive verbatim), every emitted token is
+   stamped with the weight version it was sampled under, and the
+   prefix-cache namespace folds the version in
+   (``prefix_cache.versioned_namespace``) so stale pages become
+   unaddressable.  Each decode/prefill step also captures the sampled
+   token's **behavior logprob** (raw log-softmax — see
+   ``sampling.sample_tokens_with_logprobs``), so the generation that
+   serves RLHF rollouts yields the exact PPO-ratio denominator with no
+   second forward pass (``rollout()`` / ``generate_rollouts``).
+
 Request/response payloads ride the object plane zero-copy: see
 ``generate_many`` (client: ``put_many`` prompts → replica:
 ``get_many`` → decode → ``put_many`` outputs → client: ``get_many``).
@@ -181,6 +198,11 @@ class _Request:
     consumed: bool = False
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # Parallel to ``out``: the behavior logprob of each emitted token
+    # (raw log-softmax at the chosen token) and the weight version it
+    # was sampled under (swap_weights bumps the engine version).
+    out_logps: List[float] = dataclasses.field(default_factory=list)
+    out_versions: List[int] = dataclasses.field(default_factory=list)
 
     def context(self) -> List[int]:
         """Prompt plus generated-so-far — what a (re)admission prefills.
@@ -305,7 +327,13 @@ class LLMEngine:
         if not cache_namespace:
             cache_namespace = (f"{type(model).__name__}|{c!r}|"
                                f"ps{self.page_size}")
-        self._namespace = cache_namespace
+        # The engine owns version-folding: callers pass the UNVERSIONED
+        # base namespace and every swap_weights re-derives the effective
+        # namespace, making pre-swap pages unaddressable (see
+        # prefix_cache.versioned_namespace).
+        self._base_namespace = cache_namespace
+        self._weight_version = 0
+        self._namespace = pc.versioned_namespace(cache_namespace, 0)
         # Refs for pages this replica published: keeps the object alive
         # across the publish handoff even if the directory is slow to
         # pin; bounded (the directory is the durable holder).
@@ -367,6 +395,16 @@ class LLMEngine:
         self._stats = collections.Counter()
         self._occupancy_sum = 0.0
         self._t0 = time.monotonic()
+        # Hot weight swap: queued (params_or_ref, version, event) applied
+        # by the loop thread at the next token boundary.
+        self._pending_swaps: collections.deque = collections.deque()
+        self._swap_latency_sum = 0.0
+        # Generation-plane accounting for the RLHF overlap gates: wall
+        # time spent doing device work (prefill/decode/swap) and the
+        # completion stamp of recent decode steps.
+        self._work_s = 0.0
+        self._step_stamps: collections.deque = collections.deque(
+            maxlen=1024)
         self._metrics = None
         self._metrics_flush = 0.0
         self._stage = None
@@ -423,6 +461,100 @@ class LLMEngine:
         if req.error is not None:
             raise req.error
         return list(req.out)
+
+    def swap_weights(self, params, version: int,
+                     timeout: Optional[float] = 60.0) -> int:
+        """Install new model params at the next token boundary (hot swap).
+
+        ``params`` is either a host/device param pytree or an
+        ``ObjectRef`` from the versioned one-put weight broadcast (the
+        learner ``put``s once; every replica resolves the same ref) —
+        either way the engine pays exactly ONE ``device_put`` per
+        version.  The compiled decode/prefill/verify programs take
+        params as a plain argument, so a swap never recompiles
+        (``decode_cache_size`` stays 1) and no in-flight request is
+        dropped: active slots are recycled through the
+        recompute-preemption path, which re-prefills their
+        prompt+generated-so-far context under the NEW weights — their
+        already-emitted tokens (and captured logprobs/version stamps)
+        are data and survive verbatim, and every later token is sampled
+        under, and stamped with, ``version``.  The prefix-cache
+        namespace re-derives with the new version, so pre-swap KV pages
+        can never be adopted into post-swap contexts.
+
+        ``version`` must be strictly greater than the current engine
+        version (stamps must be unambiguous).  With ``timeout`` the call
+        blocks until the loop applies the swap (raises ``TimeoutError``
+        otherwise); ``timeout=None`` returns immediately.  Returns the
+        installed version."""
+        version = int(version)
+        applied = threading.Event()
+        with self._cond:
+            if self._closed:
+                raise EngineClosedError("engine is closed")
+            pending_max = max(
+                [v for _, v, _ in self._pending_swaps],
+                default=self._weight_version)
+            if version <= pending_max:
+                raise ValueError(
+                    f"swap version {version} must exceed the current "
+                    f"version {pending_max}")
+            self._pending_swaps.append((params, version, applied))
+            self._cond.notify_all()
+        if timeout is not None:
+            if not applied.wait(timeout):
+                raise TimeoutError(
+                    f"weight swap to version {version} not applied within "
+                    f"{timeout}s")
+            if self._weight_version < version:
+                # close()/_fail_all wakes waiters without applying.
+                raise EngineClosedError(
+                    f"engine closed before swap to version {version} "
+                    f"applied")
+        return version
+
+    @property
+    def weight_version(self) -> int:
+        return self._weight_version
+
+    def rollout(self, rid: int, timeout: Optional[float] = None
+                ) -> Dict[str, Any]:
+        """Blocking full result PLUS the per-token behavior logprobs and
+        weight-version stamps — the RLHF rollout record (no second
+        forward pass needed for the PPO ratio)."""
+        req = self._requests[rid]
+        if not req.done.wait(timeout):
+            raise TimeoutError(f"request {rid} not done within {timeout}s")
+        req.consumed = True
+        if req.error is not None:
+            raise req.error
+        return {
+            "prompt": list(req.prompt),
+            "tokens": list(req.out),
+            "logprobs": list(req.out_logps),
+            "versions": list(req.out_versions),
+        }
+
+    def generate_rollouts(self, prompts: Sequence[Sequence[int]],
+                          max_new_tokens: int = 16,
+                          eos_id: Optional[int] = None,
+                          sampling: Optional[List[SamplingParams]] = None,
+                          timeout: float = 300.0) -> List[Dict[str, Any]]:
+        """Submit a prompt batch and collect version-stamped rollouts —
+        continuous batching amortizes the decode across the whole batch
+        (all prompts are in flight together, subject to ``max_slots``)."""
+        if sampling is None:
+            sampling = [None] * len(prompts)
+        rids = [self.submit(p, max_new_tokens, eos_id, sampling=s)
+                for p, s in zip(prompts, sampling)]
+        return [self.rollout(r, timeout=timeout) for r in rids]
+
+    def recent_step_stamps(self) -> List[float]:
+        """``time.monotonic()`` completion stamps of recent decode steps
+        — the overlap gates prove generation ran inside an SGD window by
+        finding stamps inside it."""
+        with self._lock:
+            return list(self._step_stamps)
 
     def stream(self, rid: int, timeout: float = 120.0):
         """Yield token chunks (lists) as they are produced; returns when
@@ -489,6 +621,13 @@ class LLMEngine:
             "prefill_prefix_fallback": s.get("prefill_prefix_fallback", 0),
             "wire_bytes": s.get("wire_bytes", 0),
             "wire_fp32_bytes": s.get("wire_fp32_bytes", 0),
+            # hot weight swap / generation-plane accounting
+            "weight_version": self._weight_version,
+            "swaps": s.get("swaps", 0),
+            "swap_reprefills": s.get("swap_reprefills", 0),
+            "swap_latency_s_avg": (self._swap_latency_sum / s["swaps"]
+                                   if s.get("swaps", 0) else 0.0),
+            "work_seconds": self._work_s,
         }
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
@@ -502,7 +641,11 @@ class LLMEngine:
             if self._closed:
                 return
             self._closed = True
+            swaps = list(self._pending_swaps)
+            self._pending_swaps.clear()
             self._cond.notify_all()
+        for _, _, applied in swaps:
+            applied.set()  # wake blocked swappers; version stays put
         if self._stage is not None:
             self._stage.close()
         err = EngineClosedError("engine closed with requests in flight")
@@ -546,7 +689,7 @@ class LLMEngine:
         jnp = self._jnp
         cfg = model.config
         L, ps, pp = cfg.num_layers, self.page_size, self.pages_per_slot
-        from ray_tpu.serve.sampling import sample_tokens
+        from ray_tpu.serve.sampling import sample_tokens_with_logprobs
 
         hkv = getattr(cfg, "num_kv_heads", cfg.num_heads)
         if window_pages is None or window_pages >= pp:
@@ -580,8 +723,8 @@ class LLMEngine:
                 {"params": params}, tokens[:, None], lengths[:, None], kv,
                 view_len)
             # The generated token sits at absolute position lengths + 1.
-            next_tok = sample_tokens(logits[:, -1], lengths + 1, temps,
-                                     top_ps, seeds)
+            next_tok, next_logp = sample_tokens_with_logprobs(
+                logits[:, -1], lengths + 1, temps, top_ps, seeds)
             newk = jnp.stack([nk[0][:, 0] for nk in new_kvs])
             newv = jnp.stack([nk[1][:, 0] for nk in new_kvs])
             slot_ix = jnp.arange(table.shape[0])
@@ -592,7 +735,7 @@ class LLMEngine:
                 newk.astype(k_pages.dtype))
             v_pages = v_pages.at[:, page_idx, off].set(
                 newv.astype(v_pages.dtype))
-            return k_pages, v_pages, next_tok
+            return k_pages, v_pages, next_tok, next_logp
 
         return step
 
@@ -606,7 +749,7 @@ class LLMEngine:
         L, ps, pp = cfg.num_layers, self.page_size, self.pages_per_slot
         k_win = self.spec_tokens
         gather = self._gather_for(cfg)
-        from ray_tpu.serve.sampling import sample_tokens
+        from ray_tpu.serve.sampling import sample_tokens_with_logprobs
 
         def verify(params, k_pages, v_pages, table, lengths, window, active,
                    temps, top_ps, seeds):
@@ -630,9 +773,11 @@ class LLMEngine:
             n = table.shape[0]
             flat = logits.reshape(n * k_win, -1)
             rep = lambda a: jnp.repeat(a, k_win)
-            sampled = sample_tokens(flat, (positions + 1).reshape(-1),
-                                    rep(temps), rep(top_ps), rep(seeds))
-            return k_pages, v_pages, sampled.reshape(n, k_win)
+            sampled, logps = sample_tokens_with_logprobs(
+                flat, (positions + 1).reshape(-1), rep(temps), rep(top_ps),
+                rep(seeds))
+            return (k_pages, v_pages, sampled.reshape(n, k_win),
+                    logps.reshape(n, k_win))
 
         return verify
 
@@ -661,13 +806,14 @@ class LLMEngine:
         jax, jnp = self._jax, self._jnp
         model = self._model
         L, ps = self.num_layers, self.page_size
-        from ray_tpu.serve.sampling import sample_tokens
+        from ray_tpu.serve.sampling import sample_tokens_with_logprobs
 
         def prefill(params, k_pages, v_pages, row, tokens, p, temp, top_p,
                     seed):
             """tokens: [bucket] ids padded past p; row: [pp] page table
             row.  Returns updated pages + the sampled next token (the
-            token at absolute position p, key fold_in(seed, p))."""
+            token at absolute position p, key fold_in(seed, p)) and its
+            behavior logprob."""
             ids = tokens[None]
             positions = jnp.arange(bucket)[None]
             empty = [(jnp.zeros((1, 0, self.kv_heads, self.head_dim),
@@ -675,10 +821,11 @@ class LLMEngine:
             logits, new_kvs = model.apply(
                 {"params": params}, ids, positions, empty,
                 jnp.zeros((1,), jnp.int32))
-            next_tok = sample_tokens(
+            toks, logps = sample_tokens_with_logprobs(
                 logits[0, p - 1][None], jnp.reshape(p, (1,)),
                 jnp.reshape(temp, (1,)), jnp.reshape(top_p, (1,)),
-                jnp.reshape(seed, (1,)))[0]
+                jnp.reshape(seed, (1,)))
+            next_tok, next_logp = toks[0], logps[0]
             t = jnp.arange(bucket)
             page_idx = jnp.where(t < p, row[t // ps], 0)
             off = t % ps
@@ -688,7 +835,7 @@ class LLMEngine:
                 newk.astype(self.dtype))
             v_pages = v_pages.at[:, page_idx, off].set(
                 newv.astype(self.dtype))
-            return k_pages, v_pages, next_tok
+            return k_pages, v_pages, next_tok, next_logp
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._prefills[key] = fn
@@ -708,13 +855,13 @@ class LLMEngine:
         model = self._model
         L, ps, pp = self.num_layers, self.page_size, self.pages_per_slot
         gather = self._gather_for(model.config)
-        from ray_tpu.serve.sampling import sample_tokens
+        from ray_tpu.serve.sampling import sample_tokens_with_logprobs
 
         def tail_prefill(params, k_pages, v_pages, row, tokens, start, p,
                          temp, top_p, seed):
             """tokens: [bucket] tail ids (absolute positions start..p-1)
             padded past p-start; returns updated pages + the sampled
-            next token at absolute position p."""
+            next token at absolute position p and its behavior logprob."""
             k_cache = gather(k_pages, row[None])  # [L, 1, max_ctx, Hkv, D]
             v_cache = gather(v_pages, row[None])
             kv = [(k_cache[i], v_cache[i]) for i in range(L)]
@@ -723,10 +870,11 @@ class LLMEngine:
                 {"params": params}, tokens[None], positions, kv,
                 jnp.reshape(start, (1,)))
             tail_len = p - start
-            next_tok = sample_tokens(
+            toks, logps = sample_tokens_with_logprobs(
                 logits[0, tail_len - 1][None], jnp.reshape(p, (1,)),
                 jnp.reshape(temp, (1,)), jnp.reshape(top_p, (1,)),
-                jnp.reshape(seed, (1,)))[0]
+                jnp.reshape(seed, (1,)))
+            next_tok, next_logp = toks[0], logps[0]
             t = jnp.arange(bucket)
             abs_pos = start + t
             page_idx = jnp.where(
@@ -738,7 +886,7 @@ class LLMEngine:
                 newk.astype(self.dtype))
             v_pages = v_pages.at[:, page_idx, off].set(
                 newv.astype(self.dtype))
-            return k_pages, v_pages, next_tok
+            return k_pages, v_pages, next_tok, next_logp
 
         fn = jax.jit(tail_prefill, donate_argnums=(1, 2))
         self._prefills[key] = fn
@@ -804,13 +952,16 @@ class LLMEngine:
         with self._cond:
             while (not self._closed and not self._pending
                    and not self._awaiting and not self._ready
+                   and not self._pending_swaps
                    and not self._active.any()):
                 self._cond.wait(0.2)
                 if self._stage is not None and self._stage.token.cancelled:
                     return
             if self._closed:
                 return
+        t_work0 = time.perf_counter()
         try:
+            self._apply_swaps()  # token boundary: between decode steps
             self._poll_prefill()
             self._admit()
             self._grow()
@@ -819,16 +970,93 @@ class LLMEngine:
                     self._decode_once_spec()
                 else:
                     self._decode_once()
+                self._step_stamps.append(time.monotonic())
         except BaseException as e:  # noqa: BLE001 — fail loudly per req
             self._fail_all(e)
             return
+        self._work_s += time.perf_counter() - t_work0
         self._flush_metrics()
+
+    # ------------------------------------------------------------------
+    # hot weight swap (loop thread only)
+    # ------------------------------------------------------------------
+    def _apply_swaps(self):
+        """Install every queued weight version, newest last.  Runs
+        between decode steps — the definition of a token boundary."""
+        while True:
+            with self._lock:
+                if not self._pending_swaps:
+                    return
+                params, version, applied = self._pending_swaps.popleft()
+            t0 = time.monotonic()
+            try:
+                params = self._resolve_swap_params(params)
+                self._check_swap_tree(params)
+            except BaseException:
+                # The loop is about to die (_fail_all); wake the blocked
+                # swapper NOW — its version check converts the wake into
+                # a typed EngineClosedError instead of a full timeout.
+                applied.set()
+                raise
+            # ONE device_put per version; the old arrays free once the
+            # next compiled call stops referencing them.
+            self._params = self._jax.device_put(params)
+            self._weight_version = int(version)
+            from ray_tpu.serve import prefix_cache as pc
+
+            self._namespace = pc.versioned_namespace(
+                self._base_namespace, self._weight_version)
+            # In-flight requests: recycle through recompute preemption so
+            # their KV is rebuilt under the new weights at re-admission
+            # (sampled tokens are data; seeded sampling is position-
+            # keyed, so the resumed stream continues seamlessly).
+            for slot in range(self.max_slots):
+                if self._active[slot]:
+                    self._preempt(slot)
+                    self._stats["swap_reprefills"] += 1
+            self._stats["swaps"] += 1
+            self._swap_latency_sum += time.monotonic() - t0
+            applied.set()
+
+    def _resolve_swap_params(self, params):
+        try:
+            import ray_tpu
+
+            if isinstance(params, ray_tpu.ObjectRef):
+                return ray_tpu.get(params)
+        except Exception:
+            pass
+        return params
+
+    def _check_swap_tree(self, params):
+        """A silently mismatched tree would recompile the decode step
+        (breaking the decode_cache_size==1 contract) or garble the
+        model — fail loudly instead."""
+        jax = self._jax
+        new_leaves = jax.tree_util.tree_structure(params)
+        cur_leaves = jax.tree_util.tree_structure(self._params)
+        if new_leaves != cur_leaves:
+            raise ValueError(
+                "swap_weights params tree does not match the serving "
+                f"model's ({new_leaves} vs {cur_leaves})")
+        for new, cur in zip(jax.tree_util.tree_leaves(params),
+                            jax.tree_util.tree_leaves(self._params)):
+            if tuple(new.shape) != tuple(cur.shape) or \
+                    new.dtype != cur.dtype:
+                raise ValueError(
+                    f"swap_weights leaf mismatch: {new.shape}/{new.dtype} "
+                    f"vs serving {cur.shape}/{cur.dtype} — a swap must "
+                    "not change shapes or dtypes (it would recompile)")
 
     def _fail_all(self, e: BaseException):
         with self._lock:
             self._closed = True  # a dead loop must reject new submits
             self._awaiting = []
             self._ready.clear()
+            swaps = list(self._pending_swaps)
+            self._pending_swaps.clear()
+        for _, _, applied in swaps:
+            applied.set()
         for req in list(self._requests.values()):
             if not req.done.is_set():
                 req.finish(error=e)
@@ -904,8 +1132,9 @@ class LLMEngine:
             if cached:
                 self._adopt_pages(slot, 0, cached)
                 self._stats["prefill_tokens_saved"] += start
-            nxt = self._local_prefill(slot, req, ctx, start)
-            self._finish_admission(slot, req, p, int(nxt), mid_batch)
+            nxt, lp = self._local_prefill(slot, req, ctx, start)
+            self._finish_admission(slot, req, p, int(nxt), float(lp),
+                                   mid_batch)
 
     def _local_prefix_run(self, ctx: List[int]) -> int:
         """Length (tokens) of the leading full-page run present in the
@@ -962,9 +1191,9 @@ class LLMEngine:
                     if cached:
                         self._adopt_pages(slot, 0, cached)
                         self._stats["prefill_tokens_saved"] += hit
-                    nxt = self._local_prefill(slot, req, ctx, hit)
+                    nxt, lp = self._local_prefill(slot, req, ctx, hit)
                     self._finish_admission(slot, req, p, int(nxt),
-                                           mid_batch)
+                                           float(lp), mid_batch)
                     continue
                 self._adopt_pages(slot, 0, cached)
                 self._stats["prefill_tokens_saved"] += start
@@ -975,12 +1204,14 @@ class LLMEngine:
             self._stats["wire_fp32_bytes"] += int(meta.get("fp32_bytes", 0))
             if meta.get("exact", True):
                 self._publish_prefix(ctx, slot)
-            self._finish_admission(slot, req, p, int(next_tok), mid_batch)
+            self._finish_admission(slot, req, p, int(next_tok),
+                                   float(meta.get("next_logp", float("nan"))),
+                                   mid_batch)
 
     def _local_prefill(self, slot: int, req: _Request, ctx: List[int],
                        start: int):
         """Run the (full or cache-aware tail) prefill into the slot's
-        pages; returns the sampled next token."""
+        pages; returns (sampled next token, its behavior logprob)."""
         p = len(ctx)
         row = self._table[slot]
         s = req.sampling
@@ -991,7 +1222,7 @@ class LLMEngine:
             toks = np.zeros((bucket,), np.int32)
             toks[:p] = ctx
             fn = self._prefill_fn(bucket)
-            self._k_pages, self._v_pages, nxt = fn(
+            self._k_pages, self._v_pages, nxt, lp = fn(
                 self._params, self._k_pages, self._v_pages, row, toks,
                 np.int32(p), np.float32(s.temperature), np.float32(s.top_p),
                 np.int32(s.seed))
@@ -1000,15 +1231,15 @@ class LLMEngine:
             toks = np.zeros((bucket,), np.int32)
             toks[:tail_len] = ctx[start:]
             fn = self._tail_prefill_fn(bucket)
-            self._k_pages, self._v_pages, nxt = fn(
+            self._k_pages, self._v_pages, nxt, lp = fn(
                 self._params, self._k_pages, self._v_pages, row, toks,
                 np.int32(start), np.int32(p), np.float32(s.temperature),
                 np.float32(s.top_p), np.int32(s.seed))
         self._publish_prefix(ctx, slot)
-        return nxt
+        return nxt, lp
 
     def _finish_admission(self, slot: int, req: _Request, p: int,
-                          next_tok: int, mid_batch: bool):
+                          next_tok: int, next_logp: float, mid_batch: bool):
         """Shared tail of every admission path: the slot's KV covers
         positions [0, p) and ``next_tok`` is the sampled token at p."""
         if self._spec:
@@ -1028,7 +1259,7 @@ class LLMEngine:
         with self._lock:
             self._active[slot] = True
         self._slot_req[slot] = req
-        self._append_token(slot, req, next_tok)
+        self._append_token(slot, req, next_tok, next_logp)
 
     def _warm_draft(self, slot: int, ctx: List[int]):
         """Spec mode: full draft prefill of the context into the draft
@@ -1247,11 +1478,12 @@ class LLMEngine:
 
     def _decode_once(self):
         n_active = int(self._active.sum())
-        self._k_pages, self._v_pages, nxt = self._decode(
+        self._k_pages, self._v_pages, nxt, lps = self._decode(
             self._params, self._k_pages, self._v_pages, self._table,
             self._lengths, self._last_tok, self._active, self._temps,
             self._top_ps, self._seeds)
         nxt = np.asarray(nxt)
+        lps = np.asarray(lps)
         self._stats["steps"] += 1
         self._stats["tokens"] += n_active
         self._occupancy_sum += n_active / self.max_slots
@@ -1262,7 +1494,7 @@ class LLMEngine:
             req = self._slot_req[slot]
             tok = int(nxt[slot])
             self._last_tok[slot] = tok
-            self._append_token(slot, req, tok)
+            self._append_token(slot, req, tok, float(lps[slot]))
 
     def _decode_once_spec(self):
         """Draft k-1 proposals per slot, verify the [slots, k] window in
@@ -1275,7 +1507,7 @@ class LLMEngine:
         proposals = np.zeros((self.max_slots, k - 1), np.int32)
         d_last = self._last_tok.copy()
         for j in range(k - 1):
-            self._dk_pages, self._dv_pages, nxt = self._draft_decode(
+            self._dk_pages, self._dv_pages, nxt, _dlp = self._draft_decode(
                 self._draft_params, self._dk_pages, self._dv_pages,
                 self._table, self._lengths + j, d_last, self._active,
                 self._temps, self._top_ps, self._seeds)
@@ -1287,17 +1519,18 @@ class LLMEngine:
         # draft would read a stale row and desync; on partial
         # acceptance the row sits beyond kv_lengths and is overwritten
         # before it is ever read.  The sampled output is discarded.
-        self._dk_pages, self._dv_pages, _ = self._draft_decode(
+        self._dk_pages, self._dv_pages, _, _ = self._draft_decode(
             self._draft_params, self._dk_pages, self._dv_pages,
             self._table, self._lengths + (k - 1), d_last, self._active,
             self._temps, self._top_ps, self._seeds)
         window = np.concatenate(
             [self._last_tok[:, None], proposals], axis=1)
-        self._k_pages, self._v_pages, sampled = self._verify(
+        self._k_pages, self._v_pages, sampled, v_logps = self._verify(
             self._params, self._k_pages, self._v_pages, self._table,
             self._lengths, window, self._active, self._temps, self._top_ps,
             self._seeds)
         sampled = np.asarray(sampled)  # [slots, k]: tokens at len+1..len+k
+        v_logps = np.asarray(v_logps)
         self._stats["steps"] += 1
         self._stats["spec_steps"] += 1
         self._occupancy_sum += n_active / self.max_slots
@@ -1317,12 +1550,16 @@ class LLMEngine:
             self._lengths[slot] += emit
             self._last_tok[slot] = int(sampled[slot, emit - 1])
             for j in range(emit):
-                self._append_token(slot, req, int(sampled[slot, j]))
+                self._append_token(slot, req, int(sampled[slot, j]),
+                                   float(v_logps[slot, j]))
                 if not self._active[slot]:
                     break  # retired mid-window (EOS / max_new_tokens)
 
-    def _append_token(self, slot: int, req: _Request, tok: int):
+    def _append_token(self, slot: int, req: _Request, tok: int,
+                      logp: float = float("nan")):
         req.out.append(tok)
+        req.out_logps.append(logp)
+        req.out_versions.append(self._weight_version)
         finished = (len(req.out) >= req.max_new_tokens
                     or (req.eos_id is not None and tok == req.eos_id))
         if finished:
@@ -1511,13 +1748,26 @@ def build_model(model_kind: str, config_kw: Optional[dict] = None,
 
 
 def cache_namespace_for(model_kind: str, config_kw: Optional[dict],
-                        seed: int, page_size: int) -> str:
+                        seed: int, page_size: int,
+                        weight_version: Optional[int] = None) -> str:
     """Stable prefix-cache namespace: everything that changes a page's
-    bytes (model family, config, init seed, page geometry) must be in
-    the address, so deployments sharing an object plane can't poison
-    each other."""
+    bytes (model family, config, init seed, page geometry — and, since
+    hot weight swaps exist, the weight version) must be in the address,
+    so deployments sharing an object plane can't poison each other.
+
+    ``weight_version=None`` returns the UNVERSIONED base — the form to
+    hand ``LLMEngine(cache_namespace=...)``, which folds its own live
+    version in on every ``swap_weights`` (see
+    ``prefix_cache.versioned_namespace``).  Pass an explicit version to
+    address a specific weight generation from outside the engine
+    (tests, external publishers)."""
     kw = sorted((config_kw or {}).items())
-    return f"{model_kind}|{kw!r}|seed{seed}|ps{page_size}"
+    base = f"{model_kind}|{kw!r}|seed{seed}|ps{page_size}"
+    if weight_version is None:
+        return base
+    from ray_tpu.serve.prefix_cache import versioned_namespace
+
+    return versioned_namespace(base, weight_version)
 
 
 class LLMServer:
@@ -1615,6 +1865,25 @@ class LLMServer:
         if chunk is None:
             req.consumed = True
         return chunk
+
+    def swap_weights(self, params, version: int,
+                     timeout: Optional[float] = 60.0) -> int:
+        """Hot-swap this replica's engine weights (``params`` may be the
+        broadcast ObjectRef — one learner ``put`` serves every
+        replica)."""
+        return self.engine.swap_weights(params, version, timeout=timeout)
+
+    def generate_rollouts(self, prompts, max_new_tokens: int = 16,
+                          eos_id: Optional[int] = None,
+                          sampling: Optional[list] = None):
+        """Version-stamped rollouts (tokens + behavior logprobs) for the
+        RLHF loop; accepts prompt refs like ``generate_batch``."""
+        import ray_tpu
+
+        if prompts and isinstance(prompts[0], ray_tpu.ObjectRef):
+            prompts = ray_tpu.get_many(list(prompts))
+        return self.engine.generate_rollouts(
+            prompts, max_new_tokens, eos_id, sampling=sampling)
 
     def stats(self) -> dict:
         return self.engine.stats()

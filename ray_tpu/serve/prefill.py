@@ -141,7 +141,7 @@ class PrefillWorker:
         import jax
         import jax.numpy as jnp
 
-        from ray_tpu.serve.sampling import sample_tokens
+        from ray_tpu.serve.sampling import sample_tokens_with_logprobs
 
         model, L = self._model, self.num_layers
         hkv, d, dt = self.kv_heads, self.head_dim, self.dtype
@@ -153,13 +153,13 @@ class PrefillWorker:
             logits, new_kvs = model.apply(
                 {"params": params}, ids, positions, empty,
                 jnp.zeros((1,), jnp.int32))
-            next_tok = sample_tokens(
+            toks, logps = sample_tokens_with_logprobs(
                 logits[0, p - 1][None], jnp.reshape(p, (1,)),
                 jnp.reshape(temp, (1,)), jnp.reshape(top_p, (1,)),
-                jnp.reshape(seed, (1,)))[0]
+                jnp.reshape(seed, (1,)))
             newk = jnp.stack([nk[0][0] for nk in new_kvs])  # [L,bkt,Hkv,D]
             newv = jnp.stack([nk[1][0] for nk in new_kvs])
-            return newk, newv, next_tok
+            return newk, newv, toks[0], logps[0]
 
         fn = jax.jit(prefill)
         self._fns[bucket] = fn
@@ -183,7 +183,7 @@ class PrefillWorker:
         bucket = self._bucket_for(p)
         toks = np.zeros((bucket,), np.int32)
         toks[:p] = tokens
-        newk, newv, nxt = self._fn(bucket)(
+        newk, newv, nxt, nxt_logp = self._fn(bucket)(
             self._params, toks, np.int32(p), np.float32(temperature),
             np.float32(top_p), np.int32(seed))
         ps = self.page_size
@@ -198,7 +198,8 @@ class PrefillWorker:
         pv = bv.reshape(self.num_layers, n1, ps, self.kv_heads,
                         self.head_dim)[:, n0:]
         payload = pack_pages(pk, pv, self.wire_dtype)
-        payload.update(next_token=int(nxt), p=p, start=start)
+        payload.update(next_token=int(nxt), next_logp=float(nxt_logp),
+                       p=p, start=start)
         self._stats["requests"] += 1
         self._stats["tokens"] += p - start
         self._stats["wire_bytes"] += payload["wire_bytes"]
@@ -263,7 +264,8 @@ def _resolve_payload(payload: Dict[str, Any]):
     k, v = unpack_pages(payload)
     meta = {"wire_bytes": payload["wire_bytes"],
             "fp32_bytes": payload["fp32_bytes"],
-            "exact": payload["fmt"] == "native"}
+            "exact": payload["fmt"] == "native",
+            "next_logp": payload.get("next_logp", float("nan"))}
     return k, v, payload["next_token"], meta
 
 

@@ -53,6 +53,20 @@ import numpy as np
 AFFINITY_PREFIX_TOKENS = 16
 
 
+def versioned_namespace(base: str, weight_version: int) -> str:
+    """Fold the serving weight version into a cache namespace.
+
+    KV pages are a pure function of (weights, token prefix): after a
+    hot weight swap (``LLMEngine.swap_weights``) every page computed
+    under the old weights is stale for the new policy, and a cache hit
+    on one would silently splice old-policy K/V into a new-policy
+    context.  Folding the version into the namespace makes every
+    pre-swap key unreachable — the invalidation is by *addressing*, no
+    sweep required, and pages published by replicas still on the old
+    version can't poison replicas on the new one."""
+    return f"{base}|wv{int(weight_version)}"
+
+
 def page_key(namespace: str, tokens) -> str:
     """Content address of the KV page covering ``tokens`` — the blake2b
     idiom from ``checkpoint/chunks.py:hash_chunk`` over the *token

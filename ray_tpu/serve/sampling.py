@@ -80,20 +80,32 @@ def top_p_mask(logits, top_p):
     return jnp.take_along_axis(keep_sorted, inv, axis=-1)
 
 
-def sample_tokens(logits, positions, temperature, top_p, seeds):
-    """Draw one token per row.  All jnp, fixed shapes, jit-inlinable.
+def sample_tokens_with_logprobs(logits, positions, temperature, top_p,
+                                seeds):
+    """Draw one token per row and capture its behavior logprob.  All
+    jnp, fixed shapes, jit-inlinable.
 
     logits: [N, V] fp32; positions: [N] absolute position of the token
     being generated; temperature/top_p: [N] f32; seeds: [N] int32.
     Rows with ``temperature <= 0`` take the argmax instead (greedy and
     sampled requests share one compiled step).
+
+    Returns ``(tokens [N] int32, logps [N] f32)``.  The logprob is the
+    RAW log-softmax of the model's logits at the chosen token —
+    ``log pi(token | context)`` at temperature 1 with no nucleus
+    truncation — which is exactly what a full-context forward pass
+    recomputes and what the PPO ratio's behavior term needs.  Sampling
+    transforms (temperature, top-p) change *which* token is drawn, not
+    the definition of the captured logprob, so greedy and sampled
+    requests stamp comparable values.
     """
     import jax
     import jax.numpy as jnp
 
+    logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temp = jnp.maximum(temperature, 1e-6)[..., None]
-    scaled = logits.astype(jnp.float32) / temp
+    scaled = logits / temp
     masked = jnp.where(top_p_mask(scaled, top_p), scaled, -jnp.inf)
 
     def draw(row_logits, pos, seed):
@@ -101,4 +113,15 @@ def sample_tokens(logits, positions, temperature, top_p, seeds):
         return jax.random.categorical(key, row_logits).astype(jnp.int32)
 
     sampled = jax.vmap(draw)(masked, positions, seeds)
-    return jnp.where(temperature <= 0.0, greedy, sampled)
+    tokens = jnp.where(temperature <= 0.0, greedy, sampled)
+    logp_all = jax.nn.log_softmax(logits, axis=-1)
+    logps = jnp.take_along_axis(logp_all, tokens[..., None],
+                                axis=-1)[..., 0]
+    return tokens, logps
+
+
+def sample_tokens(logits, positions, temperature, top_p, seeds):
+    """Token-only form of :func:`sample_tokens_with_logprobs` (the
+    logprob computation is dead code XLA eliminates when unused)."""
+    return sample_tokens_with_logprobs(logits, positions, temperature,
+                                       top_p, seeds)[0]

@@ -2,10 +2,12 @@
 
 Reference: python/ray/tune/execution/trial_runner.py:268 (step :931) +
 RayTrialExecutor (ray_trial_executor.py:191).  Each trial runs as a
-_TrialActor (a remote actor executing the trainable function in a thread and
-streaming reports through a queue — same mechanism as Train's TrainWorker).
-The runner multiplexes trial results with ray_tpu.wait, feeds the scheduler,
-and applies STOP/exploit decisions.
+_TrialActor: a remote actor executing the trainable function on a
+``flow.Stage`` sink worker (the async dataflow substrate owns the
+thread lifecycle — same migration as the serve batcher and the engine
+loop) and streaming reports through a queue, same mechanism as Train's
+TrainWorker.  The runner multiplexes trial results with ray_tpu.wait,
+feeds the scheduler, and applies STOP/exploit decisions.
 """
 from __future__ import annotations
 
@@ -24,7 +26,11 @@ from ray_tpu.tune.trial import ERROR, PENDING, RUNNING, TERMINATED, Trial
 class _TrialActor:
     def __init__(self, fn, config: dict, checkpoint=None):
         import queue
-        import threading
+
+        # Lazy: ray_tpu.parallel's __init__ pulls jax; trial actors that
+        # never run a jax trainable shouldn't pay the import at module
+        # scope (the serve batcher's rule).
+        from ray_tpu.parallel import flow
 
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
@@ -34,7 +40,7 @@ class _TrialActor:
             if self._stop.is_set():
                 raise SystemExit  # cooperative stop at next report
 
-        def run():
+        def run(_item):
             from ray_tpu.air import session as air_session
 
             air_session.init_session(report_fn=report_fn,
@@ -58,7 +64,11 @@ class _TrialActor:
             finally:
                 air_session.shutdown_session()
 
-        threading.Thread(target=run, daemon=True, name="trial").start()
+        # One-item sink stage: the worker thread runs the trainable to
+        # completion (reports stream through the queue as side effects),
+        # then the source exhausts and the substrate retires the thread.
+        self._stage = flow.Stage(iter([None]), run, sink=True, workers=1,
+                                 name="tune_trial", export_metrics=False)
 
     def next_result(self, timeout: float = 600.0):
         import queue

@@ -55,6 +55,7 @@ def test_lints_regret_sublinear():
     assert m["regret_this_iter"] < 0.3 * max(m1["regret_this_iter"], 1e-9)
 
 
+@pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
 def test_dynaq_learns_cartpole_and_model_converges():
     algo = (DynaQConfig().environment("CartPole-v1")
             .anakin(num_envs=32, unroll_length=16)

@@ -29,6 +29,7 @@ NODE_TYPES = {
 }
 
 
+@pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
 def test_scale_up_run_and_idle_terminate(tight_cluster):
     head = tight_cluster
     provider = FakeMultiNodeProvider(head)

@@ -21,6 +21,7 @@ def _run_cli(mod, *args, timeout=600):
 
 
 class TestRllibCLI:
+    @pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
     def test_train_flags_then_evaluate_checkpoint(self, tmp_path):
         """Full CLI round trip: train PPO briefly, checkpoint, evaluate."""
         ckpt_dir = str(tmp_path / "ckpt")

@@ -73,6 +73,7 @@ def test_appo_grad_matches_impala_on_policy():
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
 def test_impala_and_appo_on_pixel_env():
     """The V-trace family drives the CNN trunk on pixel envs (the loss
     must preserve trailing obs dims instead of flattening them)."""
@@ -107,6 +108,7 @@ def test_td3_learns_pendulum():
     assert best >= -300, f"TD3 failed to learn Pendulum: best={best}"
 
 
+@pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
 def test_td3_smoke_and_checkpoint():
     from ray_tpu.rllib import TD3Config
 

@@ -302,6 +302,7 @@ def test_llama_1b_config_scale():
     assert cfg.mlp_dim == 5632
 
 
+@pytest.mark.slow  # long-tail (>8s): nightly covers it; tier-1 budget rule (PR 10)
 def test_llama_pipeline_parity(cluster):
     """A split tiny Llama (GQA + SwiGLU) trained through the interleaved
     2-stage pipeline matches the same chunk fns composed in-process."""

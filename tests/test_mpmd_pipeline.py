@@ -379,6 +379,7 @@ def test_mpmd_spmd_stage_with_zero_sharded_optimizer(cluster):
     assert ratio <= 0.5 + 0.05, f"opt state not 1/N-sharded: {ratio}"
 
 
+@pytest.mark.slow  # long-tail (>8s): nightly covers it; tier-1 budget rule (PR 10)
 def test_mpmd_gpt2_split_pipeline_parity(cluster):
     """A split tiny GPT-2 trained through the 2-stage pipeline matches
     the same stages composed in-process (the single-mesh reference)."""

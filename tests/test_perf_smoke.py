@@ -11,6 +11,7 @@ from tools.perf_smoke import (
     run_mpmd_smoke,
     run_node_loss_smoke,
     run_object_plane_smoke,
+    run_rlhf_smoke,
     run_rollout_smoke,
     run_rpc_chaos_smoke,
     run_serving_smoke,
@@ -158,6 +159,22 @@ def test_flow_smoke(shutdown_only):
     assert out["residency_ok"], f"window bound violated: {out}"
     assert out["produce_consume_overlap"], f"stage barrier regression: {out}"
     assert out["driver_syncs"] == 0, out
+    assert out["ok"], out
+
+
+def test_rlhf_smoke():
+    """The RLHF loop must keep its two planes genuinely concurrent: a
+    decode-step wall-clock stamp lands inside an SGD window (generation
+    of batch i+1 overlaps training on batch i), >= 2 hot weight swaps
+    apply with the decode step compiled exactly once and zero
+    dropped/errored rollouts, and the engine-captured behavior logprobs
+    match a full-context forward pass (the tier-1 guard for ISSUE 14)."""
+    out = run_rlhf_smoke()
+    assert out["overlap_windows"] >= 1, f"drain-then-train regression: {out}"
+    assert out["swaps"] >= 2, out
+    assert out["decode_cache_size"] == 1, f"swap recompiled decode: {out}"
+    assert out["rollouts_full"] and out["pages_leaked"] == 0, out
+    assert out["logp_parity_err"] < 1e-3, f"logprob capture drifted: {out}"
     assert out["ok"], out
 
 

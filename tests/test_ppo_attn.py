@@ -108,6 +108,7 @@ def test_attention_ppo_learns_stateless_cartpole():
     assert best >= 150, f"attention PPO failed the memory task: best={best}"
 
 
+@pytest.mark.slow  # long-tail (>8s): nightly covers it; tier-1 budget rule (PR 10)
 def test_attention_ppo_checkpoint_roundtrip():
     cfg = (PPOConfig().environment("StatelessCartPole-v1")
            .anakin(num_envs=8, unroll_length=8)

@@ -2,6 +2,7 @@
 stateless-CartPole recurrent example, rllib/examples/env/
 stateless_cartpole.py)."""
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +69,7 @@ def test_sequence_replay_matches_rollout_exactly():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # long-tail (>10s): nightly covers it; tier-1 budget rule (PR 10)
 def test_lstm_ppo_learns_stateless_cartpole():
     """The memory gate: with velocities hidden, a memoryless policy
     plateaus around reward ~30 (measured); the LSTM must clear 150."""
@@ -89,6 +91,7 @@ def test_lstm_ppo_learns_stateless_cartpole():
     assert best >= 150, f"LSTM PPO failed the memory task: best={best}"
 
 
+@pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
 def test_lstm_ppo_checkpoint_roundtrip():
     cfg = (PPOConfig().environment("StatelessCartPole-v1")
            .anakin(num_envs=8, unroll_length=8)

@@ -14,6 +14,7 @@ def ray_cluster():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow  # long-tail (>10s): nightly covers it; tier-1 budget rule (PR 10)
 def test_ppo_actor_mode_learns_cartpole(ray_cluster):
     """Learning gate for the reference-shaped path (reference pattern:
     per-algorithm learning tests with a reward floor,

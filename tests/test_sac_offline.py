@@ -116,6 +116,7 @@ def test_json_writer_reader_roundtrip(tmp_path):
     assert len(total) == 7
 
 
+@pytest.mark.slow  # long-tail (>8s): nightly covers it; tier-1 budget rule (PR 10)
 def test_bc_clones_expert_cartpole(tmp_path):
     """End-to-end offline pipeline: PPO trains an expert, its rollouts are
     written with JsonWriter, BC clones them, and the clone clears the

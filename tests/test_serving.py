@@ -263,6 +263,7 @@ def serve_cluster(monkeypatch):
     CONFIG.reset()
 
 
+@pytest.mark.slow  # long-tail (>8s): nightly covers it; tier-1 budget rule (PR 10)
 def test_serve_llm_zero_copy_roundtrip(serve_cluster, gpt2):
     """Prompts ride put_many → replica get_many → decode → put_many →
     client get_many, token-identical to the uncached reference; teardown
@@ -285,6 +286,7 @@ def test_serve_llm_zero_copy_roundtrip(serve_cluster, gpt2):
     serve.delete("llm")
 
 
+@pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
 def test_serve_llm_streaming_chunks(serve_cluster, gpt2):
     """Pull-based streaming through the replica: chunks arrive before the
     request completes and concatenate to the exact output."""
@@ -311,6 +313,7 @@ def test_serve_llm_streaming_chunks(serve_cluster, gpt2):
     serve.delete("llm_stream")
 
 
+@pytest.mark.slow  # long-tail (>10s): nightly covers it; tier-1 budget rule (PR 10)
 def test_llm_autoscales_up_under_load(serve_cluster):
     """The acceptance gate's autoscaling half: a saturating synthetic
     client drives the ServeController to add LLM replicas."""

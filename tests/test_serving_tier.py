@@ -163,6 +163,7 @@ def test_spec_decode_self_draft_identical_full_acceptance(gpt2, naive):
         eng.close()
 
 
+@pytest.mark.slow  # long-tail: nightly covers it; tier-1 budget rule (PR 10)
 def test_spec_decode_tiny_draft_distribution_identical(gpt2, naive):
     """A 1-layer random-weight draft: acceptance is partial, but the
     emitted stream is STILL bitwise the non-speculative sampled stream

@@ -158,6 +158,9 @@ def _run_gpt2_dp(num_workers: int, local_device_count: int):
     return result.metrics_history[-1]
 
 
+@pytest.mark.slow  # ~30s: two gloo worlds + elastic retries under load
+# inflate it to the suite's slowest test (see the max_failures note in
+# _run_gpt2_dp); nightly covers it, PR 10's long-tail rule.
 def test_gpt2_dp_two_workers_matches_single_process(ray_start_regular):
     """GPT-2 data-parallel across 2 worker processes produces the SAME loss
     trajectory as one process driving an equal-size mesh — the gradient

@@ -368,6 +368,7 @@ def test_zero_requires_spmd_path():
 # ---------------------------------------------------------------------------
 # GPT-2 tiny quantization gate (ISSUE 9 satellite)
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # long-tail (>8s): nightly covers it; tier-1 budget rule (PR 10)
 def test_gpt2_int8_collectives_loss_envelope():
     """GPT-2 tiny trained with int8 gradient collectives (ZeRO-2 wire)
     reaches a loss within a fixed envelope of the fp32 run on the same

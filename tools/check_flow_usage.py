@@ -33,8 +33,6 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALLOWLIST = {
     # train worker-group result plumbing
     "ray_tpu/train/_internal/worker_group.py",
-    # tune trial-runner event queue
-    "ray_tpu/tune/execution/trial_runner.py",
 }
 
 # Runtime plumbing exempt from the operator-core rule: the transport /
