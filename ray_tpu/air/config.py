@@ -8,7 +8,7 @@ layout lives in the mesh axes, not in worker count.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple, Union
 
 from ray_tpu.parallel.mesh import MeshSpec
 
@@ -17,14 +17,39 @@ from ray_tpu.parallel.mesh import MeshSpec
 class ScalingConfig:
     """num_workers = processes (1 per TPU host). use_tpu selects the chip
     resource; chips_per_worker reserves them; mesh describes the logical
-    parallelism over ALL chips of the group."""
+    parallelism over ALL chips of the group.
 
-    num_workers: int = 1
+    ``num_workers`` may be an ``(min, max)`` tuple for an *elastic* gang:
+    BackendExecutor starts as many workers as the cluster can place right
+    now (probing max→min) and never below min."""
+
+    num_workers: Union[int, Tuple[int, int]] = 1
     use_tpu: bool = False
     chips_per_worker: int = 0
     resources_per_worker: Optional[Dict[str, float]] = None
     mesh: Optional[MeshSpec] = None
     placement_strategy: str = "PACK"
+
+    def worker_range(self) -> Tuple[int, int]:
+        """(min, max) worker count — a fixed ``num_workers=n`` is the
+        degenerate range (n, n)."""
+        nw = self.num_workers
+        if isinstance(nw, int):
+            if nw < 1:
+                raise ValueError(f"num_workers must be >= 1, got {nw}")
+            return (nw, nw)
+        lo, hi = int(nw[0]), int(nw[1])
+        if not 1 <= lo <= hi:
+            raise ValueError(f"bad elastic num_workers range {nw!r}")
+        return (lo, hi)
+
+    @property
+    def min_workers(self) -> int:
+        return self.worker_range()[0]
+
+    @property
+    def max_workers(self) -> int:
+        return self.worker_range()[1]
 
     # Reference-compat alias (trainer_resources etc. intentionally dropped).
     @property

@@ -138,6 +138,43 @@ class FakeMultiNodeProvider(NodeProvider):
             self.terminate_node(node_id)
 
 
+class TrainingGangPolicy:
+    """Scale decision for ONE elastic training gang (an ElasticMeshGroup
+    or anything duck-typed like it: ``hosts`` attribute, ``pending_steps()``
+    and ``request_resize(n)`` methods).
+
+    Gangs scale as a unit, so the generic bin-packing loop can't drive
+    them — a gang never wants "one more node somewhere", it wants "resize
+    the whole gang to N".  The policy maps spare cluster capacity plus the
+    gang's own backlog onto a target size: grow only when work is actually
+    queued (``pending_steps() >= scale_threshold``) and spare hosts exist;
+    never propose below ``min_hosts`` (preemption handling, not this
+    policy, shrinks the gang)."""
+
+    def __init__(self, controller, min_hosts: int, max_hosts: int,
+                 scale_threshold: int = 1):
+        self.controller = controller
+        self.min_hosts = int(min_hosts)
+        self.max_hosts = int(max_hosts)
+        self.scale_threshold = int(scale_threshold)
+
+    def desired(self, spare_hosts: int) -> int:
+        cur = int(self.controller.hosts)
+        pending = int(self.controller.pending_steps())
+        target = cur
+        if pending >= self.scale_threshold and spare_hosts > 0:
+            target = min(self.max_hosts, cur + spare_hosts)
+        return max(self.min_hosts, target)
+
+    def apply(self, spare_hosts: int) -> Optional[int]:
+        """Returns the requested size when a resize was proposed."""
+        target = self.desired(spare_hosts)
+        if target != int(self.controller.hosts):
+            self.controller.request_resize(target)
+            return target
+        return None
+
+
 class StandardAutoscaler:
     def __init__(self, node_types: Dict[str, Dict],
                  provider: Optional[NodeProvider] = None,
@@ -152,6 +189,7 @@ class StandardAutoscaler:
         self.max_nodes = max_nodes
         self.idle_timeout_s = idle_timeout_s
         self._node_idle_since: Dict = {}
+        self._gang_policies: List[TrainingGangPolicy] = []
         # Register the launchable shapes with the scheduler so demands
         # only a future node can satisfy stay PENDING (for this loop to
         # serve) instead of erroring as infeasible at submit.  (Like the
@@ -165,6 +203,16 @@ class StandardAutoscaler:
         """Stop advertising launchable capacity: without a live monitor,
         a pending-forever demand should raise Infeasible at submit."""
         self.head.scheduler.external_capacity = []
+
+    def register_gang_policy(self, policy: "TrainingGangPolicy"):
+        """Let update() drive an elastic training gang's size alongside
+        node scaling.  Returns the policy so callers can unregister it."""
+        self._gang_policies.append(policy)
+        return policy
+
+    def unregister_gang_policy(self, policy: "TrainingGangPolicy"):
+        if policy in self._gang_policies:
+            self._gang_policies.remove(policy)
 
     # ---- one reconciliation pass (reference: update :366 + the
     # resource_demand_scheduler bin-packing) ----
@@ -229,6 +277,19 @@ class StandardAutoscaler:
                 self.node_types[nt]["resources"]))
             launched[nt] = launched.get(nt, 0) + 1
         self._terminate_idle()
+        # 3) Offer whatever launch budget is left to registered training
+        #    gangs: gangs resize as a unit through their own controller
+        #    (the resize happens at the gang's next step boundary, not
+        #    here), so the only coupling is the spare-capacity signal.
+        if self._gang_policies:
+            spare = max(0, self.max_nodes
+                        - len(self.provider.non_terminated_nodes()))
+            for policy in list(self._gang_policies):
+                try:
+                    policy.apply(spare)
+                except Exception:
+                    # A dead/shutdown gang must not wedge the scaling loop.
+                    pass
         return launched
 
     def _pending_demands(self) -> List[tuple]:

@@ -41,17 +41,24 @@ from ray_tpu.parallel.mesh_group import (  # noqa: F401
     bootstrap_jax_distributed,
     driver_sync_count,
     gang_get,
+    is_transport_abort,
     rendezvous,
 )
 
 
 def __getattr__(name):
     # mpmd_pipeline spawns actors on import-site use; keep it lazy so
-    # `import ray_tpu.parallel` stays runtime-free.
+    # `import ray_tpu.parallel` stays runtime-free.  elastic.py pulls in
+    # jax/optax at import time — lazy for the same reason.
     if name in ("MPMDPipeline", "PipelineStage", "StageCore",
                 "mpmd_driver_sync_count", "stage_schedule",
                 "simulate_schedule"):
         from ray_tpu.parallel import mpmd_pipeline
 
         return getattr(mpmd_pipeline, name)
+    if name in ("ElasticMeshGroup", "LocalElastic", "build_elastic_step",
+                "reference_trajectory"):
+        from ray_tpu.parallel import elastic
+
+        return getattr(elastic, name)
     raise AttributeError(name)

@@ -122,6 +122,39 @@ _GANG_ERRORS = (exc.ActorDiedError, exc.ActorUnavailableError,
                 exc.WorkerCrashedError, exc.ObjectLostError,
                 exc.RpcTimeoutError)
 
+# The recurring CPU-gloo TCP race: a rank's connection pair aborts
+# mid-collective ("gloo::EnforceNotMet ... op.preamble.length",
+# "Connection reset by peer", ...).  The worker processes are alive and
+# the jax program is correct — the *transport* hiccuped — so this failure
+# class gets its own bounded in-place recovery (init retry + warm-up +
+# same-size rebuild budget) instead of consuming the caller's
+# gang-restart/FailureConfig budget.  Matching is textual because gloo
+# surfaces the abort as a plain RuntimeError inside the worker.
+_TRANSPORT_MARKERS = ("preamble", "connection reset", "connection closed",
+                      "connection refused", "enforcenotmet", "timed out",
+                      "socket")
+
+
+def _transport_text(s: str) -> bool:
+    s = s.lower()
+    if "gloo" not in s and "enforcenotmet" not in s:
+        return False
+    return any(m in s for m in _TRANSPORT_MARKERS)
+
+
+def is_transport_abort(err: Any) -> bool:
+    """True when ``err`` is (or wraps, rank-for-rank) the gloo TCP
+    transport abort rather than a real rank death.  A ``MeshGroupError``
+    counts only when EVERY failed rank classifies as transport — one
+    genuinely dead rank makes the whole gang failure a death."""
+    if getattr(err, "transport_abort", False):
+        return True
+    if isinstance(err, exc.MeshGroupError):
+        ranks = getattr(err, "failed_ranks", None) or {}
+        return bool(ranks) and all(is_transport_abort(e)
+                                   for e in ranks.values())
+    return _transport_text(str(err))
+
 # Driver-side sync counter: every blocking per-step driver↔worker round
 # trip on a dispatch path (the lockstep run*/health_check calls) bumps it.
 # The pipelined path must leave it untouched — tests assert the delta is
@@ -216,13 +249,63 @@ def bootstrap_jax_distributed(coordinator: str, world_size: int, rank: int,
     if world_size > 1:
         if (platform or "").startswith("cpu"):
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        jax.distributed.initialize(coordinator_address=coordinator,
-                                   num_processes=world_size,
-                                   process_id=rank)
+        # The gloo TCP rendezvous sporadically aborts while the pairs
+        # connect (root cause of the op.preamble.length failures seen
+        # mid-update): before any backend is touched the initialize is
+        # safely repeatable, so retry it in place instead of paying a
+        # full gang teardown.
+        retries = int(os.environ.get("RAY_TPU_GLOO_INIT_RETRIES", "2"))
+        for attempt in range(retries + 1):
+            try:
+                jax.distributed.initialize(coordinator_address=coordinator,
+                                           num_processes=world_size,
+                                           process_id=rank)
+                break
+            except Exception as e:  # noqa: BLE001 — classified below
+                if attempt >= retries or not _transport_text(str(e)):
+                    raise
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                time.sleep(0.2 * (attempt + 1))
+        if os.environ.get("RAY_TPU_GLOO_WARMUP", "1") != "0":
+            _collective_warmup()
     return {"rank": rank,
             "process_index": jax.process_index(),
             "local_devices": jax.local_device_count(),
             "global_devices": jax.device_count()}
+
+
+def _collective_warmup() -> None:
+    """Force every gloo pair to establish NOW, inside the rendezvous, by
+    running one tiny cross-process all-reduce.  Connection-time races
+    (the other half of the op.preamble.length root cause) then surface
+    here — where the supervisor's in-place rendezvous retry can respawn
+    the gang cheaply — instead of aborting the first real training step."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) <= jax.local_device_count():
+        return  # single-process world: nothing to connect
+    mesh = Mesh(np.asarray(devs), ("warmup",))
+    n = len(devs)
+    host = np.arange(n, dtype=np.float32)
+    x = jax.make_array_from_callback(
+        (n,), NamedSharding(mesh, P("warmup")),
+        lambda idx, _a=host: _a[idx])
+    out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+    expect = float(n * (n - 1) / 2)
+    got = float(jax.device_get(out))
+    if got != expect:
+        raise RuntimeError(
+            f"collective warm-up all-reduce returned {got}, "
+            f"expected {expect}: the gloo group is mis-wired")
 
 
 def _metrics_to_host(out):
@@ -387,13 +470,23 @@ def gang_get(futures: Sequence, timeout: Optional[float] = None,
                 results[rank] = ray_tpu.get(ref)
             except _GANG_ERRORS as e:
                 failed[rank] = e
-            except exc.RayTpuError:
-                raise  # user exception / task error: gang is not poisoned
+            except exc.RayTpuError as e:
+                # A gloo transport abort surfaces as a TaskError whose
+                # message names the race; it poisons the gang exactly like
+                # a rank death (peers are stuck in the collective), so it
+                # joins failed_ranks — tagged so supervisors can charge
+                # the transport budget instead of the restart budget.
+                if not _transport_text(str(e)):
+                    raise  # user exception: gang is not poisoned
+                failed[rank] = e
         remaining = still
         if failed:
             _abandon(remaining)
-            raise exc.MeshGroupError("mesh rank(s) died mid-run",
+            err = exc.MeshGroupError("mesh rank(s) died mid-run",
                                      failed_ranks=failed)
+            err.transport_abort = all(is_transport_abort(e)
+                                      for e in failed.values())
+            raise err
         if deadline is not None and remaining and time.monotonic() > deadline:
             late = {rank: exc.GetTimeoutError(
                 f"rank {rank} produced no result within {timeout}s")
@@ -743,7 +836,8 @@ class MeshGroup:
                  max_group_restarts: int = 0,
                  restart_backoff_s: float = 0.5,
                  restart_backoff_max_s: float = 30.0,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 transport_restart_budget: int = 2):
         self.num_hosts = num_hosts
         self.platform = platform
         self.local_device_count = local_device_count
@@ -753,6 +847,17 @@ class MeshGroup:
         self.restart_backoff_s = restart_backoff_s
         self.restart_backoff_max_s = restart_backoff_max_s
         self.restart_count = 0
+        # Transport aborts (the gloo TCP race — see is_transport_abort)
+        # rebuild under their own budget: they are environmental hiccups,
+        # not workload failures, and must not consume the caller's
+        # max_group_restarts headroom.
+        self.transport_restart_budget = transport_restart_budget
+        self.transport_restart_count = 0
+        # Monotonic incarnation counter: every respawn (restart OR
+        # resize) gets a fresh generation; equals restart_count when no
+        # transport restarts/resizes occur, so generation-pinned chaos
+        # schedules keep their meaning.
+        self._generation = 0
         # Default StepPipeline window; also sizes the actor pool so up to
         # depth+1 queued pipeline steps can park on the sequence gate with
         # ping still answered on a free slot.
@@ -795,13 +900,32 @@ class MeshGroup:
             self.pg.ready(timeout=self.bootstrap_timeout)
             opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                 self.pg)
-        self.workers = [
-            MeshWorker.options(**opts).remote(rank, self.num_hosts, generation)
-            for rank in range(self.num_hosts)
-        ]
-        self.device_info = rendezvous(self.workers, self.platform,
-                                      self.local_device_count,
-                                      timeout=self.bootstrap_timeout)
+        # The rendezvous now includes a collective warm-up, so the gloo
+        # connect race can surface right here — where a bounded in-place
+        # retry (fresh actors, same placement group) is cheap and
+        # invisible to the caller.
+        attempts = 3
+        for attempt in range(attempts):
+            self.workers = [
+                MeshWorker.options(**opts).remote(rank, self.num_hosts,
+                                                  generation)
+                for rank in range(self.num_hosts)
+            ]
+            try:
+                self.device_info = rendezvous(self.workers, self.platform,
+                                              self.local_device_count,
+                                              timeout=self.bootstrap_timeout)
+                return
+            except exc.MeshGroupError as e:
+                if attempt >= attempts - 1 or not is_transport_abort(e):
+                    raise
+                for w in self.workers:
+                    try:
+                        ray_tpu.kill(w)
+                    except Exception:
+                        pass
+                self.workers = []
+                time.sleep(0.2 * (attempt + 1))
 
     def _teardown_workers(self):
         for w in self.workers:
@@ -830,17 +954,26 @@ class MeshGroup:
             restarts_total, restart_failures = _restart_metrics()
         except Exception:
             pass  # metrics are best-effort (e.g. driver disconnecting)
-        if self.restart_count >= self.max_group_restarts:
-            cause.restarts = self.restart_count
-            raise cause
-        self.restart_count += 1
+        transport = is_transport_abort(cause)
+        if transport:
+            if self.transport_restart_count >= self.transport_restart_budget:
+                cause.restarts = self.restart_count
+                raise cause
+            self.transport_restart_count += 1
+        else:
+            if self.restart_count >= self.max_group_restarts:
+                cause.restarts = self.restart_count
+                raise cause
+            self.restart_count += 1
+        attempt = self.restart_count + self.transport_restart_count
         backoff = min(
-            self.restart_backoff_s * (2 ** (self.restart_count - 1)),
+            self.restart_backoff_s * (2 ** (attempt - 1)),
             self.restart_backoff_max_s)
         self._teardown_workers()
         time.sleep(backoff)
+        self._generation += 1
         try:
-            self._spawn(generation=self.restart_count)
+            self._spawn(generation=self._generation)
         except Exception as e:
             if restart_failures is not None:
                 try:
@@ -872,6 +1005,21 @@ class MeshGroup:
         swallowed — use for cross-cutting reactions (cancelling pending
         checkpoint commits, cache invalidation), not state rebuilds."""
         self._restart_hooks.append(hook)
+
+    def resize(self, num_hosts: int) -> None:
+        """Tear the gang down and rebuild it at ``num_hosts`` hosts.
+
+        A ``jax.distributed`` world is fixed-size, so elasticity means a
+        full rebuild: fresh worker processes, fresh placement group,
+        fresh rendezvous, next generation.  The caller owns state — this
+        carries nothing over (ElasticMeshGroup re-broadcasts its boundary
+        snapshot afterwards)."""
+        if num_hosts < 1:
+            raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+        self._teardown_workers()
+        self.num_hosts = int(num_hosts)
+        self._generation += 1
+        self._spawn(generation=self._generation)
 
     # ---- health ----
     def health_check(self, deadline: float = 10.0) -> List[int]:

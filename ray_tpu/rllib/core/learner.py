@@ -187,7 +187,7 @@ class DistributedLearnerGroup:
     instead of silently restarting from a re-initialized policy.
     """
 
-    def __init__(self, learner_factory, num_hosts: int = 1,
+    def __init__(self, learner_factory, num_hosts=1,
                  resources_per_host=None, platform=None,
                  local_device_count=None, max_group_restarts: int = 0,
                  pipeline_depth: int = 0, metrics_interval: int = 1,
@@ -195,6 +195,14 @@ class DistributedLearnerGroup:
                  checkpoint_keep: Optional[int] = None):
         from ray_tpu.parallel.mesh_group import MeshGroup
 
+        # Elastic range: num_hosts may be (min, max); the gang starts at
+        # max and resize() keeps it inside the range.
+        if isinstance(num_hosts, (tuple, list)):
+            self.min_hosts, self.max_hosts = int(num_hosts[0]), \
+                int(num_hosts[1])
+            num_hosts = self.max_hosts
+        else:
+            self.min_hosts = self.max_hosts = int(num_hosts)
         self._factory = learner_factory
         self._last_weights = None
         self._last_metrics: Optional[Dict[str, float]] = None
@@ -313,6 +321,28 @@ class DistributedLearnerGroup:
         results = self.group.run_stateful(_learner_update, batch_ref,
                                           on_restart=self._on_restart)
         return results[0]
+
+    def resize(self, num_hosts: int) -> None:
+        """Elastically rebuild the learner gang at ``num_hosts`` hosts
+        (clamped to the configured ``(min, max)`` range) at an update
+        boundary: capture the live rank-0 weights, rebuild the gang
+        (fresh processes + rendezvous), re-materialize the learner on
+        every rank and re-broadcast the weights as ONE put."""
+        import ray_tpu
+
+        n = max(self.min_hosts, min(self.max_hosts, int(num_hosts)))
+        if n == self.group.num_hosts:
+            return
+        if self._pipeline is not None:
+            raise RuntimeError(
+                "resize() needs the lockstep path (pipeline_depth=0): an "
+                "in-flight step window cannot straddle two world sizes")
+        self._last_weights = self.group.run_rank_stateful(
+            0, _learner_get_weights)
+        self.group.resize(n)
+        self.group.run_stateful(_build_learner, self._factory)
+        self.group.run_stateful(_learner_set_weights,
+                                ray_tpu.put(self._last_weights))
 
     # ---- pipelined update stream (pipeline_depth > 0) ----
     def _on_pipe_result(self, idx: int, res) -> None:

@@ -18,7 +18,7 @@ import ray_tpu
 from ray_tpu import exceptions as exc
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import ScalingConfig
-from ray_tpu.parallel.mesh_group import gang_get
+from ray_tpu.parallel.mesh_group import gang_get, is_transport_abort
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.util.placement_group import (
@@ -28,9 +28,15 @@ from ray_tpu.util.placement_group import (
 
 
 class TrainingWorkerError(Exception):
-    def __init__(self, cause, tb: str):
+    """``transport_abort`` marks the gloo TCP race (see
+    ``mesh_group.is_transport_abort``): the gang needs a rebuild but the
+    failure is environmental, so ``BaseTrainer.fit`` charges it against a
+    separate transport budget instead of ``FailureConfig.max_failures``."""
+
+    def __init__(self, cause, tb: str, transport_abort: bool = False):
         self.cause = cause
         self.tb = tb
+        self.transport_abort = transport_abort
         super().__init__(f"training worker failed:\n{tb}")
 
 
@@ -48,6 +54,8 @@ class BackendExecutor:
         self.backend_config = backend_config
         self.backend: Backend = backend_config.backend_cls()()
         self.scaling = scaling_config
+        # Actual gang size; start() resolves it inside the elastic range.
+        self.num_workers = scaling_config.max_workers
         self.worker_group: Optional[WorkerGroup] = None
         self.pg = None
         # Elastic-restart incarnation index (0 on the first attempt);
@@ -68,16 +76,40 @@ class BackendExecutor:
                                              self.backend_config, e)
         except Exception:
             pass
-        return TrainingWorkerError(e, traceback.format_exc())
+        return TrainingWorkerError(e, traceback.format_exc(),
+                                   transport_abort=is_transport_abort(e))
 
     def start(self):
+        """Reserve placement + spawn the gang.  With an elastic
+        ``num_workers=(min, max)`` range, probe sizes max→min and take
+        the largest the cluster can place NOW (never below min —
+        min's placement failure propagates)."""
         res = self.scaling.worker_resources()
-        if self.scaling.num_workers > 1:
-            bundles = [dict(res) for _ in range(self.scaling.num_workers)]
-            self.pg = _create_pg(
-                bundles, strategy=self.scaling.placement_strategy)
-            self.pg.ready(timeout=60)
-        self.worker_group = WorkerGroup(self.scaling.num_workers, res,
+        lo, hi = self.scaling.worker_range()
+        self.num_workers = lo
+        for n in range(hi, lo - 1, -1):
+            if n == 1:
+                self.num_workers = 1
+                break
+            bundles = [dict(res) for _ in range(n)]
+            pg = _create_pg(bundles,
+                            strategy=self.scaling.placement_strategy)
+            try:
+                # The floor size gets the full grace period; larger probe
+                # sizes fail fast so a tight cluster degrades quickly.
+                pg.ready(timeout=60 if n == lo else 10)
+            except Exception:
+                try:
+                    _remove_pg(pg)
+                except Exception:
+                    pass
+                if n == lo:
+                    raise
+                continue
+            self.pg = pg
+            self.num_workers = n
+            break
+        self.worker_group = WorkerGroup(self.num_workers, res,
                                         self.pg, generation=self.generation)
         if self.storage_path:
             try:
@@ -128,7 +160,9 @@ class BackendExecutor:
         if "error" in kinds:
             for r in results:
                 if r[0] == "error":
-                    raise TrainingWorkerError(r[1], r[2])
+                    raise TrainingWorkerError(
+                        r[1], r[2],
+                        transport_abort=is_transport_abort(r[1]))
         if kinds == {"done"}:
             return None
         if "timeout" in kinds:

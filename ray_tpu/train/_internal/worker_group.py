@@ -2,19 +2,25 @@
 
 Reference: python/ray/train/_internal/worker_group.py:92 (plain actors with
 execute/execute_async).  Here each worker is a TrainWorker actor
-(max_concurrency=2 so result polling overlaps the training thread), spawned
+(max_concurrency=2 so result polling overlaps the training loop), spawned
 under a placement group for gang scheduling — on TPU this is the unit that
 *hosts a mesh*: one worker per TPU host.
+
+The training loop runs on a ``flow.Stage(sink=True)`` (the dataflow
+substrate's terminal stage: one background worker consuming a single-item
+source by side effect) rather than a hand-rolled ``threading.Thread``;
+results still flow to the driver through the ``queue.Queue`` result
+mailbox — a mailbox, not a pipeline, so it stays.
 """
 from __future__ import annotations
 
 import queue
-import threading
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.air import session as air_session
 from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.parallel import flow
 
 
 @ray_tpu.remote
@@ -30,7 +36,7 @@ class TrainWorker:
         self.world_size = world_size
         self.generation = generation
         self._results: "queue.Queue" = queue.Queue()
-        self._thread: Optional[threading.Thread] = None
+        self._stage: Optional[flow.Stage] = None
         self._env: Dict[str, str] = {}
         # Gang generation: lets the chaos kill schedule target exactly one
         # incarnation, so an elastically-restarted gang survives.
@@ -38,7 +44,7 @@ class TrainWorker:
 
     def ping(self) -> int:
         """Liveness probe; answers on the spare concurrency slot even
-        while the training thread runs."""
+        while the training loop runs."""
         return self.rank
 
     def setup_env(self, env: Dict[str, str]):
@@ -63,7 +69,8 @@ class TrainWorker:
     def start_training(self, train_fn: Callable, config: dict,
                       checkpoint: Optional[Checkpoint],
                       dataset_shards: Optional[dict] = None) -> bool:
-        """Launch the user loop in a thread; results flow via next_result."""
+        """Launch the user loop on a sink stage; results flow via
+        next_result."""
 
         def report_fn(metrics, ckpt):
             from ray_tpu._private import chaos
@@ -74,7 +81,7 @@ class TrainWorker:
             chaos.maybe_die("train_report", self.rank)
             self._results.put(("report", metrics, ckpt))
 
-        def run():
+        def run(_item):
             import inspect
             import os
 
@@ -100,9 +107,13 @@ class TrainWorker:
             finally:
                 air_session.shutdown_session()
 
-        self._thread = threading.Thread(target=run, daemon=True,
-                                        name="train-loop")
-        self._thread.start()
+        if self._stage is not None:
+            self._stage.close()
+        # One-item source, sink=True: the stage's single worker runs the
+        # whole training loop as the side effect of consuming that item.
+        self._stage = flow.Stage(iter([None]), run, sink=True, workers=1,
+                                 depth=1, name="train-loop",
+                                 export_metrics=False)
         return True
 
     def next_result(self, timeout: float = 3600.0):

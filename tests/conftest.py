@@ -14,12 +14,26 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Share one persistent XLA compilation cache across the test process AND
+# every spawned worker process (gang workers inherit the environment).
+# Worker processes otherwise recompile identical programs from scratch on
+# every gang spawn/rebuild — on a 1-core machine that dominates suite
+# wall-clock.  Executables are keyed by HLO hash, so reuse is bitwise-safe.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/ray_tpu_test_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 # A site hook imports jax before conftest runs, so env vars alone are too
 # late — update the live config too (backend must not be initialized yet).
 if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

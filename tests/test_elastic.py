@@ -1,0 +1,290 @@
+"""Elastic data parallelism: bitwise world-invariance, N->M resharding,
+and the chaos gate (ray_tpu/parallel/elastic.py).
+
+The keystone property: the slot-deterministic step makes the parameter
+trajectory bitwise-identical for ANY world size dividing ``slots``, so a
+gang that loses a host mid-run (with or without notice) must finish
+bitwise-equal to an uninterrupted in-process run — not "close", EQUAL.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _make_problem(seed: int = 0):
+    """Tiny deterministic regression problem.  Returned as CLOSURES (not
+    module-level functions) so cloudpickle ships them by value to gang
+    workers, which cannot import the tests package."""
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, mb):
+        h = jnp.tanh(mb["x"] @ params["w1"] + params["b1"])
+        pred = (h @ params["w2"])[:, 0]
+        return jnp.mean((pred - mb["y"]) ** 2)
+
+    def params_factory():
+        rng = np.random.default_rng(seed)
+        return {
+            "w1": jnp.asarray(rng.normal(size=(3, 8)).astype(np.float32)),
+            "b1": jnp.zeros((8,), jnp.float32),
+            "w2": jnp.asarray(rng.normal(size=(8, 1)).astype(np.float32)),
+        }
+
+    def tx_factory():
+        return optax.adam(1e-2)
+
+    def batch_fn(step_idx):
+        # 4 slots x 2 examples x 3 features; content depends only on the
+        # step index, so replay after a gang rebuild sees identical data.
+        rng = np.random.default_rng(10_000 * (seed + 1) + step_idx)
+        x = rng.normal(size=(4, 2, 3)).astype(np.float32)
+        y = x.sum(axis=-1).astype(np.float32)
+        return {"x": x, "y": y}
+
+    return loss_fn, params_factory, tx_factory, batch_fn
+
+
+def _tree_bitwise_equal(a, b) -> bool:
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+# ---- in-process: the world-invariance contract ----
+@pytest.mark.parametrize("grad_clip", [None, 0.5])
+def test_trajectory_bitwise_world_invariant(grad_clip):
+    from ray_tpu.parallel.elastic import reference_trajectory
+
+    fns = _make_problem()
+    ref = reference_trajectory(*fns, steps=6, slots=4, world=1,
+                               grad_clip=grad_clip)
+    for world in (2, 4):
+        got = reference_trajectory(*fns, steps=6, slots=4, world=world,
+                                   grad_clip=grad_clip)
+        assert np.array_equal(ref["losses"], got["losses"]), \
+            f"world={world}: losses diverge"
+        assert _tree_bitwise_equal(ref["params"], got["params"]), \
+            f"world={world}: params not bitwise-equal"
+
+
+@pytest.mark.parametrize("start,plan", [
+    (4, {3: 2}),               # shrink 4 -> 2 mid-run
+    (2, {3: 4}),               # grow 2 -> 4 mid-run
+    (2, {1: 4, 3: 1, 5: 4}),   # grow-shrink-grow
+])
+def test_midrun_reshard_bitwise_parity(start, plan):
+    """N->M opt-state resharding at a step boundary must not perturb the
+    trajectory: resized runs end bitwise-equal to a never-resized one."""
+    from ray_tpu.parallel.elastic import reference_trajectory
+
+    fns = _make_problem()
+    ref = reference_trajectory(*fns, steps=6, slots=4, world=1)
+    got = reference_trajectory(*fns, steps=6, slots=4, world=start,
+                               resize_plan=plan)
+    assert np.array_equal(ref["losses"], got["losses"])
+    assert _tree_bitwise_equal(ref["params"], got["params"])
+
+
+# ---- transport-abort classification (satellite: gloo root-cause) ----
+def test_is_transport_abort_classification():
+    from ray_tpu import exceptions as exc
+    from ray_tpu.parallel.mesh_group import is_transport_abort
+
+    # The observed gloo TCP race signatures classify as transport.
+    assert is_transport_abort(RuntimeError(
+        "gloo: connection reset by peer"))
+    assert is_transport_abort(RuntimeError(
+        "EnforceNotMet: op.preamble.length <= op.nbytes"))
+    # User errors never classify — even when wrapped in a gang error.
+    assert not is_transport_abort(ValueError("bad shape (3,) vs (4,)"))
+    assert not is_transport_abort(RuntimeError("gloo backend selected"))
+    # A MeshGroupError is transport iff EVERY failed rank classifies.
+    all_transport = exc.MeshGroupError("gang", failed_ranks={
+        0: RuntimeError("gloo: connection reset by peer"),
+        1: RuntimeError("EnforceNotMet: timed out waiting")})
+    assert is_transport_abort(all_transport)
+    mixed = exc.MeshGroupError("gang", failed_ranks={
+        0: RuntimeError("gloo: connection reset by peer"),
+        1: ValueError("user bug")})
+    assert not is_transport_abort(mixed)
+    # Explicit tagging (TrainingWorkerError-style) wins outright.
+    tagged = RuntimeError("anything")
+    tagged.transport_abort = True
+    assert is_transport_abort(tagged)
+
+
+# ---- autoscaler gang policy (unit) ----
+def test_training_gang_policy():
+    from ray_tpu.autoscaler import TrainingGangPolicy
+
+    class FakeGang:
+        def __init__(self, hosts, pending):
+            self.hosts = hosts
+            self._pending = pending
+            self.requests = []
+
+        def pending_steps(self):
+            return self._pending
+
+        def request_resize(self, n):
+            self.requests.append(n)
+
+    # Backlog + spare capacity -> grow, capped at max_hosts.
+    gang = FakeGang(hosts=2, pending=5)
+    policy = TrainingGangPolicy(gang, min_hosts=1, max_hosts=4)
+    assert policy.apply(spare_hosts=8) == 4
+    assert gang.requests == [4]
+    # No backlog -> no grow, regardless of spare.
+    gang = FakeGang(hosts=2, pending=0)
+    policy = TrainingGangPolicy(gang, min_hosts=1, max_hosts=4)
+    assert policy.apply(spare_hosts=8) is None
+    assert gang.requests == []
+    # No spare -> no grow.
+    gang = FakeGang(hosts=2, pending=5)
+    policy = TrainingGangPolicy(gang, min_hosts=1, max_hosts=4)
+    assert policy.apply(spare_hosts=0) is None
+    # Never proposes below min_hosts.
+    gang = FakeGang(hosts=1, pending=0)
+    policy = TrainingGangPolicy(gang, min_hosts=2, max_hosts=4)
+    assert policy.apply(spare_hosts=0) == 2
+    assert gang.requests == [2]
+
+
+def test_autoscaler_drives_gang_policy(ray_start_regular):
+    """StandardAutoscaler.update() offers spare launch budget to
+    registered gangs and survives a policy that throws."""
+    from ray_tpu.autoscaler import StandardAutoscaler, TrainingGangPolicy
+
+    class FakeGang:
+        hosts = 1
+
+        def __init__(self):
+            self.requests = []
+
+        def pending_steps(self):
+            return 3
+
+        def request_resize(self, n):
+            self.requests.append(n)
+
+    class BrokenGang(FakeGang):
+        def request_resize(self, n):
+            raise RuntimeError("gang already shut down")
+
+    sc = StandardAutoscaler({"cpu": {"resources": {"CPU": 4.0}}},
+                            max_nodes=4)
+    try:
+        gang, broken = FakeGang(), BrokenGang()
+        sc.register_gang_policy(
+            TrainingGangPolicy(broken, min_hosts=1, max_hosts=4))
+        policy = sc.register_gang_policy(
+            TrainingGangPolicy(gang, min_hosts=1, max_hosts=4))
+        sc.update()
+        assert gang.requests and gang.requests[-1] > 1
+        sc.unregister_gang_policy(policy)
+        sc.update()
+        assert len(gang.requests) == 1  # unregistered: no new requests
+    finally:
+        sc.detach()
+
+
+# ---- the chaos gate: lease expiry on a REAL gang ----
+def test_elastic_gang_lease_expiry_chaos_gate(shutdown_only):
+    """2-host gang, rank 1 SIGKILLed with NO notice mid-run.  The gate:
+    the run finishes at the surviving size with steps_lost == 0 and the
+    final params BITWISE-equal an unkilled in-process run."""
+    from ray_tpu.parallel.elastic import (
+        ElasticMeshGroup, reference_trajectory)
+
+    loss_fn, params_factory, tx_factory, batch_fn = _make_problem()
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    # snapshot_interval=2 leaves the boundary snapshot one step behind
+    # when the kill lands, so recovery must REPLAY the missed step from
+    # batch_fn — exercising the deterministic-replay path, not just the
+    # restore path.
+    emg = ElasticMeshGroup(loss_fn, params_factory, tx_factory, batch_fn,
+                           num_hosts=(1, 2), platform="cpu",
+                           local_device_count=2, slots=4,
+                           snapshot_interval=2)
+    try:
+        losses = emg.run(3)
+        # Spot reclaim with zero warning: SIGKILL rank 1 at its next step.
+        emg.arm_lease_expiry(1, after_steps=1)
+        losses += emg.run(3)
+        stats = emg.stats()
+        params = emg.params_host()
+    finally:
+        emg.shutdown()
+    assert stats["hosts"] == 1, stats
+    assert stats["step"] == 6
+    assert stats["elastic_expiry_shrinks_total"] >= 1, stats
+    assert stats["elastic_steps_lost_total"] == 0, stats
+    assert stats["elastic_replayed_steps_total"] >= 1, stats
+    ref = reference_trajectory(loss_fn, params_factory, tx_factory,
+                               batch_fn, steps=6, slots=4, world=1)
+    assert np.array_equal(np.asarray(losses, dtype=np.float64),
+                          ref["losses"])
+    assert _tree_bitwise_equal(params, ref["params"]), \
+        "killed gang diverged from the unkilled reference"
+    # Counters surfaced through util/metrics on the driver's kv.
+    from ray_tpu.util.metrics import Counter
+
+    assert Counter("elastic_expiry_shrinks_total",
+                   "elastic gang lifecycle").value() >= 1
+
+
+# ---- nightly chaos matrix: 3 seeds x 3 failure modes ----
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("scenario",
+                         ["notice", "expiry", "shrink_during_grow"])
+def test_elastic_chaos_matrix(shutdown_only, seed, scenario):
+    from ray_tpu.parallel.elastic import (
+        ElasticMeshGroup, reference_trajectory)
+
+    fns = _make_problem(seed=seed)
+    loss_fn, params_factory, tx_factory, batch_fn = fns
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    start = 1 if scenario == "shrink_during_grow" else 2
+    emg = ElasticMeshGroup(loss_fn, params_factory, tx_factory, batch_fn,
+                           num_hosts=(1, 2), initial_hosts=start,
+                           platform="cpu", local_device_count=2, slots=4)
+    try:
+        losses = emg.run(2)
+        if scenario == "notice":
+            emg.preemption_notice(1, deadline_s=30.0)
+        elif scenario == "expiry":
+            emg.arm_lease_expiry(1, after_steps=1)
+        else:
+            # Grow is pending when a preemption notice lands: the notice
+            # must win the boundary and the grow must be dropped.
+            emg.request_resize(2)
+            losses += emg.run(2)
+            emg.request_resize(2)
+            emg.preemption_notice(1, deadline_s=30.0)
+        losses += emg.run(4 if scenario != "shrink_during_grow" else 2)
+        stats = emg.stats()
+        params = emg.params_host()
+    finally:
+        emg.shutdown()
+    assert stats["hosts"] == 1, stats
+    assert stats["step"] == 6
+    assert stats["elastic_steps_lost_total"] == 0, stats
+    if scenario == "notice":
+        assert stats["elastic_notice_shrinks_total"] >= 1, stats
+    elif scenario == "expiry":
+        assert stats["elastic_expiry_shrinks_total"] >= 1, stats
+    else:
+        assert stats["elastic_grows_total"] >= 1, stats
+        assert stats["elastic_notice_shrinks_total"] >= 1, stats
+    ref = reference_trajectory(loss_fn, params_factory, tx_factory,
+                               batch_fn, steps=6, slots=4, world=1)
+    assert np.array_equal(np.asarray(losses, dtype=np.float64),
+                          ref["losses"])
+    assert _tree_bitwise_equal(params, ref["params"])

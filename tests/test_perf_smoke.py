@@ -7,6 +7,7 @@ import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 from tools.perf_smoke import (
     run_3d_smoke,
     run_checkpoint_smoke,
+    run_elastic_smoke,
     run_flow_smoke,
     run_mpmd_smoke,
     run_node_loss_smoke,
@@ -207,4 +208,20 @@ def test_node_loss_smoke(shutdown_only):
     assert out["objects_reconstructed"] >= 1, f"no reconstruction: {out}"
     assert out["objects_lost"] == 0, out
     assert out["no_hang"], f"node-loss recovery hung: {out}"
+    assert out["ok"], out
+
+
+def test_elastic_smoke(shutdown_only):
+    """A scripted grow (spare capacity) + notice shrink (preemption)
+    must both land at step boundaries with zero steps lost, exactly one
+    versioned weight broadcast per gang incarnation, and a final state
+    BITWISE-equal to an uninterrupted single-host run — the tier-1 guard
+    for the elastic data-parallel plane."""
+    out = run_elastic_smoke()
+    assert out["grows"] == 1, out
+    assert out["notice_shrinks"] == 1, out
+    assert out["steps_lost"] == 0, out
+    assert out["weight_puts"] == out["version"], \
+        f"weight broadcast fan-out regressed: {out}"
+    assert out["bitwise_parity"], f"elastic resize perturbed the run: {out}"
     assert out["ok"], out

@@ -27,13 +27,10 @@ from typing import Dict, List
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Hand-rolled thread+queue pipelines that predate flow.py and have not
-# been migrated yet.  DO NOT add entries: new code uses the substrate.
-# When one of these is rebased on flow primitives, delete its line (the
-# check fails on stale entries to force that).
-ALLOWLIST = {
-    # train worker-group result plumbing
-    "ray_tpu/train/_internal/worker_group.py",
-}
+# been migrated yet.  EMPTY as of the train worker-group migration — and
+# it stays empty: any new threading.Thread+queue.Queue combo fails the
+# check outright; build it on flow.Stage/flow.RefStream instead.
+ALLOWLIST: set = set()
 
 # Runtime plumbing exempt from the operator-core rule: the transport /
 # store / head loops are message routers, not item pipelines, and

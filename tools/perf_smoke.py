@@ -506,6 +506,115 @@ def run_node_loss_smoke(steps: int = 8, kill_at: int = 3) -> dict:
         CONFIG.reset()
 
 
+# ---- elastic gang smoke (module-level fns: pickled by reference) ----
+def _elastic_loss_fn(params, mb):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(mb["x"] @ params["w1"] + params["b1"])
+    return jnp.mean(((h @ params["w2"])[:, 0] - mb["y"]) ** 2)
+
+
+def _elastic_params():
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    return {"w1": jnp.asarray(rng.normal(size=(3, 8)).astype(np.float32)),
+            "b1": jnp.zeros((8,), jnp.float32),
+            "w2": jnp.asarray(rng.normal(size=(8, 1)).astype(np.float32))}
+
+
+def _elastic_tx():
+    import optax
+
+    return optax.adam(1e-2)
+
+
+def _elastic_batch(step_idx):
+    import numpy as np
+
+    rng = np.random.default_rng(20_000 + step_idx)
+    x = rng.normal(size=(4, 2, 3)).astype(np.float32)
+    return {"x": x, "y": x.sum(axis=-1).astype(np.float32)}
+
+
+def run_elastic_smoke(steps_per_phase: int = 2) -> dict:
+    """Elastic-gang lifecycle invariants (tier-1 guard for the elastic
+    data-parallel plane, ray_tpu/parallel/elastic.py):
+
+    1. **Grow** 1 -> 2 hosts at a step boundary (scripted spare-capacity
+       offer), **notice shrink** 2 -> 1 on a preemption notice — both
+       land without losing a step.
+    2. **One versioned weight broadcast per incarnation**: weight_puts
+       == gang version after two resizes.
+    3. **Bitwise parity**: the grown-then-shrunk run's final params are
+       bit-identical to an uninterrupted in-process world-1 run — the
+       slot-deterministic step contract, end to end through real
+       actors.
+    """
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.parallel.elastic import (ElasticMeshGroup,
+                                          reference_trajectory)
+
+    t0 = _time.monotonic()
+    total = 3 * steps_per_phase
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    try:
+        emg = ElasticMeshGroup(_elastic_loss_fn, _elastic_params,
+                               _elastic_tx, _elastic_batch,
+                               num_hosts=(1, 2), initial_hosts=1,
+                               platform="cpu", local_device_count=2,
+                               slots=4)
+        try:
+            losses = emg.run(steps_per_phase)
+            emg.offer_capacity(1)           # autoscaler found a spare host
+            losses += emg.run(steps_per_phase)
+            emg.preemption_notice(rank=1)   # ... and is now reclaiming it
+            losses += emg.run(steps_per_phase)
+            stats = emg.stats()
+            params = emg.params_host()
+        finally:
+            emg.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    ref = reference_trajectory(_elastic_loss_fn, _elastic_params,
+                               _elastic_tx, _elastic_batch,
+                               steps=total, slots=4, world=1)
+    bitwise = (
+        sorted(params) == sorted(ref["params"])
+        and all(np.array_equal(np.asarray(params[k]),
+                               np.asarray(ref["params"][k]))
+                for k in params)
+        and np.array_equal(np.asarray(losses, dtype=np.float64),
+                           ref["losses"]))
+    elapsed = _time.monotonic() - t0
+    out = {
+        "steps": stats["step"],
+        "hosts_final": stats["hosts"],
+        "grows": stats["elastic_grows_total"],
+        "notice_shrinks": stats["elastic_notice_shrinks_total"],
+        "steps_lost": stats["elastic_steps_lost_total"],
+        "weight_puts": stats["elastic_weight_puts_total"],
+        "version": stats["version"],
+        "bitwise_parity": bool(bitwise),
+        "elapsed_s": round(elapsed, 3),
+    }
+    out["ok"] = bool(stats["step"] == total
+                     and stats["hosts"] == 1
+                     and stats["elastic_grows_total"] == 1
+                     and stats["elastic_notice_shrinks_total"] == 1
+                     and stats["elastic_steps_lost_total"] == 0
+                     and stats["elastic_weight_puts_total"]
+                     == stats["version"]
+                     and bitwise)
+    return out
+
+
 def _zero_step(state, step_i):
     """Worker-side ZeRO train step (built lazily on a 4-way virtual data
     mesh inside the MeshGroup worker): one compiled shard_map program per
@@ -1187,6 +1296,8 @@ def main() -> int:
     out["rpc_chaos"] = rpc
     nl = run_node_loss_smoke()
     out["node_loss"] = nl
+    el = run_elastic_smoke()
+    out["elastic"] = el
     sv = run_serving_smoke()
     out["serving"] = sv
     zr = run_zero_smoke()
@@ -1200,8 +1311,8 @@ def main() -> int:
     rl = run_rlhf_smoke()
     out["rlhf"] = rl
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
-                     and rpc["ok"] and nl["ok"] and sv["ok"] and zr["ok"]
-                     and mpmd["ok"] and fl["ok"] and td["ok"]
+                     and rpc["ok"] and nl["ok"] and el["ok"] and sv["ok"]
+                     and zr["ok"] and mpmd["ok"] and fl["ok"] and td["ok"]
                      and rl["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
