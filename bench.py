@@ -1434,6 +1434,108 @@ def bench_streaming_data() -> dict:
         ray_tpu.shutdown()
 
 
+def bench_locality(chains: int = 8, mb: int = 8) -> dict:
+    """Locality-aware scheduling vs pure utilization packing (ISSUE 17).
+
+    Two real node-agent subprocesses (distinct hosts and stores) join a
+    CPU-less head.  ``chains`` producer→consumer ref chains of ``mb``-MiB
+    arrays run twice: producers pinned alternately to host A / host B,
+    consumers unpinned.  With locality OFF the default policy packs
+    consumers by utilization, so about half of them land across the wire
+    from their argument and demand-pull it (``sched_locality_wire_bytes_
+    total`` counts every cross-host resolution handed out, locality on or
+    off).  With locality ON consumers follow their bytes and the demand
+    wire goes quiet.  Reports the wire-byte reduction and the consume
+    wall clock of both phases (the locality run must not be slower)."""
+    import contextlib
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.util.testing import start_node_agent, wait_for_condition
+
+    n = mb * 1024 * 1024 // 8
+
+    def phase(enabled: bool):
+        ray_tpu.init(num_cpus=0, object_store_memory=1024 * 1024**2,
+                     ignore_reinit_error=True,
+                     _system_config={"locality_scheduling": enabled})
+        agents = []
+        try:
+            head = ray_tpu._head
+            base = len(head.raylets)
+            agents.append(start_node_agent(
+                head, num_cpus=4, resources={"hostA": float(chains)},
+                store_capacity=1024 * 1024**2))
+            agents.append(start_node_agent(
+                head, num_cpus=4, resources={"hostB": float(chains)},
+                store_capacity=1024 * 1024**2))
+            wait_for_condition(lambda: len(head.raylets) >= base + 2,
+                               timeout=30)
+
+            @ray_tpu.remote
+            def produce(i):
+                return np.full(n, i, dtype=np.int64)
+
+            @ray_tpu.remote
+            def consume(arr):
+                return int(arr[0]) + int(arr[-1])
+
+            # Producers alternate hosts; every output seals remotely.
+            prefs = [produce.options(
+                resources={"hostA" if i % 2 == 0 else "hostB": 1.0}
+            ).remote(i) for i in range(chains)]
+            wait_for_condition(
+                lambda: all(
+                    (lambda e: e is not None and e.locations)(
+                        head.gcs.object_lookup(r.id)) for r in prefs),
+                timeout=120)
+
+            def wire():
+                return head.locality_stats()["counters"].get(
+                    "sched_locality_wire_bytes_total", 0.0)
+
+            w0 = wire()
+            t0 = time.perf_counter()
+            got = ray_tpu.get([consume.remote(r) for r in prefs],
+                              timeout=180)
+            dt = time.perf_counter() - t0
+            assert got == [2 * i for i in range(chains)]
+            stats = head.locality_stats()["counters"]
+            return {
+                "wire_bytes": wire() - w0,
+                "consume_s": dt,
+                "prefetch_started": stats.get(
+                    "sched_locality_prefetch_started_total", 0.0),
+                "hits": stats.get("sched_locality_hits_total", 0.0),
+            }
+        finally:
+            for a in agents:
+                with contextlib.suppress(Exception):
+                    a.kill()
+            for a in agents:
+                with contextlib.suppress(Exception):
+                    a.wait(timeout=10)
+            ray_tpu.shutdown()
+            CONFIG.reset()
+
+    off = phase(False)
+    on = phase(True)
+    return {
+        "locality_chains": chains,
+        "locality_arg_mb": mb,
+        "locality_off_wire_bytes": int(off["wire_bytes"]),
+        "locality_on_wire_bytes": int(on["wire_bytes"]),
+        "locality_wire_reduction_x": round(
+            off["wire_bytes"] / max(1.0, on["wire_bytes"]), 2),
+        "locality_off_consume_s": round(off["consume_s"], 3),
+        "locality_on_consume_s": round(on["consume_s"], 3),
+        "locality_on_hits": int(on["hits"]),
+        "locality_on_prefetch_started": int(on["prefetch_started"]),
+    }
+
+
 def main():
     out = bench_gpt2()
     out.update(bench_gpt2_pipeline())
@@ -1441,6 +1543,7 @@ def main():
     out.update(bench_serving())
     out.update(bench_rlhf())
     out.update(bench_streaming_data())
+    out.update(bench_locality())
     out.update(bench_ppo_real_env())
     out.update(bench_impala_breakout())
     out.update(bench_ppo_breakout())

@@ -272,4 +272,19 @@ CONFIG \
     .declare("quantized_collectives", str, "off",
              "Gradient-reduction wire format for the sharded train "
              "steps: 'off' (fp32 psum) | 'int8' (block-scaled int8, "
-             "~4x fewer bytes, loss-parity gated).")
+             "~4x fewer bytes, loss-parity gated).") \
+    .declare("locality_scheduling", bool, True,
+             "Arg-locality-aware placement: tasks with ObjectRef args "
+             "wait for their args to exist, then prefer nodes on the "
+             "host already holding the most arg bytes (reference: "
+             "locality_aware_lease_policy.h).  'off' restores pure "
+             "utilization packing (bench baseline / regression triage).") \
+    .declare("locality_min_bytes", int, 1024 * 1024,
+             "Resident arg bytes a host must hold before locality "
+             "outranks the hybrid utilization score (tiny args are not "
+             "worth unbalancing the cluster for).") \
+    .declare("locality_prefetch", bool, True,
+             "When a task is placed on a node whose host is missing "
+             "some of its args, start pulling them into that node's "
+             "store while the task is still queued (dispatch overlaps "
+             "the wire instead of serializing behind it).")

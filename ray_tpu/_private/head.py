@@ -17,8 +17,10 @@ Responsibilities (reference equivalents in parentheses):
 from __future__ import annotations
 
 import os
+import pickle
 import tempfile
 import threading
+import time
 import traceback
 from collections import defaultdict, deque
 from multiprocessing.connection import Listener
@@ -183,6 +185,22 @@ class Head:
         self.gcs_snapshot_path = os.path.join(self.session_dir,
                                               "gcs_snapshot.pkl")
         self.gcs.load_snapshot(self.gcs_snapshot_path)
+        # ---- arg-locality plane (place compute where the bytes live) ----
+        # Tasks with directory-tracked ObjectRef args park until the args
+        # exist somewhere, so placement sees real per-host byte counts;
+        # the default policy then prefers the holder host, and args still
+        # missing from the chosen host are prefetched into its store
+        # while the task is queued (initialized BEFORE snapshot restore —
+        # restored creation specs go through _schedule below).
+        self._locality_on: bool = CONFIG.locality_scheduling
+        self._locality_prefetch: bool = (self._locality_on
+                                         and CONFIG.locality_prefetch)
+        self._dep_parked: Dict[ObjectID, List[TaskSpec]] = defaultdict(list)
+        self._prefetch_inflight: set = set()          # {(oid, node_id)}
+        self._prefetch_recs: Dict[tuple, dict] = {}   # in-flight records
+        self._prefetch_log: deque = deque(maxlen=256)  # wall-stamp proof
+        self._prefetch_q = None                       # lazy worker queue
+        self._loc_counters: Dict[str, float] = {}     # sched_locality_*
         # Restored actors that had NO worker at snapshot time (creation
         # still queued) have nothing to re-adopt: reschedule their
         # creation now — it waits in the pending queue until capacity
@@ -493,7 +511,16 @@ class Head:
                 return
             self._dead_nodes.add(node_id)
             raylet = self.raylets.pop(node_id, None)
-            self.scheduler.remove_node(node_id)
+            # PGs demoted to PENDING by the node loss re-reserve through
+            # the pending queue once capacity returns (their surviving
+            # bundles' reservations were released by the scheduler).
+            for pg in self.scheduler.remove_node(node_id):
+                if pg not in self._pending_pgs:
+                    self._pending_pgs.append(pg)
+            # Prefetches targeting the dead node can never complete.
+            for key in [k for k in self._prefetch_inflight
+                        if k[1] == node_id]:
+                self._finish_prefetch(key, 0, False)
             self.gcs.remove_node(node_id)
             self.node_host.pop(node_id, None)
             self.node_xfer.pop(node_id, None)
@@ -770,12 +797,21 @@ class Head:
 
         oid = ObjectID(msg["oid"])
         with self._lock:
+            key = (oid, node_id)
+            was_prefetch = key in self._prefetch_inflight
             if node_id not in self.raylets:
+                if was_prefetch:
+                    self._finish_prefetch(key, msg["size"], False)
                 return  # replica landed after the node died: useless
             self.gcs.object_sealed(oid, node_id, msg["size"],
                                    meta=msg.get("meta"),
                                    segment=msg.get("segment"))
             note("objects_replicated")
+            if was_prefetch:
+                self._finish_prefetch(key, msg["size"], True)
+            # Same-host waiters (e.g. a queued task's worker about to
+            # resolve this arg) can now attach the replica segment.
+            self._notify_object(oid)
 
     def on_driver_disconnected(self, driver_wid: bytes):
         with self._lock:
@@ -981,6 +1017,7 @@ class Head:
                 if resolved is not None:
                     if resolved.get("kind") == "arena":
                         self._grant_arena_lease(oid, caller)
+                    self._note_pull_resolution(resolved)
                     out[oid.binary()] = resolved
         reply(out)
 
@@ -994,6 +1031,10 @@ class Head:
             if resolved is not None:
                 if resolved.get("kind") == "arena":
                     self._grant_arena_lease(oid, caller)
+                if not payload.get("recheck"):
+                    # A puller re-confirming its resolution already paid
+                    # the wire-bytes count at the original handout.
+                    self._note_pull_resolution(resolved)
                 reply(resolved)
                 return
             entry = self.gcs.object_lookup(oid)
@@ -1008,6 +1049,7 @@ class Head:
                 if resolved is not None:
                     if resolved.get("kind") == "arena":
                         self._grant_arena_lease(oid, caller)
+                    self._note_pull_resolution(resolved)
                     reply(resolved)
                     return
             cb_list = self._object_waiters[oid]
@@ -1025,6 +1067,7 @@ class Head:
                 record["done"] = True
                 if resolved_msg.get("kind") == "arena":
                     self._grant_arena_lease(oid, caller)
+                self._note_pull_resolution(resolved_msg)
                 reply(resolved_msg)
 
             cb_list.append(cb)
@@ -1414,8 +1457,11 @@ class Head:
             self._schedule(spec)
 
     def _schedule(self, spec: TaskSpec):
+        if self._park_if_unready(spec):
+            return
+        locality, arg_bytes = self._arg_locality(spec)
         try:
-            node_id = self.scheduler.pick_node(spec)
+            node_id = self.scheduler.pick_node(spec, locality=locality)
         except Infeasible as e:
             self._fail_task(spec, exc.PlacementGroupSchedulingError(str(e))
                             if spec.scheduling_strategy.kind == "PLACEMENT_GROUP"
@@ -1424,10 +1470,270 @@ class Head:
         if node_id is None:
             self.pending.append(spec)
             return
+        self._note_locality_placement(spec, node_id, arg_bytes)
         raylet = self.raylets[node_id]
         self.gcs.update_task_status(spec.task_id, TaskStatus.SCHEDULED,
                                     node_id=node_id)
         raylet.queue_task(spec)
+
+    # ---------- arg-locality plane ----------
+    @staticmethod
+    def _iter_arg_refs(spec: TaskSpec, direct_only: bool = False):
+        """Directory-tracked ObjectRef args of a task, deduplicated.
+        Owner-resident refs (arg.owner set) resolve worker→owner and are
+        invisible to the directory — skipped.  Contained refs (nested in
+        arg values, materialized lazily inside the task) count for
+        locality scoring but never gate dispatch (direct_only)."""
+        seen = set()
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            refs = [arg.ref] if arg.ref is not None and arg.owner is None \
+                else []
+            if not direct_only:
+                refs += list(arg.contained)
+            for oid in refs:
+                if oid not in seen:
+                    seen.add(oid)
+                    yield oid
+
+    def _park_if_unready(self, spec: TaskSpec) -> bool:
+        """Locality gate: hold a task whose directly-passed ref args don't
+        exist anywhere yet (no value, no holder, no spill record) until
+        they seal — placement then sees real byte locations instead of
+        racing the producer (reference: the raylet's dependency manager
+        dispatches tasks only once args are ready, dependency_manager.h).
+        Lost args trigger reconstruction; unrecoverable ones get a typed
+        error value so the task still dispatches and fails loudly.
+        Returns True when the task was parked (re-scheduled from
+        _notify_object when the first missing arg becomes available)."""
+        if not self._locality_on:
+            return False
+        for oid in self._iter_arg_refs(spec, direct_only=True):
+            entry = self.gcs.object_lookup(oid)
+            if entry is not None and entry.lost:
+                if not self._try_reconstruct(oid, entry):
+                    self._fail_object_locked(oid, exc.ObjectLostError(
+                        f"task arg {oid} was lost and cannot be "
+                        f"reconstructed"))
+                entry = self.gcs.object_lookup(oid)
+            if entry is not None and (entry.inline is not None
+                                      or entry.locations
+                                      or entry.spill is not None):
+                continue  # a value, a holder, or a restorable copy exists
+            self._dep_parked[oid].append(spec)
+            return True
+        return False
+
+    def _arg_locality(self, spec: TaskSpec):
+        """(locality, arg_bytes) for a task's ref args: ``locality`` maps
+        node -> resident arg bytes on that node's HOST (any node on the
+        holder's host reads via zero-copy segment attach, so the signal
+        is host-level); ``arg_bytes`` lists (oid, size, hosts, entry)
+        per sized directory arg, reused for hit/miss metrics and
+        prefetch targeting after placement."""
+        if not self._locality_on:
+            return None, []
+        arg_bytes = []
+        host_bytes: Dict[str, float] = {}
+        for oid in self._iter_arg_refs(spec):
+            entry = self.gcs.object_lookup(oid)
+            if entry is None or entry.inline is not None \
+                    or not entry.locations or not entry.size:
+                continue
+            hosts = {self.node_host.get(nid, self.host_key)
+                     for nid in entry.locations}
+            arg_bytes.append((oid, entry.size, hosts, entry))
+            for hk in hosts:
+                host_bytes[hk] = host_bytes.get(hk, 0.0) + entry.size
+        if not host_bytes:
+            return None, arg_bytes
+        locality = {nid: host_bytes[hk]
+                    for nid, hk in self.node_host.items()
+                    if host_bytes.get(hk)}
+        return (locality or None), arg_bytes
+
+    def _note_locality_placement(self, spec: TaskSpec, node_id: NodeID,
+                                 arg_bytes) -> None:
+        """Post-placement accounting + prefetch kick: count how many arg
+        bytes the chosen host already holds, and start pulling the rest
+        into the chosen node's store while the task is still queued."""
+        if not self._locality_on or not arg_bytes:
+            return
+        chosen_host = self.node_host.get(node_id, self.host_key)
+        local = remote = 0.0
+        missing = []
+        for oid, size, hosts, entry in arg_bytes:
+            if chosen_host in hosts:
+                local += size
+            else:
+                remote += size
+                missing.append((oid, size, entry))
+        self._loc_counter_add("sched_locality_tasks_total", 1)
+        self._loc_counter_add("sched_locality_hits_total"
+                              if not missing else
+                              "sched_locality_misses_total", 1)
+        if local:
+            self._loc_counter_add("sched_locality_local_arg_bytes_total",
+                                  local)
+            if self._has_remote:
+                # Bytes that stayed off the wire because placement
+                # followed them (only meaningful once a wire exists).
+                self._loc_counter_add(
+                    "sched_locality_transfer_bytes_avoided_total", local)
+        if remote:
+            self._loc_counter_add("sched_locality_remote_arg_bytes_total",
+                                  remote)
+        tot_l = self._loc_counters.get(
+            "sched_locality_local_arg_bytes_total", 0.0)
+        tot_r = self._loc_counters.get(
+            "sched_locality_remote_arg_bytes_total", 0.0)
+        if tot_l + tot_r > 0:
+            self._loc_gauge_set("sched_locality_local_bytes_fraction",
+                                tot_l / (tot_l + tot_r))
+        if missing and self._locality_prefetch:
+            for oid, size, entry in missing:
+                self._start_prefetch(spec, oid, size, entry, node_id,
+                                     chosen_host)
+
+    def _loc_counter_add(self, name: str, delta: float) -> None:
+        """Bump a sched_locality_* counter; write-through to the GCS KV
+        metrics namespace so /metrics (util.metrics.prometheus_text)
+        exports it.  In-process dict + pickle — cheap per placement."""
+        val = self._loc_counters.get(name, 0.0) + delta
+        self._loc_counters[name] = val
+        try:
+            self.gcs.kv_put((name + "|").encode(), pickle.dumps(val),
+                            namespace="metrics")
+        except Exception:
+            pass
+
+    def _loc_gauge_set(self, name: str, value: float) -> None:
+        self._loc_counters[name] = value
+        try:
+            self.gcs.kv_put((name + "|").encode(), pickle.dumps(value),
+                            namespace="metrics")
+        except Exception:
+            pass
+
+    def locality_stats(self) -> dict:
+        """Locality-plane counters + the recent prefetch wall-stamp log
+        (smoke/bench proof surface; counters mirror /metrics)."""
+        with self._lock:
+            return {"counters": dict(self._loc_counters),
+                    "prefetch": [dict(r) for r in self._prefetch_log]}
+
+    def _note_pull_resolution(self, resolved: Optional[dict]) -> None:
+        """A cross-host "pull" resolution handed to a real caller == that
+        many bytes about to cross the transfer plane on demand.  Counted
+        ONLY at the resolution-handout sites (req_resolve_batch /
+        req_get_locations) — _notify_object's availability probe also
+        calls _resolve_object and must not double-count."""
+        if resolved is not None and resolved.get("kind") == "pull":
+            self._loc_counter_add("sched_locality_wire_bytes_total",
+                                  resolved.get("size") or 0)
+            self._loc_counter_add("sched_locality_pull_resolutions_total", 1)
+
+    def _start_prefetch(self, spec: TaskSpec, oid: ObjectID, size: int,
+                        entry, node_id: NodeID, chosen_host: str) -> None:
+        """Pull a missing arg into the chosen node's store while its task
+        is still queued (worker spawn / dispatch overlaps the wire).
+        Rides the durability plane's store-to-store machinery: replica
+        segments are uniquely named, so a racing demand pull by the
+        worker can never collide.  Under the head lock."""
+        key = (oid, node_id)
+        if key in self._prefetch_inflight:
+            return
+        addrs = []
+        for nid in entry.locations:
+            if self.node_host.get(nid, self.host_key) == chosen_host:
+                return  # already resident on the target host
+            addr = self.node_xfer.get(nid)
+            if addr is not None:
+                addrs.append(tuple(addr))
+        raylet = self.raylets.get(node_id)
+        if not addrs or raylet is None:
+            return  # no pullable holder: the worker's demand path covers it
+        self._prefetch_inflight.add(key)
+        rec = {"oid": oid.hex(), "node": node_id.hex(),
+               "task": spec.task_id.hex(), "bytes": size,
+               "start": time.time(), "done": None, "ok": None}
+        self._prefetch_recs[key] = rec
+        self._prefetch_log.append(rec)
+        self._loc_counter_add("sched_locality_prefetch_started_total", 1)
+        if isinstance(raylet, RemoteRaylet):
+            # The agent pulls into its own store and acks with
+            # object_replicated (the durability wire protocol), which
+            # registers the location and completes the record.
+            raylet.send_agent({"type": "store_pull", "oid": oid.binary(),
+                               "addr": list(addrs[0]),
+                               "addrs": [list(a) for a in addrs],
+                               "size": size, "meta": entry.meta})
+        else:
+            if self._prefetch_q is None:
+                import queue as _queue
+
+                self._prefetch_q = _queue.Queue()
+                threading.Thread(target=self._prefetch_loop,
+                                 name="rtpu-prefetch", daemon=True).start()
+            self._prefetch_q.put((oid, node_id, addrs, size))
+
+    _PREFETCH_ATTEMPTS = 5  # seal→store_adopt race on the source agent
+
+    def _prefetch_loop(self):
+        """Head-side prefetch worker: store-to-store pulls into local
+        (in-head) raylet stores.  Failures are silent — the worker's
+        demand pull at materialization time is the correctness path."""
+        import time as _time
+
+        while not self._shutdown:
+            item = self._prefetch_q.get()
+            if item is None:
+                return
+            oid, node_id, addrs, size = item
+            meta = data = None
+            for attempt in range(self._PREFETCH_ATTEMPTS):
+                for addr in addrs:
+                    try:
+                        meta, data = self._repl_pull(addr, oid)
+                        break
+                    except Exception:
+                        meta = data = None
+                if data is not None or self._shutdown:
+                    break
+                _time.sleep(0.05 * (2 ** attempt))
+            ok = False
+            if data is not None:
+                with self._lock:
+                    raylet = self.raylets.get(node_id)
+                    entry = self.gcs.object_lookup(oid)
+                    if raylet is not None and entry is not None \
+                            and entry.inline is None and not entry.lost:
+                        try:
+                            seg = raylet.store.put_replica(oid, meta, data)
+                            self.gcs.object_sealed(oid, node_id, len(data),
+                                                   meta=meta, segment=seg)
+                            ok = True
+                        except Exception:
+                            traceback.print_exc()
+                    if ok:
+                        self._notify_object(oid)
+            self._finish_prefetch((oid, node_id),
+                                  len(data) if data is not None else size, ok)
+
+    def _finish_prefetch(self, key: tuple, nbytes: int, ok: bool) -> None:
+        with self._lock:
+            self._prefetch_inflight.discard(key)
+            rec = self._prefetch_recs.pop(key, None)
+            if rec is None:
+                return
+            rec["done"] = time.time()
+            rec["ok"] = bool(ok)
+            if ok:
+                self._loc_counter_add("sched_locality_prefetch_done_total", 1)
+                self._loc_counter_add("sched_locality_prefetch_bytes_total",
+                                      nbytes)
+                self._loc_counter_add(
+                    "sched_locality_prefetch_overlap_seconds_total",
+                    max(0.0, rec["done"] - rec["start"]))
 
     def submit_actor_task(self, spec: TaskSpec,
                           dead_worker: Optional[bytes] = None):
@@ -1615,6 +1921,16 @@ class Head:
 
     def cancel_task(self, task_id: TaskID):
         with self._lock:
+            # Parked on a not-yet-produced arg (locality gate).
+            for oid, lst in list(self._dep_parked.items()):
+                for spec in list(lst):
+                    if spec.task_id == task_id:
+                        lst.remove(spec)
+                        if not lst:
+                            self._dep_parked.pop(oid, None)
+                        self._fail_task(spec,
+                                        exc.RayTpuError("task cancelled"))
+                        return
             for q in [self.pending] + [r.queued for r in self.raylets.values()]:
                 for spec in list(q):
                     if spec.task_id == task_id:
@@ -1655,7 +1971,8 @@ class Head:
                 still.append(spec)
                 continue
             try:
-                node_id = self.scheduler.pick_node(spec)
+                locality, arg_bytes = self._arg_locality(spec)
+                node_id = self.scheduler.pick_node(spec, locality=locality)
             except Infeasible as e:
                 self._fail_task(spec, exc.RayTpuError(str(e)))
                 continue
@@ -1664,6 +1981,7 @@ class Head:
                 if key is not None:
                     blocked.add(key)
             else:
+                self._note_locality_placement(spec, node_id, arg_bytes)
                 self.gcs.update_task_status(spec.task_id, TaskStatus.SCHEDULED,
                                             node_id=node_id)
                 self.raylets[node_id].queue_task(spec)
@@ -2047,6 +2365,13 @@ class Head:
     def _notify_object(self, oid: ObjectID):
         if self._resolve_object(oid) is None:
             return
+        # Tasks parked on this arg (locality gate): schedule them now
+        # that the directory knows where the bytes live — remaining
+        # missing args just re-park on their own oid.
+        parked = self._dep_parked.pop(oid, None)
+        if parked:
+            for spec in parked:
+                self._schedule(spec)
         # Callbacks re-resolve per caller host (cross-host waiters need a
         # pull resolution, same-host waiters a segment attach).
         for cb in self._object_waiters.pop(oid, []):
@@ -2449,6 +2774,8 @@ class Head:
             self._shutdown = True
             if self._durability_q is not None:
                 self._durability_q.put(None)
+            if self._prefetch_q is not None:
+                self._prefetch_q.put(None)
             if self._repl_client is not None:
                 try:
                     self._repl_client.close()

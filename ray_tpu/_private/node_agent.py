@@ -204,13 +204,33 @@ class NodeAgent:
             traceback.print_exc()
 
     def _store_pull(self, msg: dict):
+        """Pull with holder failover and a short retry ladder: the named
+        source may not serve the object YET (its seal raced the async
+        store_adopt on that host) or may have died — try every holder
+        the head named, backing off between rounds.  Used by both the
+        durability plane and the scheduler's arg prefetch; a permanent
+        failure is silent (the reader's demand pull is the correctness
+        path)."""
         oid = ObjectID(msg["oid"])
+        addrs = [tuple(a) for a in (msg.get("addrs") or [msg["addr"]])]
         try:
             if self._xfer_client is None:
                 from ray_tpu._private.transfer import TransferClient
 
                 self._xfer_client = TransferClient(self.authkey)
-            meta, data = self._xfer_client.pull(tuple(msg["addr"]), oid)
+            meta = data = None
+            for attempt in range(5):
+                for addr in addrs:
+                    try:
+                        meta, data = self._xfer_client.pull(addr, oid)
+                        break
+                    except Exception:
+                        meta = data = None
+                if data is not None or self._shutdown.is_set():
+                    break
+                time.sleep(0.05 * (2 ** attempt))
+            if data is None:
+                return
             seg = self.store.put_replica(oid, meta, data)
             self.send({"type": "object_replicated", "oid": oid.binary(),
                        "size": len(data), "meta": meta, "segment": seg})

@@ -75,6 +75,23 @@ def untrack(shm: shared_memory.SharedMemory):
         pass
 
 
+def retrack(shm: shared_memory.SharedMemory):
+    """Undo untrack() before this process unlinks the segment itself.
+
+    unlink() unregisters the name with the tracker daemon; if untrack()
+    already did, the daemon logs a KeyError per segment.  Used on the
+    abort path of a worker's pull-into-store (the segment was created
+    here, untracked in anticipation of the store adopting it, and must
+    now be destroyed because the pull failed)."""
+    name = shm._name  # type: ignore[attr-defined]
+    if name in _untracked:
+        try:
+            resource_tracker.register(name, "shared_memory")
+        except Exception:
+            pass
+        _untracked.discard(name)
+
+
 def forget_untracked(shm: shared_memory.SharedMemory):
     """The segment is gone (unlinked): drop its bookkeeping entries so
     neither name set grows without bound in long-lived processes."""
@@ -366,6 +383,7 @@ def _pretouch(buf: memoryview, page: int = 4096):
 
 def _unlink_quiet(shm: shared_memory.SharedMemory):
     try:
+        retrack(shm)  # unlink() re-unregisters; a no-op for owned names
         shm.unlink()
     except Exception:
         pass
@@ -676,6 +694,11 @@ class SharedMemoryStore:
                     pass
                 else:
                     try:
+                        # Adopted segments were attach-registered and then
+                        # untracked; unlink()'s unregister must find the
+                        # name registered or the tracker daemon logs a
+                        # KeyError per deleted object.
+                        retrack(obj.shm)
                         obj.shm.unlink()
                     except Exception:
                         pass

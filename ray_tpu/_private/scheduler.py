@@ -91,20 +91,47 @@ class ClusterScheduler:
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         # Round-robin cursor for SPREAD scheduling.
         self._spread_cursor = 0
+        # Node shapes an attached autoscaler can launch (reference:
+        # infeasible tasks stay pending when the autoscaler's node types
+        # could satisfy them — resource_demand_scheduler feasibility).
+        # Set by StandardAutoscaler; empty means no autoscaler.  Instance
+        # state: two heads in one process must not share capacity.
+        self.external_capacity: list = []
+        # Arg-locality policy knobs (reference: the locality-aware lease
+        # policy, locality_aware_lease_policy.h): resident arg bytes
+        # outrank utilization once a host holds at least min_bytes.
+        from ray_tpu._private.config import CONFIG
+
+        self.locality_enabled: bool = CONFIG.locality_scheduling
+        self.locality_min_bytes: int = CONFIG.locality_min_bytes
 
     # ----- membership -----
     def add_node(self, node_id: NodeID, resources: Dict[str, float], labels=None):
         with self._lock:
             self.nodes[node_id] = NodeResources(node_id, resources, labels)
 
-    def remove_node(self, node_id: NodeID):
+    def remove_node(self, node_id: NodeID) -> List[PlacementGroupInfo]:
+        """Drop a node; demote placement groups that had a bundle there
+        back to PENDING, releasing the SURVIVING bundles' reservations so
+        the re-reservation pass doesn't double-allocate them.  Returns
+        the demoted groups (the head requeues them for re-reservation)."""
+        demoted: List[PlacementGroupInfo] = []
         with self._lock:
             self.nodes.pop(node_id, None)
             for pg in self.placement_groups.values():
+                if pg.state != "CREATED" or not any(
+                        b.node_id == node_id for b in pg.bundles):
+                    continue
                 for b in pg.bundles:
-                    if b.node_id == node_id:
-                        b.node_id = None
-                        pg.state = "PENDING"  # needs re-reservation
+                    if b.node_id is not None and b.node_id != node_id:
+                        n = self.nodes.get(b.node_id)
+                        if n is not None:
+                            n.release(b.resources)
+                    b.node_id = None
+                pg.bundle_available = []
+                pg.state = "PENDING"  # needs re-reservation
+                demoted.append(pg)
+        return demoted
 
     def available_resources(self) -> Dict[str, float]:
         with self._lock:
@@ -124,10 +151,19 @@ class ClusterScheduler:
 
     # ----- task placement -----
     def pick_node(self, spec: TaskSpec,
-                  preferred: Optional[NodeID] = None) -> Optional[NodeID]:
+                  preferred: Optional[NodeID] = None,
+                  locality: Optional[Dict[NodeID, float]] = None
+                  ) -> Optional[NodeID]:
         """Returns a node id and reserves the task's resources on it, or None
         if nothing fits right now.  Raises Infeasible if no node could ever
-        fit the demand."""
+        fit the demand.
+
+        ``locality`` maps node -> bytes of the task's ObjectRef args
+        already resident on that node's host; above ``locality_min_bytes``
+        it outranks utilization in the default policy (NODE_AFFINITY and
+        PLACEMENT_GROUP placements are explicit and stay untouched; a
+        soft affinity that falls back to the default policy keeps the
+        locality signal)."""
         st = spec.scheduling_strategy
         with self._lock:
             if st.kind == "PLACEMENT_GROUP":
@@ -136,21 +172,16 @@ class ClusterScheduler:
                 node = self.nodes.get(st.node_id)
                 if node is None:
                     if st.soft:
-                        return self._pick_default(spec, None)
+                        return self._pick_default(spec, None, locality)
                     raise Infeasible(f"node {st.node_id} not in cluster")
                 if node.fits(spec.resources):
                     node.allocate(spec.resources)
                     return node.node_id
-                return self._pick_default(spec, None) if st.soft else None
+                return self._pick_default(spec, None, locality) if st.soft \
+                    else None
             if st.kind == "SPREAD":
                 return self._pick_spread(spec)
-            return self._pick_default(spec, preferred)
-
-    # Node shapes an attached autoscaler can launch (reference: infeasible
-    # tasks stay pending when the autoscaler's node types could satisfy
-    # them — resource_demand_scheduler feasibility).  Set by
-    # StandardAutoscaler; empty means no autoscaler.
-    external_capacity: list = []
+            return self._pick_default(spec, preferred, locality)
 
     def _check_feasible(self, spec: TaskSpec):
         if any(n.feasible(spec.resources) for n in self.nodes.values()):
@@ -164,22 +195,32 @@ class ClusterScheduler:
             f"cluster totals {dict(self.total_resources())}"
         )
 
-    def _pick_default(self, spec: TaskSpec,
-                      preferred: Optional[NodeID]) -> Optional[NodeID]:
+    def _pick_default(self, spec: TaskSpec, preferred: Optional[NodeID],
+                      locality: Optional[Dict[NodeID, float]] = None
+                      ) -> Optional[NodeID]:
         """Hybrid policy: prefer the caller's node until it passes a
         utilization threshold, then pack by score (reference:
-        scheduling/policy/hybrid_scheduling_policy.h)."""
+        scheduling/policy/hybrid_scheduling_policy.h).  Resident arg
+        bytes dominate the score once a host holds locality_min_bytes
+        of them — below the threshold pure utilization packing wins, so
+        tiny args never unbalance the cluster."""
         self._check_feasible(spec)
         if preferred is not None:
             n = self.nodes.get(preferred)
             if n is not None and n.fits(spec.resources) and n.utilization() < 0.5:
                 n.allocate(spec.resources)
                 return n.node_id
+        if not (self.locality_enabled and locality):
+            locality = None
         best, best_score = None, None
         for n in self.nodes.values():
             if not n.fits(spec.resources):
                 continue
-            score = (n.utilization(), n.node_id.binary())  # pack: highest util first
+            loc = locality.get(n.node_id, 0.0) if locality else 0.0
+            if loc < self.locality_min_bytes:
+                loc = 0.0
+            # pack: most resident bytes, then highest utilization
+            score = (loc, n.utilization(), n.node_id.binary())
             if best is None or score > best_score:
                 best, best_score = n, score
         if best is not None:
@@ -280,7 +321,7 @@ class ClusterScheduler:
                        key=lambda n: -n.utilization())  # pack onto busy nodes first
         if strategy in ("STRICT_PACK",):
             for n in self.nodes.values():
-                if all(_fits_sum(n, [b.resources for b in pg.bundles])):
+                if _fits_sum(n, [b.resources for b in pg.bundles]):
                     for b in pg.bundles:
                         n.allocate(b.resources)
                         b.node_id = n.node_id
@@ -345,12 +386,14 @@ class ClusterScheduler:
             )
 
 
-def _fits_sum(node: NodeResources, demands: List[Dict[str, float]]):
+def _fits_sum(node: NodeResources, demands: List[Dict[str, float]]) -> bool:
+    """Whether the summed demand of all bundles fits the node right now."""
     total: Dict[str, float] = defaultdict(float)
     for d in demands:
         for k, v in d.items():
             total[k] += v
-    yield all(node.available.get(k, 0.0) + _EPS >= v for k, v in total.items())
+    return all(node.available.get(k, 0.0) + _EPS >= v
+               for k, v in total.items())
 
 
 class Infeasible(Exception):

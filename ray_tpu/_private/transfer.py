@@ -132,6 +132,11 @@ class ObjectTransferServer:
         self.port = self._listener.address[1]
         self.address: Tuple[str, int] = (routable_ip(), self.port)
         self._shutdown = False
+        # Transfer-plane traffic actually served by this store (locality
+        # smokes assert "quiet plane" on these, not just on directory
+        # accounting).  Plain ints under the GIL — per-object bumps.
+        self.served_objects = 0
+        self.served_bytes = 0
         self._thread = threading.Thread(target=self._accept_loop,
                                         name="rtpu-xfer-accept", daemon=True)
         self._thread.start()
@@ -171,6 +176,8 @@ class ObjectTransferServer:
                            "error": f"object {oid} not in this store"})
                 return
             meta, size, chunks = got
+            self.served_objects += 1
+            self.served_bytes += size
             conn.send({"ok": True, "meta": bytes(meta), "size": size})
             chunk = _chunk_size()
             depth = _pipeline_depth()

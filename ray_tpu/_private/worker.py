@@ -1443,6 +1443,22 @@ class CoreWorker:
         its node-death protocol and points at a replica, a spill restore,
         or a reconstruction — instead of erroring on the first sever.
         Reference: pull_manager.h:52 retrying against updated locations."""
+        if not msg.get("_rechecked"):
+            # Prefetch race: the scheduler may have landed these bytes in
+            # THIS host's store after the resolution was handed out — one
+            # control round trip can turn a wire pull into a segment
+            # attach (and refreshes stale holder addresses either way).
+            try:
+                fresh = self.transport.request(
+                    "get_locations", {"oid": oid, "recheck": True})
+            except Exception:
+                fresh = None
+            if fresh and fresh.get("kind") != "pull":
+                return self._materialize(oid, fresh,
+                                         pull_failovers=_failovers)
+            if fresh:
+                fresh["_rechecked"] = True
+                msg = fresh
         last_err: Optional[BaseException] = None
         for addr in (msg.get("addrs") or [msg["addr"]]):
             try:
@@ -1510,6 +1526,7 @@ class CoreWorker:
             # zero-copy pull path for this object on this host.
             if shm is not None:
                 try:
+                    store_mod.retrack(shm)  # unlink() re-unregisters
                     shm.unlink()
                     shm.close()
                 except Exception:

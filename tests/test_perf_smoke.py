@@ -9,6 +9,7 @@ from tools.perf_smoke import (
     run_checkpoint_smoke,
     run_elastic_smoke,
     run_flow_smoke,
+    run_locality_smoke,
     run_mpmd_smoke,
     run_node_loss_smoke,
     run_object_plane_smoke,
@@ -208,6 +209,26 @@ def test_node_loss_smoke(shutdown_only):
     assert out["objects_reconstructed"] >= 1, f"no reconstruction: {out}"
     assert out["objects_lost"] == 0, out
     assert out["no_hang"], f"node-loss recovery hung: {out}"
+    assert out["ok"], out
+
+
+def test_locality_smoke(shutdown_only):
+    """Locality-aware scheduling must place a DEFAULT-strategy consumer
+    on its producer's host and read the arg with zero demand wire bytes
+    (zero-copy segment attach), and a forced-remote consumer must find
+    its arg prefetched into the target host's store WHILE the task was
+    still queued (wall-stamp overlap, wire counter flat) — the tier-1
+    guard for ISSUE 17's place-compute-where-the-bytes-live plane."""
+    out = run_locality_smoke()
+    assert out["local_on_producer_host"], f"compute left the bytes: {out}"
+    assert out["local_wire_bytes"] == 0, f"local read hit the wire: {out}"
+    assert out["local_hit_counted"], out
+    assert out["remote_on_b"], out
+    assert out["remote_wire_bytes"] == 0, f"prefetch missed demand: {out}"
+    assert out["prefetch_completed"], out
+    assert out["prefetch_overlapped_queue"], \
+        f"prefetch did not overlap the queue: {out}"
+    assert out["values_ok"], out
     assert out["ok"], out
 
 

@@ -154,6 +154,138 @@ def test_spread_strategy(ray_start_cluster):
     assert len(nodes) == 2
 
 
+# ---------------------------------------------------------------------------
+# ClusterScheduler policy unit tests (no cluster: direct ledger checks)
+# ---------------------------------------------------------------------------
+def _sched():
+    from ray_tpu._private.scheduler import ClusterScheduler
+
+    return ClusterScheduler()
+
+
+def _node_id():
+    from ray_tpu._private.ids import NodeID
+
+    return NodeID.from_random()
+
+
+def _spec(resources=None, strategy=None):
+    from ray_tpu._private.ids import JobID, TaskID
+    from ray_tpu._private.task_spec import (SchedulingStrategy, TaskSpec,
+                                            TaskType)
+
+    return TaskSpec(
+        task_id=TaskID.from_random(), job_id=JobID.from_random(),
+        task_type=TaskType.NORMAL, name="t",
+        resources=resources or {"CPU": 1},
+        scheduling_strategy=strategy or SchedulingStrategy())
+
+
+def test_locality_outranks_utilization_above_threshold():
+    """A host holding >= locality_min_bytes of a task's args must win
+    placement even when utilization packing prefers the other node."""
+    s = _sched()
+    busy, holder = _node_id(), _node_id()
+    s.add_node(busy, {"CPU": 4})
+    s.add_node(holder, {"CPU": 4})
+    s.nodes[busy].allocate({"CPU": 2})  # packing would pick `busy`
+    assert s.pick_node(_spec()) == busy  # no locality: utilization wins
+    s.return_resources(busy, _spec())
+    got = s.pick_node(_spec(), locality={holder: s.locality_min_bytes})
+    assert got == holder
+
+
+def test_tiny_args_never_unbalance_packing():
+    """Below locality_min_bytes the resident-bytes signal is ignored —
+    utilization packing decides, so small args can't spread the load."""
+    s = _sched()
+    busy, holder = _node_id(), _node_id()
+    s.add_node(busy, {"CPU": 4})
+    s.add_node(holder, {"CPU": 4})
+    s.nodes[busy].allocate({"CPU": 2})
+    got = s.pick_node(_spec(), locality={holder: s.locality_min_bytes - 1})
+    assert got == busy
+
+
+def test_locality_off_restores_pure_packing():
+    s = _sched()
+    s.locality_enabled = False
+    busy, holder = _node_id(), _node_id()
+    s.add_node(busy, {"CPU": 4})
+    s.add_node(holder, {"CPU": 4})
+    s.nodes[busy].allocate({"CPU": 2})
+    got = s.pick_node(_spec(), locality={holder: 1 << 30})
+    assert got == busy
+
+
+def test_soft_node_affinity_honors_locality():
+    """A soft affinity to a dead node falls back to the default policy —
+    WITH the locality signal, not blind packing."""
+    from ray_tpu._private.task_spec import SchedulingStrategy
+
+    s = _sched()
+    gone, busy, holder = _node_id(), _node_id(), _node_id()
+    s.add_node(busy, {"CPU": 4})
+    s.add_node(holder, {"CPU": 4})
+    s.nodes[busy].allocate({"CPU": 2})
+    spec = _spec(strategy=SchedulingStrategy(
+        kind="NODE_AFFINITY", node_id=gone, soft=True))
+    got = s.pick_node(spec, locality={holder: 2 * s.locality_min_bytes})
+    assert got == holder
+
+
+def test_spread_cursor_deterministic():
+    """SPREAD walks nodes round-robin in stable (node-id) order."""
+    from ray_tpu._private.task_spec import SchedulingStrategy
+
+    s = _sched()
+    nodes = sorted([_node_id() for _ in range(3)],
+                   key=lambda n: n.binary())
+    for n in nodes:
+        s.add_node(n, {"CPU": 2})
+    got = [s.pick_node(_spec(strategy=SchedulingStrategy(kind="SPREAD")))
+           for _ in range(6)]
+    assert got == nodes * 2
+
+
+def test_remove_node_releases_surviving_pg_bundles():
+    """Demoting a PG on node loss must release the SURVIVING bundles'
+    reservations: re-reserving the demoted group from the head's pending
+    queue must not double-allocate (the leak left the cluster looking
+    fuller than it was, permanently)."""
+    from ray_tpu._private.ids import PlacementGroupID
+    from ray_tpu._private.scheduler import PlacementGroupInfo
+
+    s = _sched()
+    a, b = _node_id(), _node_id()
+    s.add_node(a, {"CPU": 2})
+    s.add_node(b, {"CPU": 2})
+    pg = PlacementGroupInfo(PlacementGroupID.from_random(),
+                            [{"CPU": 2}, {"CPU": 2}], "STRICT_SPREAD")
+    assert s.create_placement_group(pg)
+    assert s.available_resources().get("CPU", 0) == 0
+    demoted = s.remove_node(b)
+    assert demoted == [pg] and pg.state == "PENDING"
+    assert all(bd.node_id is None for bd in pg.bundles)
+    # The survivor's reservation came back — nothing leaked.
+    assert s.available_resources()["CPU"] == 2
+    # A replacement node arrives: the demoted group re-reserves cleanly.
+    c = _node_id()
+    s.add_node(c, {"CPU": 2})
+    assert s.create_placement_group(pg)
+    assert s.available_resources().get("CPU", 0) == 0
+    s.remove_placement_group(pg.pg_id)
+    assert s.available_resources()["CPU"] == 4
+
+
+def test_external_capacity_is_instance_state():
+    """Two schedulers in one process must not share autoscaler capacity
+    (the old class attribute leaked one head's shapes into another)."""
+    s1, s2 = _sched(), _sched()
+    s1.external_capacity.append({"CPU": 64})
+    assert s2.external_capacity == []
+
+
 def test_two_tpu_actors_same_node(shutdown_only):
     """A second TPU actor on a node must get its own TPU-visible worker
     instead of queueing forever behind an actor-pinned one (ADVICE r1)."""

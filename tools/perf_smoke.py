@@ -1283,6 +1283,141 @@ def run_flow_smoke(blocks: int = 6, window: int = 2,
         ray_tpu.shutdown()
 
 
+def run_locality_smoke(mb: int = 8) -> dict:
+    """Locality-aware scheduling invariants (tier-1 guard for ISSUE 17):
+
+    Two real node-agent subprocesses (distinct hosts/stores) join the
+    head; a producer pinned to host A seals an ``mb``-MiB array there.
+
+    1. **Local case — compute follows the bytes**: a DEFAULT-strategy
+       consumer of that ref must land on host A (the arg-locality score
+       outranks utilization packing) and read its arg with ZERO demand
+       wire bytes (``sched_locality_wire_bytes_total`` stays flat) —
+       same-host zero-copy segment attach, no transfer-plane pull.
+    2. **Remote case — prefetch overlaps the queue**: a consumer pinned
+       hard to host B forces a miss; the head must start a store-to-store
+       prefetch of the arg into B WHILE the task is still queued (the
+       prefetch record's wall-clock ``start`` precedes the task body's
+       first statement), complete it, and the worker must again find the
+       bytes already local (wire counter still flat).
+    """
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+    from ray_tpu.util.testing import start_node_agent, wait_for_condition
+
+    n = mb * 1024 * 1024 // 8
+    # Headless head (0 CPUs): every task must run on a real agent.
+    ray_tpu.init(num_cpus=0, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    agents = []
+    try:
+        head = ray_tpu._head
+        base = len(head.raylets)
+        agents.append(start_node_agent(head, num_cpus=2,
+                                       resources={"hostA": 1.0}))
+        agents.append(start_node_agent(head, num_cpus=2,
+                                       resources={"hostB": 1.0}))
+        wait_for_condition(lambda: len(head.raylets) >= base + 2,
+                           timeout=30)
+        with head._lock:
+            node_a = next(nid for nid, st in head.scheduler.nodes.items()
+                          if "hostA" in st.total)
+            node_b = next(nid for nid, st in head.scheduler.nodes.items()
+                          if "hostB" in st.total)
+
+        def counters():
+            c = head.locality_stats()["counters"]
+            return (c.get("sched_locality_wire_bytes_total", 0.0),
+                    c.get("sched_locality_hits_total", 0.0),
+                    c.get("sched_locality_prefetch_done_total", 0.0))
+
+        @ray_tpu.remote(resources={"hostA": 0.01})
+        def produce():
+            return np.arange(n, dtype=np.int64)
+
+        @ray_tpu.remote
+        def consume(arr):
+            t0 = _time.time()  # first statement: queue/overlap boundary
+            import ray_tpu as rt
+
+            return {"t0": t0, "sum": int(arr[:64].sum()),
+                    "node": rt.get_runtime_context().get_node_id()}
+
+        ref = produce.remote()
+        # Wait for the seal through the directory — a driver-side get()
+        # would copy the bytes onto the head host and blur the signal.
+        wait_for_condition(
+            lambda: (lambda e: e is not None and e.locations)(
+                head.gcs.object_lookup(ref.id)), timeout=30)
+
+        # --- local case ---
+        w0, h0, _ = counters()
+        got = ray_tpu.get(consume.remote(ref), timeout=60)
+        w1, h1, _ = counters()
+        with head._lock:
+            host_of = dict(head.node_host)
+        local_on_a = host_of.get(
+            ray_tpu.NodeID.from_hex(got["node"])) == host_of.get(node_a)
+        local_wire = w1 - w0
+        local_hit = h1 - h0
+
+        # --- remote case ---
+        w2 = counters()[0]
+        aff = NodeAffinitySchedulingStrategy(node_b, soft=False)
+        got_b = ray_tpu.get(
+            consume.options(scheduling_strategy=aff).remote(ref),
+            timeout=60)
+        # The agent acks the prefetch asynchronously; let it land before
+        # reading the record (the task itself already proved the bytes).
+        wait_for_condition(
+            lambda: any(r["oid"] == ref.id.hex() and r["ok"]
+                        for r in head.locality_stats()["prefetch"]),
+            timeout=15)
+        w3 = counters()[0]
+        recs = [r for r in head.locality_stats()["prefetch"]
+                if r["oid"] == ref.id.hex() and r["node"] == node_b.hex()]
+        rec = recs[-1] if recs else None
+        out = {
+            "arg_mb": mb,
+            "local_on_producer_host": bool(local_on_a),
+            "local_wire_bytes": local_wire,
+            "local_hit_counted": local_hit == 1,
+            "remote_on_b": host_of.get(ray_tpu.NodeID.from_hex(
+                got_b["node"])) == host_of.get(node_b),
+            "remote_wire_bytes": w3 - w2,
+            "prefetch_completed": bool(rec and rec["ok"]
+                                       and rec["done"] is not None),
+            "prefetch_overlapped_queue": bool(
+                rec and rec["start"] < got_b["t0"]),
+            "values_ok": got["sum"] == got_b["sum"] == 2016,
+        }
+        out["ok"] = bool(out["local_on_producer_host"]
+                         and out["local_wire_bytes"] == 0
+                         and out["local_hit_counted"]
+                         and out["remote_on_b"]
+                         and out["remote_wire_bytes"] == 0
+                         and out["prefetch_completed"]
+                         and out["prefetch_overlapped_queue"]
+                         and out["values_ok"])
+        return out
+    finally:
+        import contextlib
+
+        for a in agents:
+            with contextlib.suppress(Exception):
+                a.kill()
+        for a in agents:
+            with contextlib.suppress(Exception):
+                a.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
@@ -1310,10 +1445,12 @@ def main() -> int:
     out["threed"] = td
     rl = run_rlhf_smoke()
     out["rlhf"] = rl
+    loc = run_locality_smoke()
+    out["locality"] = loc
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
                      and rpc["ok"] and nl["ok"] and el["ok"] and sv["ok"]
                      and zr["ok"] and mpmd["ok"] and fl["ok"] and td["ok"]
-                     and rl["ok"])
+                     and rl["ok"] and loc["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
