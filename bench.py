@@ -1536,6 +1536,149 @@ def bench_locality(chains: int = 8, mb: int = 8) -> dict:
     }
 
 
+def bench_replay(frag_len: int = 256, dim: int = 32, frags: int = 32,
+                 batch_size: int = 512, batches: int = 24,
+                 naive_batches: int = 8, sgd_s: float = 0.01) -> dict:
+    """Distributed replay plane vs a naive per-transition store (ISSUE 18).
+
+    The plane inserts fixed-shape fragments as coalesced ``put_many``
+    column refs and resolves each sampled batch with ONE batched
+    ``get_many``.  The naive baseline is the classic per-row
+    replay-on-an-object-store shape: a rollout worker owns every
+    transition as its own object and the learner assembles a batch with
+    ``batch_size`` individual gets, each paying a resolve round trip
+    (fresh rows per batch — in steady state a draw from a large buffer
+    almost never re-hits a row the learner already resolved).  Reports
+    insert rows/s and sample rows/s for both, the speedup (acceptance:
+    >= 3x), insert wire overhead (ref metadata vs full payload per
+    learner-bound RPC), and the learner idle fraction with/without the
+    flow prefetcher overlapping gather with a fixed ``sgd_s`` SGD
+    window."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.rllib.execution.replay_plane import ReplayPlane
+
+    ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024**2,
+                 ignore_reinit_error=True)
+    try:
+        rng = np.random.default_rng(0)
+
+        def frag():
+            return {
+                "obs": rng.standard_normal((frag_len, dim))
+                .astype(np.float32),
+                "actions": rng.integers(0, 4, frag_len).astype(np.int64),
+                "rewards": rng.standard_normal(frag_len)
+                .astype(np.float32),
+                "next_obs": rng.standard_normal((frag_len, dim))
+                .astype(np.float32),
+                "dones": np.zeros(frag_len, np.float32),
+            }
+
+        plane = ReplayPlane(capacity=frags * frag_len, num_shards=4,
+                            alpha=0.0, seed=0)
+        payload = frag()
+        frag_bytes = sum(v.nbytes for v in payload.values())
+
+        # Warm the shard actors (process spawn + import cost lands on
+        # the first ack of each shard, not on steady-state inserts).
+        for _ in range(frags):
+            plane.insert(frag())
+        assert plane.size == frags * frag_len  # barrier: acks harvested
+
+        t0 = time.perf_counter()
+        for _ in range(frags):      # ring full: every insert now evicts
+            plane.insert(frag())
+        n_rows = plane.size          # barrier: all insert acks harvested
+        plane_insert_s = time.perf_counter() - t0
+        assert n_rows == frags * frag_len
+
+        for _ in range(2):
+            plane.sample(batch_size)            # warm the sample path
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            b = plane.sample(batch_size)
+            assert b["obs"].shape == (batch_size, dim)
+        plane_sample_s = time.perf_counter() - t0
+
+        # Learner idle fraction: fraction of loop wall clock spent
+        # waiting on the gather, with and without the prefetcher.
+        def idle_frac(next_batch):
+            wait = 0.0
+            t_loop = time.perf_counter()
+            for _ in range(batches):
+                t0 = time.perf_counter()
+                next_batch()
+                wait += time.perf_counter() - t0
+                time.sleep(sgd_s)              # the "SGD" window
+            return wait / (time.perf_counter() - t_loop)
+
+        idle_sync = idle_frac(lambda: plane.sample(batch_size))
+        stage = plane.prefetch(batch_size, depth=2)
+        next(stage)                            # prime: batch 0 in flight
+        idle_prefetch = idle_frac(lambda: next(stage))
+        stage.close()
+        plane.close()
+
+        # --- naive per-transition baseline ---------------------------
+        # A rollout worker owns one object per transition; the learner
+        # pays one resolve round trip per row it draws.
+        @ray_tpu.remote
+        class NaiveReplayWorker:
+            def __init__(self, dim):
+                self.dim = dim
+                self.rng = np.random.default_rng(1)
+
+            def put_rows(self, n):
+                return [ray_tpu.put({
+                    "obs": self.rng.standard_normal(self.dim)
+                    .astype(np.float32),
+                    "actions": np.int64(i % 4),
+                    "rewards": np.float32(0.0),
+                    "next_obs": self.rng.standard_normal(self.dim)
+                    .astype(np.float32),
+                    "dones": np.float32(0.0),
+                }) for i in range(n)]
+
+        naive_rows = naive_batches * batch_size
+        worker = NaiveReplayWorker.remote(dim)
+        ray_tpu.get(worker.put_rows.remote(1))     # warm the actor
+        t0 = time.perf_counter()
+        chunks = [ray_tpu.get(worker.put_rows.remote(batch_size))
+                  for _ in range(naive_batches)]
+        naive_insert_s = time.perf_counter() - t0
+        row_bytes = 2 * dim * 4 + 8 + 4 + 4
+
+        t0 = time.perf_counter()
+        for batch_refs in chunks:
+            got = [ray_tpu.get(r) for r in batch_refs]
+            _ = np.stack([g["obs"] for g in got])
+        naive_sample_s = time.perf_counter() - t0
+
+        plane_rows_s = batches * batch_size / plane_sample_s
+        naive_rows_s = naive_batches * batch_size / naive_sample_s
+        return {
+            "replay_insert_rows_s": round(
+                frags * frag_len / plane_insert_s),
+            "replay_naive_insert_rows_s": round(
+                naive_rows / naive_insert_s),
+            "replay_sample_rows_s": round(plane_rows_s),
+            "replay_naive_sample_rows_s": round(naive_rows_s),
+            "replay_sample_speedup_x": round(
+                plane_rows_s / max(1.0, naive_rows_s), 2),
+            # Learner-bound RPC wire: the plane ships column refs (~64B
+            # of metadata each), the naive path ships the payload.
+            "replay_insert_rpc_bytes": 5 * 64,
+            "replay_naive_insert_rpc_bytes": frag_bytes,
+            "replay_row_bytes": row_bytes,
+            "replay_idle_frac_sync": round(idle_sync, 3),
+            "replay_idle_frac_prefetch": round(idle_prefetch, 3),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 def main():
     out = bench_gpt2()
     out.update(bench_gpt2_pipeline())
@@ -1544,6 +1687,7 @@ def main():
     out.update(bench_rlhf())
     out.update(bench_streaming_data())
     out.update(bench_locality())
+    out.update(bench_replay())
     out.update(bench_ppo_real_env())
     out.update(bench_impala_breakout())
     out.update(bench_ppo_breakout())

@@ -61,6 +61,9 @@ class Algorithm:
         stream = getattr(self, "_stream", None)
         if stream is not None:
             stream.close()
+        rb = getattr(self, "_rb", None)
+        if rb is not None and hasattr(rb, "close"):
+            rb.close()
         workers = getattr(self, "workers", None)
         if workers is not None:
             workers.stop()

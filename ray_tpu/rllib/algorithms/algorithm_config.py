@@ -25,6 +25,20 @@ class AlgorithmConfig:
         # many published versions are dropped before the learner sees
         # them.  None disables the gate.
         self.max_weight_staleness: Optional[int] = 4
+        # Distributed replay plane (replay-family actor modes; see
+        # rllib/execution/replay_plane.py).  0 shards = learner-local
+        # single-shard mode (the historical HostReplay path); > 0 shards
+        # stores fragments on the object plane behind shard actors.
+        self.replay_num_shards = 0
+        self.replay_prioritized = False   # priority-proportional sampling
+        self.replay_alpha = 0.6           # priority exponent (when on)
+        self.replay_beta = 0.4            # IS-weight exponent
+        self.n_step = 1                   # n-step returns folded at insert
+        self.replay_prefetch = 0          # gathered batches kept in flight
+        # Staleness gate on SAMPLED rows (vs the rollout-plane gate below):
+        # rows acted under weights older than this many versions get
+        # importance weight 0.  None disables.
+        self.replay_max_weight_staleness: Optional[int] = None
         # VectorEnv stepping: "serial" | "thread" | "subprocess" | "auto"
         # (auto: subprocess when the actor's host has >= 4 cores).
         self.env_parallelism = "serial"

@@ -99,80 +99,15 @@ def make_replay_state(buffer_size: int, n_insert: int, obs_dim: int,
     )
 
 
-class HostReplay:
-    """Learner-owned host (numpy) replay ring for the actor topology —
-    the rollout actors stream raw transitions in, the learner samples
-    stacked minibatches out (reference: the learner-side replay in ApexDQN,
-    rllib/execution/multi_gpu_learner_thread.py:187)."""
-
-    def __init__(self, capacity: int, obs_dim: int,
-                 action_shape: Tuple[int, ...] = (), action_dtype=None):
-        import numpy as np
-
-        self.cols = {
-            "obs": np.zeros((capacity, obs_dim), np.float32),
-            "actions": np.zeros((capacity,) + tuple(action_shape),
-                                action_dtype or np.int64),
-            "rewards": np.zeros((capacity,), np.float32),
-            "next_obs": np.zeros((capacity, obs_dim), np.float32),
-            "dones": np.zeros((capacity,), np.float32),
-        }
-        self.capacity = capacity
-        self.pos = 0
-        self.size = 0
-
-    def insert(self, batch):
-        import numpy as np
-
-        n = len(batch["rewards"])
-        idx = (self.pos + np.arange(n)) % self.capacity
-        for k, col in self.cols.items():
-            self.cols[k][idx] = np.asarray(batch[k]).reshape(
-                (n,) + col.shape[1:])
-        self.pos = int((self.pos + n) % self.capacity)
-        self.size = int(min(self.size + n, self.capacity))
-
-    def sample_stacked(self, rng, num_batches: int, batch_size: int):
-        """[U, B, ...] stacked minibatches as device arrays — one device
-        round trip feeds a whole lax.scan of updates."""
-        idx = rng.integers(0, self.size, size=(num_batches, batch_size))
-        return {k: jnp.asarray(col[idx]) for k, col in self.cols.items()}
-
-
-def run_actor_replay_iter(algo, explore_arg, batch_size, do_updates):
-    """ONE shared actor-topology iteration for the replay family
-    (DQN/SAC/TD3): harvest transitions from the rollout actors, feed the
-    learner-owned host replay, run the algorithm's updates once warm, and
-    assemble the common metrics (reward EMA, worker health)."""
-    import numpy as np
-
-    cfg = algo.config
-    batches, returns = algo.workers.sample_sync(explore_arg)
-    for b in batches:
-        algo._rb.insert(b)
-        algo._env_steps += len(b["rewards"])
-    metrics = {"replay_size": algo._rb.size}
-    if returns:
-        mean_r = float(np.mean(returns))
-        prev = getattr(algo, "_ep_reward_ema", None)
-        algo._ep_reward_ema = (mean_r if prev is None
-                               else 0.7 * prev + 0.3 * mean_r)
-        metrics["episodes_this_iter"] = len(returns)
-    if getattr(algo, "_ep_reward_ema", None) is not None:
-        metrics["episode_reward_mean"] = algo._ep_reward_ema
-    if algo._rb.size >= cfg.learning_starts:
-        # Algorithms may pin an actor-mode update count (e.g. DQN's
-        # replay-ratio-derived default) — num_updates_per_iter's default
-        # is tuned for the anakin path's huge batches.
-        U = getattr(algo, "_actor_updates", None) or cfg.num_updates_per_iter
-        stacked = algo._rb.sample_stacked(algo._host_rng, U, batch_size)
-        keys = jax.random.split(jax.random.PRNGKey(algo._env_steps), U)
-        metrics.update(do_updates(stacked, keys))
-        algo.workers.sync_weights(jax.device_get(algo._sync_params()))
-    metrics["num_env_steps_sampled_this_iter"] = sum(
-        len(b["rewards"]) for b in batches)
-    metrics["num_healthy_workers"] = algo.workers.num_healthy_workers
-    return metrics
+# The historical learner-owned HostReplay ring folded into the replay
+# plane's local single-shard mode (PR 18): one replay implementation for
+# DQN/SAC/TD3 actor modes, with the sharded object-plane mode one config
+# knob away (replay_num_shards > 0).  run_actor_replay_iter re-exported
+# here for back-compat with its historical import site.
+from ray_tpu.rllib.execution.replay_plane import (  # noqa: E402,F401
+    ReplayPlane,
+    run_actor_replay_iter,
+)
 
 
 def make_offpolicy_rollout(env, act_fn):
@@ -399,7 +334,7 @@ class DQN(Algorithm):
         self._opt_state = tx.init(self._params)
         self._rng = rng
         self._env_steps = 0
-        self._rb = HostReplay(cfg.buffer_size, obs_dim)
+        self._rb = ReplayPlane.from_config(cfg)
         self._host_rng = __import__("numpy").random.default_rng(cfg.seed)
 
         hiddens = tuple(cfg.hiddens)

@@ -278,8 +278,8 @@ class SAC(Algorithm):
         import cloudpickle
         import numpy as np
 
-        from ray_tpu.rllib.algorithms.dqn import HostReplay
         from ray_tpu.rllib.env.py_envs import make_py_env
+        from ray_tpu.rllib.execution.replay_plane import ReplayPlane
         from ray_tpu.rllib.evaluation.worker_set import (
             OffPolicyRolloutWorker,
             WorkerSet,
@@ -321,9 +321,7 @@ class SAC(Algorithm):
         self._q_opt = q_tx.init(self._q_params)
         self._a_opt = a_tx.init(self._log_alpha)
         self._env_steps = 0
-        self._rb = HostReplay(cfg.buffer_size, obs_dim,
-                              action_shape=(adim,),
-                              action_dtype=np.float32)
+        self._rb = ReplayPlane.from_config(cfg)
 
         hiddens = tuple(cfg.hiddens)
         low_l = np.asarray(probe.action_low).tolist()

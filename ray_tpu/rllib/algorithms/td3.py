@@ -258,8 +258,8 @@ class TD3(Algorithm):
         import cloudpickle
         import numpy as np
 
-        from ray_tpu.rllib.algorithms.dqn import HostReplay
         from ray_tpu.rllib.env.py_envs import make_py_env
+        from ray_tpu.rllib.execution.replay_plane import ReplayPlane
         from ray_tpu.rllib.evaluation.worker_set import (
             OffPolicyRolloutWorker,
             WorkerSet,
@@ -299,9 +299,7 @@ class TD3(Algorithm):
         self._q_opt = q_tx.init(self._q_params)
         self._count = jnp.zeros((), jnp.int32)
         self._env_steps = 0
-        self._rb = HostReplay(cfg.buffer_size, obs_dim,
-                              action_shape=(adim,),
-                              action_dtype=np.float32)
+        self._rb = ReplayPlane.from_config(cfg)
         self._host_rng = np.random.default_rng(cfg.seed)
 
         hiddens = tuple(cfg.hiddens)

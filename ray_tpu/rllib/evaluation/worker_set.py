@@ -335,6 +335,26 @@ class OffPolicyRolloutWorker:
         completed, self.completed = self.completed, []
         return batch, completed
 
+    def sample_publish(self, explore_arg: float = 0.0, gamma: float = 0.99,
+                       n_step: int = 1):
+        """The replay-plane publish path: collect one fragment, fold
+        n-step returns HERE (the worker owns the contiguity), publish the
+        columns to the object plane with one put_many burst, and return
+        only the refs + metadata — transition bytes never ride the RPC
+        reply, so the learner's insert path is pure ref bookkeeping."""
+        batch, completed = self.sample(explore_arg)
+        if n_step > 1:
+            from ray_tpu.rllib.execution.replay_plane import compute_nstep
+
+            batch = compute_nstep(batch, len(self.ep_returns), gamma,
+                                  n_step)
+        cols = sorted(batch)
+        refs = ray_tpu.put_many([np.ascontiguousarray(batch[c])
+                                 for c in cols])
+        meta = {"n": len(batch["rewards"]),
+                "version": self._weights_version}
+        return dict(zip(cols, refs)), meta, completed
+
 
 class WorkerSet:
     """Rollout workers behind a fault-tolerant actor manager (reference:
@@ -482,6 +502,13 @@ class WorkerSet:
     @property
     def num_healthy_workers(self) -> int:
         return sum(1 for n in self._failures if n == 0)
+
+    def publish_sync(self, *args) -> List[Tuple[Any, Dict[str, Any], list]]:
+        """sample_sync's replay-plane sibling: every worker publishes its
+        fragment to the object plane and replies (refs, meta, completed)
+        — same dead-worker tolerance, no payload bytes in the replies."""
+        return [r for _i, r in self._foreach(
+            lambda w: w.sample_publish.remote(*args))]
 
     def sample_sync(self, *args) -> Tuple[List[Any], List[float]]:
         """synchronous_parallel_sample (reference:

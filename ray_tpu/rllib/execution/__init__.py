@@ -1,0 +1,11 @@
+"""Execution-plane building blocks for the rllib stack (reference:
+rllib/execution/ — replay ops, learner threads, rollout ops).  Here the
+package holds the distributed replay plane (replay_plane.py)."""
+from ray_tpu.rllib.execution.replay_plane import (  # noqa: F401
+    ReplayBatch,
+    ReplayPlane,
+    ReplayShard,
+    ShardCore,
+    compute_nstep,
+    run_actor_replay_iter,
+)

@@ -13,6 +13,7 @@ from tools.perf_smoke import (
     run_mpmd_smoke,
     run_node_loss_smoke,
     run_object_plane_smoke,
+    run_replay_smoke,
     run_rlhf_smoke,
     run_rollout_smoke,
     run_rpc_chaos_smoke,
@@ -245,4 +246,18 @@ def test_elastic_smoke(shutdown_only):
     assert out["weight_puts"] == out["version"], \
         f"weight broadcast fan-out regressed: {out}"
     assert out["bitwise_parity"], f"elastic resize perturbed the run: {out}"
+    assert out["ok"], out
+
+
+def test_replay_smoke(shutdown_only):
+    """The distributed replay plane's three perf invariants: steady-state
+    inserts are zero-copy (ring eviction recycles pooled segments — no
+    new shm segments while the ring churns), sampling resolves each batch
+    with exactly ONE batched get_many gather, and the flow prefetcher
+    keeps a gather in flight during the learner's SGD window."""
+    out = run_replay_smoke()
+    assert out["zero_copy_ok"], \
+        f"insert path copied or leaked segments: {out}"
+    assert out["gather_ok"], f"sampling issued extra gathers: {out}"
+    assert out["overlap_ok"], f"no gather ran during an SGD window: {out}"
     assert out["ok"], out

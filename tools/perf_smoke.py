@@ -1418,6 +1418,117 @@ def run_locality_smoke(mb: int = 8) -> dict:
         ray_tpu.shutdown()
 
 
+def run_replay_smoke(frag_len: int = 512, dim: int = 512,
+                     batches: int = 4, batch_size: int = 64,
+                     steady_inserts: int = 4) -> dict:
+    """Distributed replay plane invariants (no timing thresholds —
+    tier-1 safe; rates live in bench.py's bench_replay):
+
+    1. **Zero-copy insert / eviction = ref release**: fragment columns
+       are store-resident pooled-segment objects; once the shard rings
+       are full, every further insert evicts one fragment and its
+       segments recycle — steady-state inserts create NO new shm
+       segments (``pool_created`` flat, ``pool_hits`` climbing).
+    2. **One gather per batch**: K sampled batches issue exactly K
+       batched ``get_many`` resolves (``plane.gather_calls``), never
+       per-transition gets.
+    3. **Gather/SGD overlap**: with the flow prefetcher on, at least one
+       sample's wall-stamp interval overlaps a consumer "SGD" window —
+       the gather of batch i+1 runs while batch i is being consumed.
+    """
+    import time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.rllib.execution.replay_plane import ReplayPlane
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    try:
+        from ray_tpu._private.worker import global_worker as gw
+
+        store = gw.transport.head.raylets[gw.node_id].store
+        out = {"pool_enabled": store.pool is not None}
+        # 2 shards x 3 slots; obs/next_obs are frag_len*dim float32
+        # (1 MiB at the defaults) — at the segment pool's MIN_CLASS, so
+        # fragments land in pooled shm segments, not dedicated ones.
+        plane = ReplayPlane(capacity=6 * frag_len, num_shards=2,
+                            alpha=0.0, seed=0)
+        rng = np.random.default_rng(0)
+
+        def frag():
+            return {
+                "obs": rng.standard_normal((frag_len, dim))
+                .astype(np.float32),
+                "actions": rng.integers(0, 4, frag_len).astype(np.int64),
+                "rewards": rng.standard_normal(frag_len)
+                .astype(np.float32),
+                "next_obs": rng.standard_normal((frag_len, dim))
+                .astype(np.float32),
+                "dones": np.zeros(frag_len, np.float32),
+            }
+
+        def settled_created():
+            """pool_created once pending eviction releases land (the
+            shard's release notify races the insert ack by a hair)."""
+            last = store.stats().get("pool_created", -1)
+            for _ in range(40):
+                time.sleep(0.05)
+                cur = store.stats().get("pool_created", -1)
+                if cur == last:
+                    return cur
+                last = cur
+            return last
+
+        for _ in range(7):   # fill both rings + first eviction (warmup)
+            plane.insert(frag())
+        assert plane.size == 6 * frag_len
+        created_before = settled_created()
+        hits_before = store.stats().get("pool_hits", 0)
+        for _ in range(steady_inserts):   # every insert now evicts
+            plane.insert(frag())
+        _ = plane.size                    # barrier: all acks harvested
+        out["segments_created_steady"] = (settled_created()
+                                          - created_before)
+        out["pool_hits_steady"] = (store.stats().get("pool_hits", 0)
+                                   - hits_before)
+        out["zero_copy_ok"] = (out["pool_enabled"]
+                               and out["segments_created_steady"] == 0
+                               and out["pool_hits_steady"] > 0)
+
+        # --- one batched gather per sampled batch ---
+        g0 = plane.gather_calls
+        for _ in range(batches):
+            b = plane.sample(batch_size)
+            assert b["obs"].shape == (batch_size, dim)
+        out["gathers_per_batch"] = (plane.gather_calls - g0) / batches
+        out["gather_ok"] = plane.gather_calls - g0 == batches
+
+        # --- gather/SGD overlap via the flow prefetcher ---
+        plane.sample_stamps.clear()
+        stage = plane.prefetch(batch_size, depth=2)
+        next(stage)                       # prime: batch 0 gathered
+        sgd_windows = []
+        for _ in range(batches):
+            s0 = time.monotonic()
+            time.sleep(0.05)              # the "SGD" window on batch i
+            sgd_windows.append((s0, time.monotonic()))
+            next(stage)                   # batch i+1 (prefetched)
+        stage.close()
+        stamps = list(plane.sample_stamps)
+        out["overlapped_gathers"] = sum(
+            1 for (t0, t1) in stamps for (s0, s1) in sgd_windows
+            if t0 < s1 and t1 > s0)
+        out["overlap_ok"] = out["overlapped_gathers"] > 0
+        plane.close()
+        out["ok"] = bool(out["zero_copy_ok"] and out["gather_ok"]
+                         and out["overlap_ok"])
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
@@ -1447,10 +1558,12 @@ def main() -> int:
     out["rlhf"] = rl
     loc = run_locality_smoke()
     out["locality"] = loc
+    rp = run_replay_smoke()
+    out["replay"] = rp
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
                      and rpc["ok"] and nl["ok"] and el["ok"] and sv["ok"]
                      and zr["ok"] and mpmd["ok"] and fl["ok"] and td["ok"]
-                     and rl["ok"] and loc["ok"])
+                     and rl["ok"] and loc["ok"] and rp["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
