@@ -238,6 +238,11 @@ def shutdown():
         if _head is not None:
             _head.shutdown()
             _head = None
+    # Session boundary: an implicit trace context minted for this
+    # session's API calls must not bleed into the next init().
+    from ray_tpu import observability as _obs
+
+    _obs.clear_context()
 
 
 def remote(*args, **kwargs):
@@ -334,12 +339,23 @@ def nodes() -> List[dict]:
     return _worker().transport.request("state", {"what": "nodes"})
 
 
-def timeline(filename: Optional[str] = None) -> List[dict]:
-    """Chrome-trace dump of task execution (reference: ray.timeline())."""
+def timeline(filename: Optional[str] = None,
+             trace_id: Optional[str] = None) -> List[dict]:
+    """Chrome-trace dump of task execution (reference: ray.timeline()),
+    merged with the tracing plane's cluster spans: per-node pid lanes,
+    per-process tid lanes, and cross-process flow arrows.  Pass a
+    ``trace_id`` to assemble one distributed trace's timeline."""
     from ray_tpu._private.profiling import chrome_tracing_dump
 
-    tasks = _worker().transport.request("state", {"what": "tasks"})
-    return chrome_tracing_dump(tasks, filename)
+    try:
+        raw = _worker().transport.request(
+            "trace_timeline", {"trace_id": trace_id})
+        tasks, spans = raw["tasks"], raw["spans"]
+    except Exception:
+        # Older head without the tracing plane: tasks only.
+        tasks, spans = _worker().transport.request(
+            "state", {"what": "tasks"}), []
+    return chrome_tracing_dump(tasks, filename, spans=spans)
 
 
 # Submodules re-exported lazily to keep `import ray_tpu` light (jax-free).
@@ -348,6 +364,7 @@ def __getattr__(name):
 
     if name in ("util", "air", "train", "tune", "data", "serve", "rllib",
                 "parallel", "ops", "models", "workflow", "dag",
-                "cluster_utils", "state", "internal_kv", "checkpoint"):
+                "cluster_utils", "state", "internal_kv", "checkpoint",
+                "observability"):
         return importlib.import_module(f"ray_tpu.{name}")
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

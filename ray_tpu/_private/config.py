@@ -183,6 +183,22 @@ CONFIG \
     .declare("tracing_enabled", bool, False,
              "Instrument task submit/execute with OpenTelemetry spans "
              "(API-only; wire a TracerProvider to export).") \
+    .declare("tracing_buffer_size", int, 4096,
+             "Capacity of the per-process span ring buffer "
+             "(drop-oldest; drops counted in "
+             "tracing_spans_dropped_total).") \
+    .declare("trace_store_max_bytes", int, 32 * 1024 * 1024,
+             "Head-side TraceStore global byte budget; whole traces "
+             "are evicted LRU past this.") \
+    .declare("trace_max_bytes", int, 2 * 1024 * 1024,
+             "Per-trace byte budget in the head TraceStore; excess "
+             "spans within one trace are dropped and counted.") \
+    .declare("flight_record_dir", str, "",
+             "Crash flight-recorder bundle directory (also "
+             "RAY_TPU_FLIGHT_RECORD_DIR); empty disables postmortem "
+             "bundles.") \
+    .declare("flight_record_max", int, 16,
+             "Max flight-record bundles kept; oldest pruned.") \
     .declare("memory_usage_threshold", float, 0.95,
              "Host/cgroup memory fraction above which the monitor kills "
              "a worker (reference: memory_usage_threshold).") \

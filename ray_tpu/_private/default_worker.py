@@ -147,6 +147,28 @@ def main():
     threading.Thread(target=reader, name="rtpu-reader", daemon=True).start()
     register()
 
+    # Tracing plane: direct-path tasks reply to their caller, bypassing
+    # the head — a periodic flusher ships their spans on the node-stats
+    # cadence so they still assemble (execute_task also flushes at task
+    # start/end; this catches spans between tasks and long-running ones).
+    from ray_tpu.util.tracing import tracing_enabled
+
+    if tracing_enabled():
+        from ray_tpu import observability as obs
+
+        def span_flusher():
+            import time as _time
+
+            while not stop.is_set():
+                _time.sleep(max(0.25, CONFIG.node_stats_period_s))
+                try:
+                    obs.flush(transport)
+                except Exception:
+                    pass
+
+        threading.Thread(target=span_flusher, name="rtpu-span-flush",
+                         daemon=True).start()
+
     def make_done(spec: TaskSpec):
         if server is not None and spec.task_id in server.cancelled:
             server.cancelled.discard(spec.task_id)

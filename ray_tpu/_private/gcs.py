@@ -139,7 +139,7 @@ class ObjectEntry:
 
 class TaskEvent:
     __slots__ = ("task_id", "name", "status", "node_id", "worker_id", "start", "end",
-                 "attempt", "error", "type", "parent_task_id")
+                 "attempt", "error", "type", "parent_task_id", "trace_id")
 
     def __init__(self, task_id, name, status, **kw):
         self.task_id = task_id
@@ -153,6 +153,8 @@ class TaskEvent:
         self.error = kw.get("error")
         self.type = kw.get("type", "NORMAL")
         self.parent_task_id = kw.get("parent_task_id")
+        # Distributed trace this task belongs to (tracing plane).
+        self.trace_id = kw.get("trace_id")
 
 
 class GCS:
@@ -568,20 +570,43 @@ class GCS:
 
     def list_tasks(self) -> List[dict]:
         with self._lock:
-            return [
-                {
+            out = []
+            for t in self.task_events.values():
+                status = t.status.name if hasattr(t.status, "name") \
+                    else str(t.status)
+                out.append({
                     "task_id": t.task_id.hex(),
                     "name": t.name,
-                    "status": t.status.name if hasattr(t.status, "name") else str(t.status),
+                    "status": status,
+                    # "state" aliases "status" to match the reference's
+                    # state API column naming.
+                    "state": status,
                     "attempt": t.attempt,
                     "type": t.type,
                     "error": t.error,
                     "start": t.start,
                     "end": t.end,
+                    "duration": (t.end - t.start)
+                    if t.start is not None and t.end is not None else None,
                     "worker_id": t.worker_id.hex() if t.worker_id else None,
-                }
-                for t in self.task_events.values()
-            ]
+                    "node_id": t.node_id.hex() if t.node_id else None,
+                    "parent_task_id": t.parent_task_id.hex()
+                    if t.parent_task_id else None,
+                    "trace_id": t.trace_id,
+                })
+            return out
+
+    @staticmethod
+    def _object_state(o: ObjectEntry) -> str:
+        if o.lost:
+            return "LOST"
+        if o.inline is not None:
+            return "INLINE"
+        if o.locations:
+            return "SEALED"
+        if o.spill is not None or o.spilled_path is not None:
+            return "SPILLED"
+        return "PENDING"
 
     def list_objects(self) -> List[dict]:
         with self._lock:
@@ -592,6 +617,9 @@ class GCS:
                     "locations": [n.hex() for n in o.locations],
                     "inline": o.inline is not None,
                     "num_holders": len(o.holders),
+                    "state": self._object_state(o),
+                    "node_id": next(iter(o.locations)).hex()
+                    if o.locations else None,
                 }
                 for o in self.objects.values()
             ]

@@ -125,6 +125,18 @@ class Head:
         self._rpc_cache = ReplyCache(
             cap=_CONFIG.rpc_reply_cache_size,
             ttl=_CONFIG.rpc_reply_cache_ttl_s)
+        # ---- tracing plane ----
+        # Cluster span sink: workers flush span batches here (span_batch
+        # op / node_stats piggyback); byte-budgeted so tracing can stay
+        # on without unbounded head memory.  The event log is the flight
+        # recorder's "what happened lately" feed (node joins/deaths,
+        # kills) — cheap enough to run even with tracing off.
+        from ray_tpu.observability.trace_store import TraceStore
+
+        self.trace_store = TraceStore(
+            max_bytes=_CONFIG.trace_store_max_bytes,
+            per_trace_bytes=_CONFIG.trace_max_bytes)
+        self._event_log: deque = deque(maxlen=512)
         # ---- multi-host plane ----
         # Host identity: object resolutions are host-aware — same host means
         # "attach the shm segment", different host means "pull over TCP from
@@ -510,6 +522,13 @@ class Head:
             if node_id in self._dead_nodes:
                 return
             self._dead_nodes.add(node_id)
+            self._log_event("node_death", node=node_id.hex(), cause=cause)
+            # Flight recorder: snapshot BEFORE death processing reshuffles
+            # the task table, so the bundle shows what was running (and
+            # which spans the victim flushed) at the moment of death.
+            self._flight_snapshot(
+                f"node_death_{node_id.hex()[:8]}",
+                {"cause": cause, "node": node_id.hex()})
             raylet = self.raylets.pop(node_id, None)
             # PGs demoted to PENDING by the node loss re-reserve through
             # the pending queue once capacity returns (their surviving
@@ -585,6 +604,7 @@ class Head:
             raylet = self.raylets.get(node_id)
             if raylet is None:
                 return
+            self._log_event("kill_node", node=node_id.hex())
             for h in list(raylet.workers.values()):
                 try:
                     h.proc.kill()
@@ -674,6 +694,11 @@ class Head:
                     if agent_node is not None:
                         self.gcs.update_node_stats(agent_node,
                                                    msg.get("stats") or {})
+                        spans = msg.get("spans")
+                        if spans:
+                            # Agent-relayed span batch riding the stats
+                            # cadence (its own ring + worker leftovers).
+                            self.trace_store.ingest(spans)
                 elif mtype == "heartbeat":
                     pass  # touch_node above already refreshed the lease
                 elif mtype == "worker_oom":
@@ -728,9 +753,18 @@ class Head:
                 elif mtype == "notify":
                     # One-way request: no reply frame (hot-path submits).
                     try:
-                        self.handle_request(msg["op"],
-                                            msg.get("payload") or {},
-                                            lambda *a, **k: None, worker_id)
+                        tc = msg.get("tc")
+                        if tc is not None and self._tracing_on():
+                            from ray_tpu import observability as obs
+
+                            with obs.use_context(tuple(tc)):
+                                self.handle_request(
+                                    msg["op"], msg.get("payload") or {},
+                                    lambda *a, **k: None, worker_id)
+                        else:
+                            self.handle_request(
+                                msg["op"], msg.get("payload") or {},
+                                lambda *a, **k: None, worker_id)
                     except Exception:
                         traceback.print_exc()
         except (EOFError, OSError, BrokenPipeError):
@@ -928,6 +962,89 @@ class Head:
         if not self._send_on(worker.conn, msg):
             self.on_conn_closed(worker.worker_id)
 
+    # ================= tracing plane =================
+    def _tracing_on(self) -> bool:
+        from ray_tpu.util.tracing import tracing_enabled
+
+        return tracing_enabled()
+
+    def _drain_local_spans(self) -> None:
+        """Pull the head/driver process's own span ring into the store.
+        Workers and agents push theirs over the wire; in-process
+        emitters (driver spans, head.<op> spans) are drained whenever
+        the store is about to be read."""
+        if not self._tracing_on():
+            return
+        from ray_tpu import observability as obs
+
+        spans = obs.drain_spans()
+        if spans:
+            self.trace_store.ingest(spans)
+
+    def _log_event(self, kind: str, **detail) -> None:
+        self._event_log.append({"ts": time.time(), "event": kind,
+                                **detail})
+
+    def _flight_snapshot(self, reason: str,
+                         extra: Optional[dict] = None) -> Optional[str]:
+        """Snapshot rings + task table + event log into a postmortem
+        bundle.  No-op unless a flight-record dir is configured; never
+        raises into the death path that triggered it."""
+        from ray_tpu.observability.flight_recorder import (
+            flight_record_dir,
+            write_bundle,
+        )
+
+        if flight_record_dir() is None:
+            return None
+        self._drain_local_spans()
+        try:
+            tasks = self.gcs.list_tasks()
+        except Exception:
+            tasks = []
+        path = write_bundle(reason, spans=self.trace_store.spans(),
+                            tasks=tasks, events=list(self._event_log),
+                            extra=extra)
+        if path is not None:
+            self._log_event("flight_record", reason=reason, path=path)
+        return path
+
+    def req_span_batch(self, payload, reply, caller):
+        """Span flush from a worker/driver: ingest into the TraceStore."""
+        spans = payload.get("spans") or []
+        if spans:
+            self.trace_store.ingest(spans)
+        reply(True)
+
+    def req_flight_record(self, payload, reply, caller):
+        """Driver-triggered postmortem snapshot (gang restart handlers,
+        MeshGroupError paths)."""
+        reply(self._flight_snapshot(
+            payload.get("reason") or "manual",
+            {"trigger": "request"}))
+
+    def req_traces(self, payload, reply, caller):
+        self._drain_local_spans()
+        reply(self.trace_store.list_traces(
+            limit=int(payload.get("limit") or 50)))
+
+    def req_trace_timeline(self, payload, reply, caller):
+        """Raw material for timeline assembly: task rows + the trace's
+        spans (all spans when no trace_id) — the client merges them with
+        observability.timeline.build_chrome_trace."""
+        self._drain_local_spans()
+        trace_id = payload.get("trace_id")
+        with self._lock:
+            tasks = self.gcs.list_tasks()
+        if trace_id:
+            tasks = [t for t in tasks if t.get("trace_id") == trace_id]
+        reply({"tasks": tasks,
+               "spans": self.trace_store.spans(trace_id or None)})
+
+    def req_span_summary(self, payload, reply, caller):
+        self._drain_local_spans()
+        reply(self.trace_store.summary())
+
     # ================= request router =================
     def _handle_request(self, msg: dict, conn, worker_id: Optional[WorkerID]):
         msg_id = msg["msg_id"]
@@ -940,6 +1057,15 @@ class Head:
                                  "op": op, "ok": error is None,
                                  "value": value, "error": error})
 
+        tc = msg.get("tc")
+        if tc is not None and self._tracing_on():
+            from ray_tpu import observability as obs
+
+            with obs.use_context(tuple(tc)):
+                self.handle_request_keyed(op, msg.get("payload") or {},
+                                          reply, worker_id,
+                                          msg.get("rpc_key"))
+            return
         self.handle_request_keyed(op, msg.get("payload") or {}, reply,
                                   worker_id, msg.get("rpc_key"))
 
@@ -970,6 +1096,21 @@ class Head:
         if fn is None:
             reply(error=ValueError(f"unknown op {op!r}"))
             return
+        # Head-side span: records the op inside the caller's trace.
+        # Sitting BELOW the reply-cache admit means a resent frame
+        # answered from cache never re-records — the resend-dedup
+        # guarantee for head spans.  span_batch itself is exempt (the
+        # flush path must not generate spans about shipping spans).
+        if op != "span_batch" and self._tracing_on():
+            from ray_tpu import observability as obs
+
+            if obs.get_context() is not None:
+                t0 = time.time()
+                try:
+                    fn(payload, reply, caller)
+                finally:
+                    obs.record("head." + op, t0, time.time())
+                return
         fn(payload, reply, caller)
 
     def req_notify_msg(self, payload, reply, caller):
@@ -1446,7 +1587,8 @@ class Head:
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, TaskStatus.PENDING,
                 attempt=spec.attempt, type=spec.task_type.name,
-                parent_task_id=spec.parent_task_id))
+                parent_task_id=spec.parent_task_id,
+                trace_id=spec.trace_ctx[0] if spec.trace_ctx else None))
             if spec.task_type != TaskType.ACTOR_CREATION:
                 self.gcs.record_lineage(spec)
             # Pin arg refs for the task's lifetime (owner-side arg pinning,
@@ -1768,7 +1910,8 @@ class Head:
                     return
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, TaskStatus.PENDING,
-                type="ACTOR_TASK", parent_task_id=spec.parent_task_id))
+                type="ACTOR_TASK", parent_task_id=spec.parent_task_id,
+                trace_id=spec.trace_ctx[0] if spec.trace_ctx else None))
             if info.state != ActorState.ALIVE or info.worker_id is None:
                 info.pending_calls.append(spec)
                 return
@@ -1824,11 +1967,13 @@ class Head:
                     self._drain_pending()
                     return
                 status = TaskStatus.FAILED if error else TaskStatus.FINISHED
-                self.gcs.update_task_status(task_id, status,
-                                            error=msg.get("error_str"),
-                                            worker_id=worker_id,
-                                            start=msg.get("start"),
-                                            end=msg.get("end"))
+                kw = dict(error=msg.get("error_str"), worker_id=worker_id,
+                          start=msg.get("start"), end=msg.get("end"))
+                if handle is not None:
+                    # Keep the SCHEDULED-time node when the worker is
+                    # already gone — don't clobber it with None.
+                    kw["node_id"] = handle.node_id
+                self.gcs.update_task_status(task_id, status, **kw)
                 # Unpin arg refs (direct and nested).
                 for arg in list(spec.args) + list(spec.kwargs.values()):
                     for oid in ([arg.ref] if arg.ref is not None else []) \

@@ -178,6 +178,14 @@ class NodeAgent:
                 self.node_id = NodeID(msg["node_id"])
                 if "node_stats_period_s" in msg:
                     self._stats_period = float(msg["node_stats_period_s"])
+                try:
+                    from ray_tpu import observability as obs
+
+                    obs.set_identity(
+                        f"agent:{self.node_id.hex()[:8]}",
+                        self.node_id.hex())
+                except Exception:
+                    pass
             elif t == "spawn_worker":
                 self._chaos_site("node_agent_spawn")
                 self._spawn_worker(msg)
@@ -378,9 +386,22 @@ class NodeAgent:
             with self._children_lock:
                 n_workers = len(self._children)
             try:
-                self.send({"type": "node_stats",
-                           "stats": collect_node_stats(
-                               store=self.store, num_workers=n_workers)})
+                frame = {"type": "node_stats",
+                         "stats": collect_node_stats(
+                             store=self.store, num_workers=n_workers)}
+                try:
+                    from ray_tpu import observability as obs
+                    from ray_tpu.util.tracing import tracing_enabled
+
+                    if tracing_enabled():
+                        # Agent-side spans (transfer serving, pulls) ride
+                        # the stats cadence instead of their own frames.
+                        spans = obs.drain_spans()
+                        if spans:
+                            frame["spans"] = spans
+                except Exception:
+                    pass
+                self.send(frame)
             except Exception:
                 pass  # head restarting: reconnect loop handles it
 

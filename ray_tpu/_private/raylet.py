@@ -170,6 +170,16 @@ class Raylet:
             "RAY_TPU_HOST_KEY": getattr(self, "host_key", None)
                                  or self.head.host_key,
         }
+        # Tracing plane: ship the driver's RESOLVED tracing switch — the
+        # flag may have been set via _system_config or enable_tracing(),
+        # which a fresh subprocess's CONFIG would never see.
+        try:
+            from ray_tpu.util.tracing import tracing_enabled
+
+            if tracing_enabled():
+                env["RAY_TPU_TRACING_ENABLED"] = "1"
+        except Exception:
+            pass
         if tpu_visible and tpu_chips and len(tpu_chips) < self.tpu_chips_total:
             # Strict-subset chip share: partition via TPU_VISIBLE_CHIPS so
             # concurrent TPU workers on this host never contend for libtpu.

@@ -130,6 +130,9 @@ class TaskSpec:
     parent_task_id: Optional[TaskID] = None
     # Bookkeeping filled in by the scheduler
     attempt: int = 0
+    # Distributed trace context carried from the submitting thread:
+    # (trace_id, parent_span_id) hex pair — see ray_tpu.observability.
+    trace_ctx: Optional[Tuple[str, str]] = None
 
     def return_ids(self) -> List[ObjectID]:
         ids = getattr(self, "_return_ids", None)
@@ -160,7 +163,8 @@ class TaskSpec:
             self.max_restarts, self.max_task_retries, self.max_concurrency,
             self.actor_name, self.actor_method_names or None,
             self.namespace, self.lifetime, self.runtime_env,
-            self.owner_worker_id, self.parent_task_id, self.attempt))
+            self.owner_worker_id, self.parent_task_id, self.attempt,
+            self.trace_ctx))
 
 
 def _rebuild_spec(task_id, job_id, task_type, name, func_blob, func_hash,
@@ -168,14 +172,16 @@ def _rebuild_spec(task_id, job_id, task_type, name, func_blob, func_hash,
                   scheduling_strategy, max_retries, retry_exceptions,
                   actor_id, max_restarts, max_task_retries, max_concurrency,
                   actor_name, actor_method_names, namespace, lifetime,
-                  runtime_env, owner_worker_id, parent_task_id, attempt):
+                  runtime_env, owner_worker_id, parent_task_id, attempt,
+                  trace_ctx=None):
     return TaskSpec(task_id, job_id, TaskType(task_type), name, func_blob,
                     func_hash, method_name, args or [], kwargs or {},
                     num_returns, resources or {}, scheduling_strategy,
                     max_retries, retry_exceptions, actor_id, max_restarts,
                     max_task_retries, max_concurrency, actor_name,
                     actor_method_names or [], namespace, lifetime,
-                    runtime_env, owner_worker_id, parent_task_id, attempt)
+                    runtime_env, owner_worker_id, parent_task_id, attempt,
+                    trace_ctx)
 
 
 @dataclass

@@ -154,7 +154,8 @@ class ObjectTransferServer:
         try:
             while True:
                 req = conn.recv()
-                self._serve_one(conn, ObjectID(req["oid"]))
+                self._serve_one(conn, ObjectID(req["oid"]),
+                                req.get("tc"))
         except (EOFError, OSError, BrokenPipeError):
             pass
         except Exception:
@@ -165,7 +166,9 @@ class ObjectTransferServer:
             except Exception:
                 pass
 
-    def _serve_one(self, conn, oid: ObjectID):
+    def _serve_one(self, conn, oid: ObjectID, tc=None):
+        t0 = time.time()
+        served0 = self.served_bytes
         # Pin while streaming: eviction must not recycle the buffer under us
         # (plasma's client in-use-count contract).
         self.store.pin(oid)
@@ -195,6 +198,17 @@ class ObjectTransferServer:
                     conn.send_bytes(piece)
         finally:
             self.store.unpin(oid)
+            if tc is not None:
+                # Serve-side span inside the puller's trace — the
+                # cross-process flow edge for transfer-plane bytes.
+                try:
+                    from ray_tpu import observability as obs
+
+                    obs.record("transfer.pull", t0, time.time(),
+                               ctx=tuple(tc), oid=oid.hex(),
+                               bytes=self.served_bytes - served0)
+                except Exception:
+                    pass
 
     @staticmethod
     def _send_pipelined(conn, chunks, depth: int):
@@ -411,6 +425,15 @@ class TransferClient:
         retries = max(0, int(CONFIG.transfer_retries))
         timeout_s = float(CONFIG.transfer_timeout_s)
         policy = RetryPolicy(base=0.05, cap=1.0)
+        tc = None
+        try:
+            from ray_tpu import observability as obs
+            from ray_tpu.util.tracing import tracing_enabled
+
+            if tracing_enabled():
+                tc = obs.get_context()
+        except Exception:
+            pass
         for attempt in range(retries + 1):
             act = net_fault("pull")
             if act is not None:
@@ -431,7 +454,10 @@ class TransferClient:
                 # One in-flight request per CONNECTION (request/response
                 # protocol); pulls against different servers overlap.
                 with conn_lock:
-                    conn.send({"oid": oid.binary()})
+                    req = {"oid": oid.binary()}
+                    if tc is not None:
+                        req["tc"] = tc
+                    conn.send(req)
                     self._await_bytes(conn, timeout_s, oid, "header")
                     hdr = conn.recv()
                     if not hdr["ok"]:

@@ -49,6 +49,19 @@ def _tracing():
     return _tracing_mod
 
 
+_obs_mod = None
+
+
+def _obs():
+    """Lazy observability-module accessor (same bootstrap constraint)."""
+    global _obs_mod
+    if _obs_mod is None:
+        from ray_tpu import observability as _o
+
+        _obs_mod = _o
+    return _obs_mod
+
+
 # ---------------------------------------------------------------------------
 # Transports
 # ---------------------------------------------------------------------------
@@ -289,6 +302,10 @@ class ConnTransport:
             key = self._key_prefix + msg_id.to_bytes(8, "little")
             frame = {"type": "request", "msg_id": msg_id, "op": op,
                      "payload": payload, "rpc_key": key}
+            if _tracing().tracing_enabled():
+                tc = _obs().get_context()
+                if tc is not None:
+                    frame["tc"] = tc
             rec = _Rpc(Future(), op, frame, key, deadline, mode)
             self._pending[msg_id] = rec
         self._ensure_keeper()
@@ -369,6 +386,10 @@ class ConnTransport:
             fut.set_exception(msg["error"])
 
     def notify(self, msg: dict):
+        if _tracing().tracing_enabled() and "tc" not in msg:
+            tc = _obs().get_context()
+            if tc is not None:
+                msg["tc"] = tc
         if self._acked_ops():
             self._request_async("notify_msg", {"msg": msg})
         else:
@@ -383,7 +404,12 @@ class ConnTransport:
         if self._acked_ops():
             self._request_async(op, payload)
         else:
-            self.send({"type": "notify", "op": op, "payload": payload})
+            frame = {"type": "notify", "op": op, "payload": payload}
+            if _tracing().tracing_enabled():
+                tc = _obs().get_context()
+                if tc is not None:
+                    frame["tc"] = tc
+            self.send(frame)
 
     def send(self, msg: dict):
         with self._send_lock:
@@ -678,6 +704,11 @@ class CoreWorker:
         self.job_id = job_id
         self.transport = transport
         self.mode = mode  # "driver" | "worker" | "local"
+        try:
+            _obs().set_identity(f"{mode}:{worker_id.hex()[:8]}",
+                                node_id.hex())
+        except Exception:
+            pass
         # Ownership plane (reference: in-process memory store +
         # reference_count.h).  _owned always exists; the direct submitter +
         # server are attached by enable_direct() when the process supports
@@ -930,6 +961,8 @@ class CoreWorker:
         return self.ctx.task_id or self.driver_task_id
 
     def put(self, value: Any) -> ObjectRef:
+        if _tracing().tracing_enabled():
+            _obs().ensure_context()
         if self.ctx.task_id is None:
             # Outside task execution the put id hangs off the SHARED
             # driver task id, but put_counter is thread-local — two driver
@@ -1128,6 +1161,8 @@ class CoreWorker:
 
     # ---- get ----
     def get(self, refs, timeout: Optional[float] = None):
+        if _tracing().tracing_enabled():
+            _obs().ensure_context()
         single = isinstance(refs, ObjectRef)
         if not single and not isinstance(refs, (list, tuple)):
             raise TypeError(
@@ -1729,6 +1764,8 @@ class CoreWorker:
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
+        if _tracing().tracing_enabled():
+            spec.trace_ctx = _obs().context_for_outbound()
         if self._direct is not None and self._direct.submit_task(spec):
             return self._adopt_return_refs(spec)
         self._promote_owned_args(spec)
@@ -1736,12 +1773,18 @@ class CoreWorker:
         tr = _tracing()
         with (tr.span("task.submit", task_name=spec.name)
               if tr.tracing_enabled() else contextlib.nullcontext()):
+            if tr.tracing_enabled():
+                # Re-parent to the submit span (recorded, driver-side) so
+                # the worker's execute spans anchor a cross-process edge.
+                spec.trace_ctx = _obs().context_for_outbound()
             self.transport.request_oneway("submit", {"spec": spec})
         return refs
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         spec.owner_worker_id = self.worker_id
         spec.parent_task_id = self.current_task_id()
+        if _tracing().tracing_enabled():
+            spec.trace_ctx = _obs().context_for_outbound()
         if self._direct is not None and self._direct.submit_actor_task(spec):
             return self._adopt_return_refs(spec)
         self._promote_owned_args(spec)
@@ -1749,6 +1792,10 @@ class CoreWorker:
         tr = _tracing()
         with (tr.span("actor_task.submit", task_name=spec.name)
               if tr.tracing_enabled() else contextlib.nullcontext()):
+            if tr.tracing_enabled():
+                # Re-parent to the submit span (recorded, driver-side) so
+                # the actor's execute spans anchor a cross-process edge.
+                spec.trace_ctx = _obs().context_for_outbound()
             self.transport.request_oneway("actor_call", {"spec": spec})
         return refs
 
@@ -1798,6 +1845,18 @@ class CoreWorker:
         self.ctx.task_id = spec.task_id
         self.ctx.task_name = spec.name
         self.ctx.put_counter = 0
+        saved_trace_ctx = None
+        tracing_on = _tracing().tracing_enabled()
+        if tracing_on:
+            obs = _obs()
+            # Execute inside the submitter's trace, and flush a begin
+            # marker BEFORE running: if this process is SIGKILLed
+            # mid-task, the head already holds evidence of what died.
+            saved_trace_ctx = obs.adopt_spec_context(spec)
+            obs.record_instant("task.begin", task_name=spec.name,
+                              task_id=spec.task_id.hex())
+            if self.mode == "worker":
+                obs.flush(self.transport)
         # Adopt the submitting job's defaults for the task's duration
         # (pooled workers serve many jobs; restored in the finally).
         saved_job_defaults = (self.namespace, self.default_runtime_env)
@@ -1928,6 +1987,11 @@ class CoreWorker:
             if spec.task_type != TaskType.ACTOR_CREATION:
                 self.namespace, self.default_runtime_env = saved_job_defaults
             self.ctx.task_id = None
+            if tracing_on:
+                obs = _obs()
+                if self.mode == "worker":
+                    obs.flush(self.transport)
+                obs.set_context(saved_trace_ctx)
         return {
             "type": "task_done",
             "task_id": spec.task_id.binary(),

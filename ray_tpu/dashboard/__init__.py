@@ -11,7 +11,10 @@ routes read it directly instead of fanning out RPCs.
 Routes (JSON unless noted):
   GET  /api/cluster            — total + available resources, node count
   GET  /api/nodes|actors|tasks|objects|jobs|named_actors
+  GET  /state/<what>           — same tables, reference-style path
   GET  /api/summary            — task/actor/object rollups
+  GET  /traces                 — tracing plane: stored traces (biggest 1st)
+  GET  /timeline?trace_id=     — assembled chrome://tracing dump (JSON)
   GET  /api/logs               — index of worker/job log files
   GET  /api/logs/<name>        — tail of one log file (text; ?lines=N)
   GET  /metrics                — Prometheus text (user + runtime metrics)
@@ -157,6 +160,30 @@ class Dashboard:
                     entry["num_replicas"] = 0
                 out.append(entry)
             return req._send(200, out)
+        if path == "/traces" or path == "/api/traces":
+            out = []
+            limit = int(q.get("limit", ["50"])[0])
+            self.head.req_traces({"limit": limit}, out.append, None)
+            return req._send(200, out[0])
+        if path == "/timeline" or path == "/api/timeline":
+            from ray_tpu.observability.timeline import build_chrome_trace
+
+            trace_id = (q.get("trace_id") or [None])[0]
+            raw = []
+            self.head.req_trace_timeline({"trace_id": trace_id},
+                                         raw.append, None)
+            return req._send(200, build_chrome_trace(raw[0]["tasks"],
+                                                     raw[0]["spans"]))
+        if path.startswith("/state/"):
+            what = path[len("/state/"):]
+            if what == "traces":
+                out = []
+                self.head.req_traces({}, out.append, None)
+                return req._send(200, out[0])
+            if what not in ("nodes", "actors", "tasks", "objects",
+                            "jobs", "named_actors"):
+                return req._send(404, {"error": f"no state table: {what}"})
+            return req._send(200, self._state(what))
         if path == "/api/logs":
             return req._send(200, self._log_index())
         if path.startswith("/api/logs/"):

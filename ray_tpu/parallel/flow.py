@@ -220,6 +220,17 @@ class _StageCore:
         self.token = token
         self.span = span
         self.sink = sink
+        # Trace context captured at construction (the creator's thread):
+        # stage worker threads install it so their spans — and anything
+        # they submit — join the creating trace instead of floating.
+        self.trace_ctx = None
+        try:
+            from ray_tpu import observability as obs
+
+            if obs.enabled():
+                self.trace_ctx = obs.get_context()
+        except Exception:
+            pass
         self.out_q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self.src_lock = threading.Lock()
         self.state_lock = threading.Lock()
@@ -246,6 +257,13 @@ def _stage_worker(core: _StageCore) -> None:
     """Worker thread body (module-level on purpose — see _StageCore)."""
     from ray_tpu._private import profiling
 
+    if core.trace_ctx is not None:
+        try:
+            from ray_tpu import observability as obs
+
+            obs.set_context(core.trace_ctx)  # fresh thread: nothing saved
+        except Exception:
+            pass
     try:
         while not core.token.cancelled:
             t_wait0 = time.perf_counter()

@@ -567,7 +567,7 @@ class _InflightStep:
     spec needed to resubmit it after a gang restart (the window is bounded
     by depth, so holding specs is bounded memory)."""
     __slots__ = ("idx", "refs", "fetch", "fn", "args", "kwargs",
-                 "dispatched_at")
+                 "dispatched_at", "trace_ctx")
 
     def __init__(self, idx, refs, fetch, fn, args, kwargs, dispatched_at):
         self.idx = idx
@@ -577,6 +577,10 @@ class _InflightStep:
         self.args = args
         self.kwargs = kwargs
         self.dispatched_at = dispatched_at
+        # One trace per step: minted at first dispatch, reused for the
+        # drain span and any replay re-dispatch so one step's dispatch,
+        # worker execution, and drain assemble into one timeline.
+        self.trace_ctx = None
 
 
 def _pipeline_metrics():
@@ -676,17 +680,38 @@ class StepPipeline:
 
     def _dispatch(self, step: _InflightStep) -> None:
         t0 = time.perf_counter()
-        fn_ref = self._fn_ref(step.fn)
-        step.refs = [
-            w.pipeline_step.remote(step.idx, step.fetch, fn_ref,
-                                   *step.args, **step.kwargs)
-            for w in self.group.workers
-        ]
-        step.dispatched_at = time.perf_counter()
+        from ray_tpu import observability as obs
         from ray_tpu._private import profiling
 
+        minted = False
+        if step.trace_ctx is None and obs.enabled():
+            # Join the caller's trace when one is live (e.g. a learner
+            # update_async boundary); mint a fresh per-step root else.
+            step.trace_ctx = obs.get_context()
+            if step.trace_ctx is None:
+                step.trace_ctx = obs.mint_context()
+                minted = True
+        # Dispatch inside the step's trace so every rank's
+        # pipeline_step submission (and its worker-side execution)
+        # carries this step's trace id.
+        saved = obs.set_context(step.trace_ctx) if step.trace_ctx else None
+        try:
+            fn_ref = self._fn_ref(step.fn)
+            step.refs = [
+                w.pipeline_step.remote(step.idx, step.fetch, fn_ref,
+                                       *step.args, **step.kwargs)
+                for w in self.group.workers
+            ]
+        finally:
+            if step.trace_ctx:
+                obs.set_context(saved)
+        step.dispatched_at = time.perf_counter()
+        # A freshly minted step records its dispatch AS the trace root so
+        # the rank-side execute spans (parented to the root id) anchor a
+        # real span — cross-process flow arrows need both ends.
         profiling.record_span("pipeline_dispatch", t0, step.dispatched_at,
-                              step=step.idx)
+                              step=step.idx, _trace_ctx=step.trace_ctx,
+                              _root=minted)
         if self._metrics is not None and \
                 step.idx % self.metrics_interval == 0:
             try:
@@ -697,6 +722,9 @@ class StepPipeline:
     def _recover(self, cause: exc.MeshGroupError) -> None:
         """Gang restart + window replay.  Raises (budget exhausted /
         respawn failure) with the pipeline marked broken."""
+        from ray_tpu import observability as obs
+
+        obs.flight_record(f"gang_restart: {cause}")
         try:
             self.group._restart(cause)  # raises when out of budget
         except BaseException:
@@ -731,7 +759,8 @@ class StepPipeline:
         t1 = time.perf_counter()
         from ray_tpu._private import profiling
 
-        profiling.record_span("pipeline_drain", t0, t1, step=step.idx)
+        profiling.record_span("pipeline_drain", t0, t1, step=step.idx,
+                              _trace_ctx=step.trace_ctx)
         self._inflight.popleft()
         self._drained += 1
         if step.fetch:

@@ -851,7 +851,7 @@ class _StepRec:
     dropping them at dispatch would let ref-gc free a store-resident
     activation before its consumer stage resolved it."""
     __slots__ = ("idx", "xs", "ts", "weights", "loss_refs", "apply_refs",
-                 "aux_refs", "snap", "drained")
+                 "aux_refs", "snap", "drained", "trace_ctx")
 
     def __init__(self, idx, xs, ts, weights, snap):
         self.idx = idx
@@ -863,6 +863,9 @@ class _StepRec:
         self.aux_refs: List[Any] = []
         self.snap = snap
         self.drained = False
+        # One distributed trace per step (minted at dispatch, reused for
+        # replay re-dispatch and the mpmd_stage_* spans at drain).
+        self.trace_ctx = None
 
 
 def _mpmd_metrics():
@@ -1095,6 +1098,37 @@ class MPMDPipeline:
 
     # ---- schedule dispatch (pure ref wiring — no tensors, no waits) ----
     def _dispatch_step(self, rec: _StepRec) -> None:
+        from ray_tpu import observability as obs
+
+        minted = False
+        if rec.trace_ctx is None and obs.enabled():
+            # Join the caller's trace when one is live (e.g. a learner
+            # update_async boundary); mint a fresh per-step root else.
+            rec.trace_ctx = obs.get_context()
+            if rec.trace_ctx is None:
+                rec.trace_ctx = obs.mint_context()
+                minted = True
+        if rec.trace_ctx is not None:
+            # Dispatch inside the step's trace: every stage-actor submit
+            # below inherits it, so one training step assembles into one
+            # cross-process timeline.
+            import time as _time
+
+            from ray_tpu._private import profiling
+
+            t0 = _time.perf_counter()
+            with obs.use_context(rec.trace_ctx):
+                self._dispatch_step_inner(rec)
+            # A freshly minted step records its dispatch AS the trace
+            # root: the stage actors' execute spans parent to the root
+            # id, and flow arrows need that span to exist.
+            profiling.record_span("mpmd_step_dispatch", t0,
+                                  _time.perf_counter(), step=rec.idx,
+                                  _trace_ctx=rec.trace_ctx, _root=minted)
+            return
+        self._dispatch_step_inner(rec)
+
+    def _dispatch_step_inner(self, rec: _StepRec) -> None:
         if rec.snap:
             refs = [h.submit("snapshot", [() for _ in range(h.width)])
                     for h in self._handles]
@@ -1280,6 +1314,9 @@ class MPMDPipeline:
 
     def _recover(self, cause: exc.MeshGroupError) -> None:
         """All-or-nothing gang restart + in-order schedule replay."""
+        from ray_tpu import observability as obs
+
+        obs.flight_record(f"mpmd_gang_restart: {cause}")
         if self.restart_count >= self.max_restarts:
             cause.restarts = self.restart_count
             self._abort(teardown=False)
@@ -1371,7 +1408,7 @@ class MPMDPipeline:
                     {"F": "mpmd_stage_fwd", "B": "mpmd_stage_bwd",
                      "A": "mpmd_stage_apply", "X": "mpmd_stage_transfer"}
                     [o["kind"]], o["start"], o["end"], stage=o["stage"],
-                    step=o["step"], mb=o["mb"])
+                    step=o["step"], mb=o["mb"], _trace_ctx=rec.trace_ctx)
             if self._metrics is not None:
                 m = self._metrics
                 m["bubble"].set(bubble)

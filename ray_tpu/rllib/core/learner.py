@@ -385,12 +385,17 @@ class DistributedLearnerGroup:
         which lag the submitted step by up to pipeline_depth steps — or
         None before the first fetch step drains."""
         import ray_tpu
+        from ray_tpu.util import tracing
 
         if self._pipeline is None:
             raise RuntimeError(
                 "pipelined updates need pipeline_depth > 0 at construction")
-        batch_ref = ray_tpu.put(batch)
-        self._pipeline.submit(_learner_update_device, batch_ref)
+        # Driver API boundary: each update step (batch put + gang
+        # dispatch + drain spans) rides one distributed trace, rooted
+        # at this span.
+        with tracing.span("learner.update_async"):
+            batch_ref = ray_tpu.put(batch)
+            self._pipeline.submit(_learner_update_device, batch_ref)
         return self._last_metrics
 
     def checkpoint_weights_async(self, step: Optional[int] = None) -> None:

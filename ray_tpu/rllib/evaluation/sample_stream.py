@@ -134,6 +134,17 @@ class SampleStream:
                 self._metrics = _stream_metrics()
             except Exception:
                 self._metrics = None
+        # One distributed trace per stream lifetime: every fragment
+        # dispatch and rollout_* span joins it, so a whole rollout run
+        # assembles into a single cross-process timeline.
+        self.trace_ctx = None
+        try:
+            from ray_tpu import observability as obs
+
+            if obs.enabled():
+                self.trace_ctx = obs.get_context() or obs.mint_context()
+        except Exception:
+            pass
 
     # ---- weights ---------------------------------------------------------
     @property
@@ -147,17 +158,28 @@ class SampleStream:
         from ray_tpu._private import profiling
 
         profiling.record_span("rollout_publish_weights", t0,
-                              time.perf_counter(), version=version)
+                              time.perf_counter(), version=version,
+                              _trace_ctx=self.trace_ctx)
         return version
 
     # ---- production ------------------------------------------------------
     def _refill(self) -> None:
         """Top every healthy worker's window up to the in-flight cap."""
-        for i, w in enumerate(self.workers.workers):
-            win = self._windows[i]
-            while not win.full:
-                fut = w.sample_fragment.remote(self.kind)
-                win.append(_Pending(fut, w, i, time.monotonic()))
+        ctx = None
+        if self.trace_ctx is not None:
+            from ray_tpu import observability as obs
+
+            ctx = obs.use_context(self.trace_ctx)
+            ctx.__enter__()
+        try:
+            for i, w in enumerate(self.workers.workers):
+                win = self._windows[i]
+                while not win.full:
+                    fut = w.sample_fragment.remote(self.kind)
+                    win.append(_Pending(fut, w, i, time.monotonic()))
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
 
     def _drop_window(self, i: int) -> None:
         """Abandon a dead handle's queued fragments: cancel what never
@@ -236,7 +258,8 @@ class SampleStream:
             from ray_tpu._private import profiling
 
             profiling.record_span("rollout_wait", t_wait0, t1,
-                                  worker=pend.worker_index, lag=lag)
+                                  worker=pend.worker_index, lag=lag,
+                                  _trace_ctx=self.trace_ctx)
             steps = int(info.get("env_steps", 0))
             self.fragments_consumed += 1
             self.steps_consumed += steps

@@ -120,6 +120,40 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_timeline(args):
+    import ray_tpu
+
+    _connect()
+    events = ray_tpu.timeline(filename=args.output,
+                              trace_id=args.trace_id)
+    if args.output:
+        print(f"wrote {len(events)} events to {args.output}",
+              file=sys.stderr)
+    else:
+        print(json.dumps(events, default=str))
+    ray_tpu.shutdown()
+    return 0
+
+
+def cmd_traces(args):
+    import ray_tpu
+    from ray_tpu import state
+
+    _connect()
+    if args.summary:
+        print(json.dumps(state.summarize_spans(), indent=2, default=str))
+    else:
+        rows = state.list_traces(limit=args.limit)
+        print(f"{'trace_id':>18} {'spans':>7} {'bytes':>10} "
+              f"{'procs':>5} {'nodes':>5} {'duration_s':>10}")
+        for r in rows:
+            print(f"{r['trace_id'][:16]:>18} {r['spans']:>7} "
+                  f"{r['bytes']:>10} {r['procs']:>5} {r['nodes']:>5} "
+                  f"{r['duration']:>10.3f}")
+    ray_tpu.shutdown()
+    return 0
+
+
 def _job_client():
     info = _read_connect_file()
     from ray_tpu.job_submission import JobSubmissionClient
@@ -195,6 +229,18 @@ def main(argv=None):
     s = sub.add_parser("memory", help="object store contents")
     s.add_argument("--limit", type=int, default=20)
     s.set_defaults(fn=cmd_memory)
+    s = sub.add_parser("timeline", help="chrome://tracing dump "
+                       "(tasks + cluster spans)")
+    s.add_argument("--trace-id", default=None,
+                   help="assemble one distributed trace only")
+    s.add_argument("-o", "--output", default=None,
+                   help="write JSON here instead of stdout")
+    s.set_defaults(fn=cmd_timeline)
+    s = sub.add_parser("traces", help="stored distributed traces")
+    s.add_argument("--limit", type=int, default=20)
+    s.add_argument("--summary", action="store_true",
+                   help="per-span-family rollup instead of trace rows")
+    s.set_defaults(fn=cmd_traces)
     s = sub.add_parser("stop", help="stop the head")
     s.set_defaults(fn=cmd_stop)
 

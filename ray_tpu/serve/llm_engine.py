@@ -203,6 +203,10 @@ class _Request:
     # was sampled under (swap_weights bumps the engine version).
     out_logps: List[float] = dataclasses.field(default_factory=list)
     out_versions: List[int] = dataclasses.field(default_factory=list)
+    # Distributed trace the request was submitted under (the caller's
+    # (trace_id, span_id) pair); engine step spans stamp it so a serve
+    # request's decode steps land in the client's timeline.
+    trace_ctx: Optional[tuple] = None
 
     def context(self) -> List[int]:
         """Prompt plus generated-so-far — what a (re)admission prefills.
@@ -441,13 +445,21 @@ class LLMEngine:
                 top_p=1.0 if top_p is None else float(top_p),
                 seed=0 if seed is None else int(seed))
         sampling.validate()
+        trace_ctx = None
+        try:
+            from ray_tpu import observability as obs
+
+            if obs.enabled():
+                trace_ctx = obs.get_context()
+        except Exception:
+            pass
         with self._cond:
             if self._closed:
                 raise EngineClosedError("engine is closed")
             rid = self._next_id
             self._next_id += 1
             req = _Request(rid, prompt, max_new_tokens, eos_id,
-                           sampling=sampling)
+                           sampling=sampling, trace_ctx=trace_ctx)
             self._requests[rid] = req
             self._pending.append(req)
             self._cond.notify_all()
@@ -974,8 +986,27 @@ class LLMEngine:
         except BaseException as e:  # noqa: BLE001 — fail loudly per req
             self._fail_all(e)
             return
-        self._work_s += time.perf_counter() - t_work0
+        t_work1 = time.perf_counter()
+        self._work_s += t_work1 - t_work0
+        self._record_step_span(t_work0, t_work1)
         self._flush_metrics()
+
+    def _record_step_span(self, t0: float, t1: float) -> None:
+        """Stamp the engine iteration onto an active request's trace so a
+        serve request's decode steps assemble into the client's timeline.
+        Free when no in-flight request carries a context."""
+        ctx = None
+        for req in self._slot_req.values():
+            if req.trace_ctx is not None:
+                ctx = tuple(req.trace_ctx)
+                break
+        if ctx is None:
+            return
+        from ray_tpu._private import profiling
+
+        profiling.record_span("serve_engine_step", t0, t1,
+                              active=int(self._active.sum()),
+                              _trace_ctx=ctx)
 
     # ------------------------------------------------------------------
     # hot weight swap (loop thread only)
@@ -1921,21 +1952,26 @@ def generate_many(handle, prompts, max_new_tokens: int = 16,
     cached KV pages (see serve/prefix_cache.py)."""
     import ray_tpu
     from ray_tpu.serve.prefix_cache import affinity_key
+    from ray_tpu.util import tracing
 
-    groups: Dict[str, List[int]] = {}
-    for i, p in enumerate(prompts):
-        groups.setdefault(affinity_key(p), []).append(i)
-    out: List[Optional[List[int]]] = [None] * len(prompts)
-    calls = []
-    for key, idxs in groups.items():
-        refs = ray_tpu.put_many(
-            [np.asarray(prompts[i], np.int32) for i in idxs])
-        samp = [sampling[i] for i in idxs] if sampling else None
-        calls.append((idxs, handle.method("generate_batch").remote(
-            refs, max_new_tokens, eos_id, True, samp, _affinity=key)))
-    for idxs, call in calls:
-        out_refs = ray_tpu.get(call, timeout=timeout)
-        vals = ray_tpu.get_many(out_refs)
-        for i, v in zip(idxs, vals):
-            out[i] = [int(t) for t in v]
-    return out
+    # Driver API boundary: the whole request batch (put_many, actor
+    # calls, get_many gather, replica decode steps) rides one trace,
+    # rooted at this span.
+    with tracing.span("serve.generate_many", requests=len(prompts)):
+        groups: Dict[str, List[int]] = {}
+        for i, p in enumerate(prompts):
+            groups.setdefault(affinity_key(p), []).append(i)
+        out: List[Optional[List[int]]] = [None] * len(prompts)
+        calls = []
+        for key, idxs in groups.items():
+            refs = ray_tpu.put_many(
+                [np.asarray(prompts[i], np.int32) for i in idxs])
+            samp = [sampling[i] for i in idxs] if sampling else None
+            calls.append((idxs, handle.method("generate_batch").remote(
+                refs, max_new_tokens, eos_id, True, samp, _affinity=key)))
+        for idxs, call in calls:
+            out_refs = ray_tpu.get(call, timeout=timeout)
+            vals = ray_tpu.get_many(out_refs)
+            for i, v in zip(idxs, vals):
+                out[i] = [int(t) for t in v]
+        return out

@@ -56,3 +56,27 @@ def summarize_actors() -> dict:
 def summarize_objects() -> dict:
     objs = list_objects()
     return {"total": len(objs), "total_bytes": sum(o["size"] for o in objs)}
+
+
+# ---- tracing plane (see ray_tpu.observability) ----
+def list_traces(limit: int = 50) -> List[dict]:
+    """Traces the head's TraceStore currently holds, biggest first."""
+    from ray_tpu import _worker
+
+    return _worker().transport.request("traces", {"limit": limit})
+
+
+def get_timeline(trace_id: str | None = None) -> dict:
+    """Raw timeline material for one trace (or everything): task rows +
+    spans.  ``ray_tpu.timeline()`` assembles the chrome dump from this."""
+    from ray_tpu import _worker
+
+    return _worker().transport.request("trace_timeline",
+                                       {"trace_id": trace_id})
+
+
+def summarize_spans() -> dict:
+    """Per-span-family counts/seconds plus TraceStore budget stats."""
+    from ray_tpu import _worker
+
+    return _worker().transport.request("span_summary", {})

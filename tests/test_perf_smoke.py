@@ -19,6 +19,7 @@ from tools.perf_smoke import (
     run_rpc_chaos_smoke,
     run_serving_smoke,
     run_smoke,
+    run_tracing_smoke,
     run_zero_smoke,
 )
 
@@ -194,6 +195,39 @@ def test_flow_usage_static_check():
     assert not result["stale_allowlist"], (
         "allowlist entries no longer hand-roll pipelines — remove them "
         f"from tools/check_flow_usage.py: {result['stale_allowlist']}")
+
+
+def test_tracing_smoke(shutdown_only):
+    """The tracing plane must be free when off (zero spans recorded, the
+    small-put rate unchanged within noise after an enable→disable
+    cycle) and assemble when on: one driver boundary produces a single
+    trace whose spans span >= 3 processes on >= 2 virtual nodes, with
+    the chrome dump json-clean and carrying cross-process flow edges —
+    the tier-1 guard for the observability PR."""
+    out = run_tracing_smoke()
+    assert out["off_zero_spans"] and out["off_still_zero_spans"], out
+    assert out["off_overhead_ok"], f"tracing-off path got slower: {out}"
+    assert out["assembled_ok"], f"trace did not assemble: {out}"
+    assert out["flow_edges"] >= 1, f"no cross-process flow edges: {out}"
+    assert out["chrome_json_ok"], out
+    assert out["ok"], out
+
+
+def test_trace_context_static_check():
+    """No NEW record_span call site may ignore trace context (orphan
+    spans never join a distributed trace), and the context-inheriting
+    allowlist only shrinks — the CI guard that keeps the span families
+    assembling into cross-process timelines."""
+    from tools.check_trace_context import scan
+
+    result = scan()
+    assert not result["violations"], (
+        "record_span call site without _trace_ctx — thread the "
+        f"step/request context through: {result['violations']}")
+    assert not result["stale_allowlist"], (
+        "allowlist entries no longer call record_span bare — remove "
+        f"them from tools/check_trace_context.py: "
+        f"{result['stale_allowlist']}")
 
 
 def test_node_loss_smoke(shutdown_only):

@@ -1,0 +1,147 @@
+"""Static check: new ``record_span`` call sites must carry trace context.
+
+The tracing plane (ray_tpu/observability/) assembles cross-process
+timelines by trace id; a ``profiling.record_span`` call that neither
+passes ``_trace_ctx=`` nor runs on a thread with an installed context
+produces orphan spans that land in the "untraced" bucket and never join
+a distributed trace.  This check keeps the orphan-site count
+monotonically SHRINKING: every ``record_span(`` call site under
+``ray_tpu/`` (outside ``_private`` plumbing, where ``record_span``
+itself lives) must either pass ``_trace_ctx=`` explicitly or be on the
+allowlist of sites known to run with a thread-local context already
+installed (e.g. flow stage workers install their creator's context at
+thread start).
+
+- A NEW bare call site outside the allowlist fails the check: thread the
+  step/request context through as ``_trace_ctx=`` (see
+  docs/OBSERVABILITY.md, "Stamping spans").
+- An allowlisted site that now passes ``_trace_ctx=`` (or disappeared)
+  also fails: remove the stale entry, so the list can only shrink.
+
+Run standalone (``python tools/check_trace_context.py``) or through the
+tier-1 wrapper in tests/test_perf_smoke.py.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Bare record_span sites that rely on a thread-local context being
+# active (or predate the tracing plane).  Keyed "path:first_arg" — the
+# call's span-name argument text, so the entry survives reformatting but
+# dies with the call site.
+ALLOWLIST = {
+    # flow stage workers install the creating thread's context at
+    # thread start (_stage_worker), so the per-item span inherits it.
+    "ray_tpu/parallel/flow.py:core.span",
+    # checkpoint spans: snapshot/persist run on rank workers inside
+    # execute_task (spec context installed) or the background persist
+    # thread; commit runs driver-side.  Not yet threaded per-step.
+    "ray_tpu/checkpoint/saver.py:\"checkpoint_snapshot\"",
+    "ray_tpu/checkpoint/saver.py:\"checkpoint_persist\"",
+    "ray_tpu/checkpoint/coordinator.py:\"checkpoint_commit\"",
+}
+
+# record_span itself (and the worker/head plumbing that stamps context
+# structurally) lives under _private.
+EXEMPT_PREFIXES = ("ray_tpu/_private/",)
+
+_CALL_RE = re.compile(r"\brecord_span\s*\(")
+
+
+def _iter_py_files() -> List[str]:
+    out = []
+    pkg_root = os.path.join(REPO_ROOT, "ray_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        for fn in filenames:
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                out.append(os.path.relpath(path, REPO_ROOT))
+    return sorted(out)
+
+
+def _call_text(text: str, open_paren: int) -> str:
+    """The call's argument text, from ``(`` to its matching ``)``."""
+    depth = 0
+    for i in range(open_paren, len(text)):
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return text[open_paren:i + 1]
+    return text[open_paren:]
+
+
+def _first_arg(call: str) -> str:
+    """First argument's source text (the span name), braces-aware."""
+    body = call[1:]  # drop the opening paren
+    depth = 0
+    for i, c in enumerate(body):
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            if depth == 0:
+                return body[:i].strip()
+            depth -= 1
+        elif c == "," and depth == 0:
+            return body[:i].strip()
+    return body.strip()
+
+
+def scan() -> Dict[str, List[str]]:
+    """Returns {"violations": [...], "stale_allowlist": [...],
+    "flagged": [...]} (flagged = bare sites, allowlisted or not)."""
+    flagged = []
+    for rel in _iter_py_files():
+        posix = rel.replace(os.sep, "/")
+        if any(posix.startswith(p) for p in EXEMPT_PREFIXES):
+            continue
+        try:
+            with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in _CALL_RE.finditer(text):
+            if re.search(r"def\s+record_span\s*\($", text[:m.end()]):
+                continue  # a local definition, not a call
+            call = _call_text(text, m.end() - 1)
+            if "_trace_ctx" in call:
+                continue
+            name = " ".join(_first_arg(call).split())
+            flagged.append(f"{posix}:{name}")
+    flagged_set = set(flagged)
+    return {
+        "flagged": sorted(flagged_set),
+        "violations": sorted(flagged_set - ALLOWLIST),
+        "stale_allowlist": sorted(ALLOWLIST - flagged_set),
+    }
+
+
+def main() -> int:
+    result = scan()
+    ok = not result["violations"] and not result["stale_allowlist"]
+    for site in result["violations"]:
+        print(f"TRACE-CONTEXT VIOLATION: {site} calls record_span without "
+              "_trace_ctx= — thread the step/request trace context "
+              "through (docs/OBSERVABILITY.md), or (context-inheriting "
+              "threads only) discuss an allowlist entry in "
+              "tools/check_trace_context.py.")
+    for site in result["stale_allowlist"]:
+        print(f"STALE ALLOWLIST ENTRY: {site} no longer calls record_span "
+              "bare — remove it from tools/check_trace_context.py so the "
+              "list keeps shrinking.")
+    if ok:
+        print(f"trace-context check OK: {len(result['flagged'])} "
+              f"known context-inheriting sites remain "
+              f"({', '.join(result['flagged']) or 'none'})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
