@@ -135,6 +135,18 @@ CONFIG \
     .declare("transfer_pipeline_depth", int, 2,
              "Chunks kept in flight per transfer stream (read-next-"
              "while-sending); 0/1 disables pipelining.") \
+    .declare("transfer_stripe_ranges", int, 8,
+             "Target number of chunk ranges a striped pull splits an "
+             "object into (work-stealing granularity across sources).") \
+    .declare("transfer_stripe_min_bytes", int, 8 * 1024 * 1024,
+             "Objects at least this large use the striped multi-source "
+             "pull path; smaller ones keep the single-stream pull.") \
+    .declare("transfer_stripe_sources", int, 4,
+             "Max concurrent source streams per striped pull.") \
+    .declare("transfer_coop_broadcast", bool, True,
+             "Receivers advertise partially-pulled objects as chunk-"
+             "range sources (dissemination tree for one-to-N broadcast) "
+             "and coalesce concurrent same-object pulls.") \
     .declare("segment_pool", bool, True,
              "Recycle shm segments across puts through size-class free "
              "lists instead of create/unlink per object.") \

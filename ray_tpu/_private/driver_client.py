@@ -51,13 +51,22 @@ class RemoteDriverRuntime:
         start = _time.monotonic()
         try:
             self.xfer = ObjectTransferServer(self.store, authkey)
-            try:
-                self.conn = Client((host, int(port)), family="AF_INET",
-                                   authkey=authkey)
-            except (OSError, EOFError) as e:
-                raise HeadConnectionError(
-                    address, elapsed=_time.monotonic() - start,
-                    socket_connected=False, detail=str(e)) from e
+            # A head that just forked may have written its authkey file
+            # before its listener accepts — retry refused connects within
+            # the caller's timeout instead of failing on the first RST.
+            while True:
+                try:
+                    self.conn = Client((host, int(port)), family="AF_INET",
+                                       authkey=authkey)
+                    break
+                except (OSError, EOFError) as e:
+                    refused = isinstance(e, ConnectionRefusedError)
+                    if refused and _time.monotonic() - start < timeout:
+                        _time.sleep(0.1)
+                        continue
+                    raise HeadConnectionError(
+                        address, elapsed=_time.monotonic() - start,
+                        socket_connected=False, detail=str(e)) from e
             self.transport = ConnTransport(self.conn, authkey)
             self.node_id: Optional[NodeID] = None
             self._registered = threading.Event()

@@ -101,7 +101,7 @@ class ObjectEntry:
     __slots__ = (
         "object_id", "locations", "inline", "holders", "lineage_task",
         "size", "meta", "spilled_path", "lost", "segments",
-        "spill", "spill_host", "contained",
+        "spill", "spill_host", "contained", "partials",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -135,6 +135,13 @@ class ObjectEntry:
         # never die while something can still reach it through the outer
         # ref (reference: contained-ref handover, reference_count.h:543).
         self.contained: Optional[List[ObjectID]] = None
+        # Cooperative-broadcast partial holders: sender key (worker id /
+        # node key) -> {"addr", "chunk", "total", "chunks": set, "host"}.
+        # A receiver mid-pull advertises the chunk ranges it has landed
+        # so concurrent pullers stripe off it instead of the owner; the
+        # record dies with its process (or on its drop notify).  None
+        # until the first advertisement — most objects never have one.
+        self.partials: Optional[Dict[bytes, dict]] = None
 
 
 class TaskEvent:

@@ -145,6 +145,10 @@ class Head:
         self.node_host: Dict[NodeID, str] = {}       # node -> host key
         self.node_xfer: Dict[NodeID, tuple] = {}      # node -> (ip, port)
         self._local_xfer: Dict[NodeID, Any] = {}      # local transfer servers
+        # Cooperative-broadcast reverse index: partial-holder key (worker
+        # id / node key) -> oids it advertised, so a process death clears
+        # its advertisements in O(its objects), not O(all objects).
+        self._partial_index: Dict[bytes, set] = defaultdict(set)
         self._driver_hosts: Dict[bytes, str] = {}     # remote driver host keys
         self._driver_nodes: Dict[bytes, NodeID] = {}  # driver wid -> pseudo node
         self._driver_conns: Dict[bytes, Any] = {}     # driver wid -> live conn
@@ -543,6 +547,7 @@ class Head:
             self.gcs.remove_node(node_id)
             self.node_host.pop(node_id, None)
             self.node_xfer.pop(node_id, None)
+            self._drop_partials_for(b"na:" + node_id.binary())
             srv = self._local_xfer.pop(node_id, None)
             if srv is not None:
                 srv.shutdown()
@@ -708,6 +713,16 @@ class Head:
                 elif mtype == "object_replicated":
                     if agent_node is not None:
                         self.on_object_replicated(agent_node, msg)
+                elif mtype == "object_partial":
+                    if agent_node is not None:
+                        host = self.node_host.get(agent_node)
+                    elif driver_wid is not None:
+                        host = self._driver_hosts.get(driver_wid)
+                    else:
+                        host = self._caller_host(worker_id)
+                    self.on_object_partial(msg, host)
+                elif mtype == "object_partial_drop":
+                    self.on_object_partial_drop(msg)
                 elif mtype == "object_evicted":
                     nid = agent_node or (driver_wid and
                                          self._driver_nodes.get(driver_wid))
@@ -851,6 +866,7 @@ class Head:
         with self._lock:
             self._driver_hosts.pop(driver_wid, None)
             self._driver_conns.pop(driver_wid, None)
+            self._drop_partials_for(driver_wid)
             node_id = self._driver_nodes.pop(driver_wid, None)
         if node_id is not None:
             self.remove_node(node_id)
@@ -1132,6 +1148,10 @@ class Head:
                 lambda m: self.on_worker_blocked(WorkerID(m["worker_id"])),
             "worker_unblocked":
                 lambda m: self.on_worker_unblocked(WorkerID(m["worker_id"])),
+            "object_partial":
+                lambda m: self.on_object_partial(m,
+                                                 self._caller_host(caller)),
+            "object_partial_drop": self.on_object_partial_drop,
         }.get(t)
         if fn is None:
             reply(error=ValueError(f"notify_msg cannot route {t!r}"))
@@ -1804,11 +1824,21 @@ class Head:
         if isinstance(raylet, RemoteRaylet):
             # The agent pulls into its own store and acks with
             # object_replicated (the durability wire protocol), which
-            # registers the location and completes the record.
-            raylet.send_agent({"type": "store_pull", "oid": oid.binary(),
-                               "addr": list(addrs[0]),
-                               "addrs": [list(a) for a in addrs],
-                               "size": size, "meta": entry.meta})
+            # registers the location and completes the record.  Partial
+            # holders ride along so the agent stripes a big prefetch
+            # across every source instead of one stream off addrs[0].
+            msg = {"type": "store_pull", "oid": oid.binary(),
+                   "addr": list(addrs[0]),
+                   "addrs": [list(a) for a in addrs],
+                   "size": size, "meta": entry.meta}
+            psources, pchunk, _ = self._partial_sources_locked(
+                entry, chosen_host)
+            if psources:
+                seen = {tuple(a) for a in addrs}
+                msg["sources"] = [[list(a), None] for a in addrs] + [
+                    s for s in psources if tuple(s[0]) not in seen]
+                msg["chunk"] = pchunk
+            raylet.send_agent(msg)
         else:
             if self._prefetch_q is None:
                 import queue as _queue
@@ -2158,6 +2188,7 @@ class Head:
         return None, None
 
     def _handle_worker_death(self, handle: WorkerHandle, cause: str):
+        self._drop_partials_for(handle.worker_id.binary())
         if handle.leased_to is not None:
             # Leased worker died: return the lease's held resources.  The
             # lessee sees the channel break and handles its own in-flight
@@ -2417,6 +2448,83 @@ class Head:
             self._link_contained(oid, msg.get("contained"))
             self._notify_object(oid)
 
+    # ----- cooperative broadcast: partial-holder directory -----
+    def on_object_partial(self, msg: dict, host: Optional[str]):
+        """A receiver mid-pull advertises chunk ranges it has landed; the
+        record makes it a stripe source for concurrent pullers (torrent-
+        style dissemination).  Dies with its process (death hooks call
+        _drop_partials_for) or on the explicit drop notify after seal."""
+        oid = ObjectID(msg["oid"])
+        key = msg["key"]
+        with self._lock:
+            entry = self.gcs.object_lookup(oid)
+            if entry is None or entry.inline is not None:
+                return
+            p = entry.partials
+            if p is None:
+                p = entry.partials = {}
+            rec = p.get(key)
+            if rec is None:
+                rec = p[key] = {"addr": tuple(msg["addr"]),
+                                "chunk": int(msg["chunk"]),
+                                "total": int(msg["total"]),
+                                "chunks": set(),
+                                "host": host or self.host_key}
+                self._partial_index[key].add(oid)
+            rec["chunks"].update(msg.get("chunks") or ())
+
+    def on_object_partial_drop(self, msg: dict):
+        oid = ObjectID(msg["oid"])
+        key = msg["key"]
+        with self._lock:
+            entry = self.gcs.object_lookup(oid)
+            if entry is not None and entry.partials:
+                entry.partials.pop(key, None)
+                if not entry.partials:
+                    entry.partials = None
+            oids = self._partial_index.get(key)
+            if oids is not None:
+                oids.discard(oid)
+                if not oids:
+                    self._partial_index.pop(key, None)
+
+    def _drop_partials_for(self, key: bytes) -> None:
+        """Clear every partial advertisement a dead process made (under
+        the head lock): a vanished peer must not be handed out as a
+        stripe source — pullers would burn a range timeout on it."""
+        for oid in self._partial_index.pop(key, ()):
+            entry = self.gcs.object_lookup(oid)
+            if entry is not None and entry.partials:
+                entry.partials.pop(key, None)
+                if not entry.partials:
+                    entry.partials = None
+
+    def _partial_sources_locked(self, entry, exclude_host: str):
+        """(sources, chunk) for a pull resolution: every cross-host
+        partial holder with at least one landed chunk, uniform chunk
+        unit (mixed-config advertisers are skipped — range alignment
+        needs one unit).  Also reports whether a SAME-host pull is in
+        progress (the segment-coalescing hint for _pull_once)."""
+        sources: list = []
+        chunk = None
+        local = False
+        if entry.partials:
+            for rec in entry.partials.values():
+                if rec["host"] == exclude_host:
+                    local = True
+                    continue
+                if not rec["chunks"]:
+                    continue
+                if chunk is None:
+                    chunk = rec["chunk"]
+                elif rec["chunk"] != chunk:
+                    continue
+                sources.append([list(rec["addr"]),
+                                sorted(rec["chunks"])])
+                if len(sources) >= 16:
+                    break
+        return sources, chunk, local
+
     def _caller_host(self, caller: Optional[WorkerID]) -> str:
         """Host key of the process asking for an object."""
         if caller is None:
@@ -2491,8 +2599,34 @@ class Head:
             if addr is not None:
                 addrs.append(list(addr))
         if addrs:
-            return {"kind": "pull", "oid": oid, "addr": addrs[0],
-                    "addrs": addrs, "size": entry.size}
+            out = {"kind": "pull", "oid": oid, "addr": addrs[0],
+                   "addrs": addrs, "size": entry.size}
+            # Serialization meta rides along so a striped pull can seal
+            # even when every byte came from meta-less partial holders.
+            meta = entry.meta
+            if meta is None:
+                for node_id in entry.locations:
+                    raylet = self.raylets.get(node_id)
+                    if raylet is not None and not isinstance(
+                            raylet.store, RemoteStoreProxy):
+                        m = raylet.store.meta(oid)
+                        if m is not None:
+                            meta = m
+                            break
+            if meta is not None:
+                out["meta"] = meta
+            psources, pchunk, local = self._partial_sources_locked(entry, ch)
+            if psources:
+                seen = {tuple(a) for a in addrs}
+                out["sources"] = [[a, None] for a in addrs] + [
+                    s for s in psources if tuple(s[0]) not in seen]
+                out["chunk"] = pchunk
+            if local:
+                # Someone on the caller's host is mid-pull on this very
+                # object: the caller should wait for that seal instead
+                # of racing the canonical segment create.
+                out["local_partial"] = True
+            return out
         # Directory-side spill record readable on the caller's host: the
         # owning store (node) is gone but its file survives.
         if entry.spill is not None \
@@ -2636,6 +2770,13 @@ class Head:
             raylet = self.raylets.get(node_id)
             if raylet is not None:
                 raylet.store.delete(oid)
+        if entry.partials:
+            for key in entry.partials:
+                oids = self._partial_index.get(key)
+                if oids is not None:
+                    oids.discard(oid)
+                    if not oids:
+                        self._partial_index.pop(key, None)
         contained = entry.contained
         self.gcs.free_object(oid)
         if contained:

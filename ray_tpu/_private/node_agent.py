@@ -227,16 +227,20 @@ class NodeAgent:
 
                 self._xfer_client = TransferClient(self.authkey)
             meta = data = None
-            for attempt in range(5):
-                for addr in addrs:
-                    try:
-                        meta, data = self._xfer_client.pull(addr, oid)
+            striped = self._store_pull_striped(oid, msg)
+            if striped is not None:
+                meta, data = striped
+            if data is None:
+                for attempt in range(5):
+                    for addr in addrs:
+                        try:
+                            meta, data = self._xfer_client.pull(addr, oid)
+                            break
+                        except Exception:
+                            meta = data = None
+                    if data is not None or self._shutdown.is_set():
                         break
-                    except Exception:
-                        meta = data = None
-                if data is not None or self._shutdown.is_set():
-                    break
-                time.sleep(0.05 * (2 ** attempt))
+                    time.sleep(0.05 * (2 ** attempt))
             if data is None:
                 return
             seg = self.store.put_replica(oid, meta, data)
@@ -244,6 +248,78 @@ class NodeAgent:
                        "size": len(data), "meta": meta, "segment": seg})
         except Exception:
             traceback.print_exc()
+
+    def _store_pull_striped(self, oid: ObjectID, msg: dict):
+        """Multi-source leg of the replica/prefetch pull: stripe chunk
+        ranges across every holder the head named (full holders + any
+        cooperative partial holders in ``sources``), advertising our own
+        landed ranges so concurrent pullers of the same object feed off
+        this agent instead of the origin.  Returns (meta, bytes) or None
+        (any failure falls back to the single-stream retry ladder)."""
+        from ray_tpu._private.config import CONFIG
+
+        size = int(msg.get("size") or 0)
+        if size < int(CONFIG.transfer_stripe_min_bytes):
+            return None
+        coop = bool(CONFIG.transfer_coop_broadcast)
+        addrs = [tuple(a) for a in (msg.get("addrs") or [msg["addr"]])]
+        if not (coop or len(addrs) > 1 or msg.get("sources")):
+            return None
+        from ray_tpu._private import transfer as transfer_mod
+
+        chunkb = int(msg.get("chunk") or CONFIG.transfer_chunk_bytes) \
+            or transfer_mod.CHUNK
+        nchunks = max(1, (size + chunkb - 1) // chunkb)
+        own_addr = tuple(self.xfer.address)
+        src_list = [(tuple(a), set(c) if c is not None else None)
+                    for a, c in (msg.get("sources") or [])] \
+            or [(a, None) for a in addrs]
+        src_list = [s for s in src_list if s[0] != own_addr]
+        if not src_list:
+            return None
+        buf = bytearray(size)
+        key = None
+        if coop and self.node_id is not None:
+            key = b"na:" + self.node_id.binary()
+            self.xfer.register_partial(oid, buf, size, chunkb)
+
+        def progress(off, ln):
+            if key is None:
+                return
+            fresh = self.xfer.mark_range(oid, off, ln)
+            if fresh:
+                try:
+                    self.send({"type": "object_partial",
+                               "oid": oid.binary(), "key": key,
+                               "addr": list(own_addr), "chunk": chunkb,
+                               "total": nchunks, "chunks": fresh,
+                               "size": size})
+                except Exception:
+                    pass
+
+        try:
+            meta, _stats = transfer_mod.pull_striped(
+                self._xfer_client, oid, size, src_list,
+                memoryview(buf), meta_hint=msg.get("meta"),
+                chunk=chunkb, progress=progress)
+            if meta is None:
+                return None
+            if key is not None:
+                self.xfer.complete_partial(oid, meta)
+            return meta, buf  # bytes-like: put_replica copies it once
+        except Exception:
+            return None
+        finally:
+            if key is not None:
+                # put_replica lands the bytes in OUR store, which the
+                # object_replicated ack registers as a full holder — the
+                # in-progress partial advertisement is obsolete either way.
+                self.xfer.drop_partial(oid)
+                try:
+                    self.send({"type": "object_partial_drop",
+                               "oid": oid.binary(), "key": key})
+                except Exception:
+                    pass
 
     def _heartbeat_loop(self):
         """Liveness lease renewal: the head declares this node dead when

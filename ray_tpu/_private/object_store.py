@@ -494,6 +494,11 @@ class SharedMemoryStore:
             spec = CONFIG.segment_pool_prewarm
             if spec:
                 self.pool.prewarm(spec)
+        # Monotone create counter: "no new segments appeared here" checks
+        # (e.g. the cooperative-broadcast smoke asserting the owner's
+        # store stayed untouched) can't be fooled by a create+delete pair
+        # the way num_objects can.
+        self.segments_created_total = 0
 
     # -- create/seal ------------------------------------------------------
     def create(self, object_id: ObjectID, data_size: int,
@@ -545,6 +550,7 @@ class SharedMemoryStore:
             obj = PlasmaObject(shm, data_size, pool_class=pool_class)
             self._objects[object_id] = obj
             self.used += data_size
+            self.segments_created_total += 1
             return obj.view()
 
     def segment_of(self, object_id: ObjectID) -> Optional[str]:
@@ -847,6 +853,7 @@ class SharedMemoryStore:
                 "used_bytes": self.used,
                 "capacity_bytes": self.capacity,
                 "num_pinned": len(self._pinned),
+                "segments_created_total": self.segments_created_total,
             }
             if self.pool is not None:
                 out.update(self.pool.stats())

@@ -195,6 +195,10 @@ class DirectTransport:
             self.head.on_arena_sealed(msg)
         elif t == "arena_release":
             self.head.on_arena_release(msg)
+        elif t == "object_partial":
+            self.head.on_object_partial(msg, self.head.host_key)
+        elif t == "object_partial_drop":
+            self.head.on_object_partial_drop(msg)
 
     def store_for(self, node_id):
         """In-process fast path: the driver writes straight into the head
@@ -752,6 +756,15 @@ class CoreWorker:
         self._value_cache: "OrderedDict[ObjectID, Any]" = OrderedDict()
         self._value_cache_cap = 256
         self._shm_registry: Dict[ObjectID, Any] = {}
+        # Same-oid pull coalescing (thread level): oid -> (Event, leader
+        # thread id).  Followers wait on the leader's seal instead of
+        # racing the canonical segment create / duplicating wire bytes.
+        self._pulls_inflight: Dict[ObjectID, tuple] = {}
+        self._pulls_lock = threading.Lock()
+        # Cooperative-broadcast peer server: serves ranges of objects
+        # THIS process is still pulling (lazily started on first striped
+        # pull with transfer_coop_broadcast on).
+        self._peer_srv = None
         self._func_cache: Dict[bytes, Callable] = {}
         self._func_blobs: Dict[bytes, bytes] = {}
         self.actors: Dict[ActorID, Any] = {}
@@ -919,7 +932,7 @@ class CoreWorker:
             n, state = r
             if n <= 0:
                 self._value_cache.pop(oid, None)
-                self._shm_registry.pop(oid, None)
+                self._drop_local_shm(oid)
                 if state == EXTERN:
                     # Drop the mirrored holder in the head directory.
                     head_remove()
@@ -939,7 +952,7 @@ class CoreWorker:
         if rec is not None:
             if last_borrow:
                 self._value_cache.pop(oid, None)
-                self._shm_registry.pop(oid, None)
+                self._drop_local_shm(oid)
                 if self._direct is not None:
                     self._direct.unpin_at_owner(
                         oid, rec[0], b"bor:" + self.worker_id.binary())
@@ -953,7 +966,7 @@ class CoreWorker:
             last = n <= 0
         if last:
             self._value_cache.pop(oid, None)
-            self._shm_registry.pop(oid, None)
+            self._drop_local_shm(oid)
             head_remove()
 
     # ---- put ----
@@ -1238,6 +1251,10 @@ class CoreWorker:
                  timeout: Optional[float] = None) -> List[Any]:
         """Batch get: one resolve_batch round trip covers every object
         already available; stragglers fall back to the blocking path.
+        Each wire pull picks its holder least-loaded-first (in-flight
+        stream counts + observed per-peer bandwidth, see
+        TransferClient.rank_sources) so a gather burst spreads across
+        replicas instead of draining the first-listed holder.
         Semantically identical to get(list) — the name documents intent
         at call sites that gather bursts (SampleBatch gathers, dataset
         block fetches)."""
@@ -1282,7 +1299,37 @@ class CoreWorker:
         self._value_cache.move_to_end(oid)
         while len(self._value_cache) > self._value_cache_cap:
             old, _ = self._value_cache.popitem(last=False)
-            self._shm_registry.pop(old, None)
+            self._drop_local_shm(old)
+
+    def _drop_local_shm(self, oid: ObjectID) -> None:
+        """Deterministically free this process's mapping for ``oid``:
+        drop any cooperative-transfer partial record still holding the
+        buffer, then defuse the segment handle through the weak-registry
+        path (object_store.defuse_shm) so a consumer-held numpy/arrow
+        view never surfaces a BufferError from SharedMemory.__del__."""
+        h = self._shm_registry.pop(oid, None)
+        if self._peer_srv is not None:
+            try:
+                if self._peer_srv.drop_partial(oid):
+                    # Retract the directory advertisement so pullers stop
+                    # being pointed at a source that no longer serves.
+                    self.transport.notify({
+                        "type": "object_partial_drop",
+                        "oid": oid.binary(),
+                        "key": self.worker_id.binary()})
+            except Exception:
+                pass
+        if h is None:
+            return
+        from multiprocessing import shared_memory
+
+        if isinstance(h, shared_memory.SharedMemory):
+            store_mod.defuse_shm(h)
+        else:
+            try:
+                h.close()  # mmap over a spill file
+            except (BufferError, ValueError, OSError):
+                pass
 
     @contextlib.contextmanager
     def _blocked_in_get(self):
@@ -1477,7 +1524,11 @@ class CoreWorker:
         mid-pull), re-resolve through the head — which by then has run
         its node-death protocol and points at a replica, a spill restore,
         or a reconstruction — instead of erroring on the first sever.
-        Reference: pull_manager.h:52 retrying against updated locations."""
+        Reference: pull_manager.h:52 retrying against updated locations.
+
+        Concurrent same-oid pulls in THIS process coalesce: one leader
+        thread lands the bytes (one segment, one wire stream), followers
+        wait on its seal and read the cached value."""
         if not msg.get("_rechecked"):
             # Prefetch race: the scheduler may have landed these bytes in
             # THIS host's store after the resolution was handed out — one
@@ -1494,10 +1545,53 @@ class CoreWorker:
             if fresh:
                 fresh["_rechecked"] = True
                 msg = fresh
+        from ray_tpu._private import transfer as transfer_mod
+
+        cur = threading.get_ident()
+        while True:
+            with self._pulls_lock:
+                rec = self._pulls_inflight.get(oid)
+                if rec is None:
+                    ev = threading.Event()
+                    self._pulls_inflight[oid] = (ev, cur)
+                    break
+                if rec[1] == cur:
+                    # The leader's own failover hop (re-resolve path
+                    # recursing through _materialize): stay leader.
+                    return self._pull_resolved(oid, msg, _failovers)
+                ev = rec[0]
+            transfer_mod._stat_add("coalesced_pulls")
+            from ray_tpu._private.config import CONFIG
+
+            ev.wait(float(CONFIG.transfer_timeout_s) + 30.0)
+            if oid in self._value_cache:
+                self._value_cache.move_to_end(oid)
+                return self._value_cache[oid]
+            # Leader failed (its caller got the error) or we timed out:
+            # loop to take leadership and pull ourselves.
+        try:
+            return self._pull_resolved(oid, msg, _failovers)
+        finally:
+            with self._pulls_lock:
+                self._pulls_inflight.pop(oid, None)
+            ev.set()
+
+    def _pull_resolved(self, oid: ObjectID, msg: dict, _failovers: int):
+        ok, value = self._try_striped_pull(oid, msg)
+        if ok:
+            return value
         last_err: Optional[BaseException] = None
-        for addr in (msg.get("addrs") or [msg["addr"]]):
+        addr_list = list(msg.get("addrs") or [msg["addr"]])
+        if len(addr_list) > 1:
+            # Least-loaded holder first (per-peer stream counts + EWMA
+            # bandwidth): batched get_many gathers spread across
+            # replicas instead of all draining the first-listed one.
+            addr_list = self._transfer_client().rank_sources(addr_list)
+        for addr in addr_list:
             try:
-                return self._pull_once(oid, tuple(addr), msg["size"])
+                return self._pull_once(oid, tuple(addr), msg["size"],
+                                       local_partial=bool(
+                                           msg.get("local_partial")))
             except (KeyError, EOFError, OSError, BrokenPipeError) as e:
                 last_err = e  # dead/stale holder: try the next one
         if _failovers <= 0:
@@ -1513,7 +1607,189 @@ class CoreWorker:
         fresh = self.transport.request("get_locations", {"oid": oid})
         return self._materialize(oid, fresh, pull_failovers=_failovers - 1)
 
-    def _pull_once(self, oid: ObjectID, addr: tuple, size: int):
+    def _peer_server(self):
+        """This process's cooperative transfer server: store-less, serves
+        only the ranges of objects we are mid-pull on (or just sealed)."""
+        if self._peer_srv is None:
+            from ray_tpu._private.transfer import ObjectTransferServer
+
+            self._peer_srv = ObjectTransferServer(
+                None, self.transport.authkey)
+        return self._peer_srv
+
+    def _try_striped_pull(self, oid: ObjectID, msg: dict):
+        """Multi-source chunk-range pull into the canonical destination
+        segment, re-serving landed ranges to concurrent pullers
+        (cooperative broadcast).  Returns (True, value) when this path
+        landed the object; (False, None) when it does not apply or
+        failed — the caller's single-stream holder loop + head
+        re-resolution remains the correctness path."""
+        from ray_tpu._private import transfer as transfer_mod
+        from ray_tpu._private.config import CONFIG
+
+        size = int(msg.get("size") or 0)
+        if size < int(CONFIG.transfer_stripe_min_bytes):
+            return False, None
+        coop = bool(CONFIG.transfer_coop_broadcast)
+        addrs = [tuple(a) for a in (msg.get("addrs") or [msg["addr"]])]
+        if not (coop or len(addrs) > 1 or msg.get("sources")):
+            return False, None
+        shm = membuf = None
+        try:
+            from multiprocessing import shared_memory
+
+            shm = shared_memory.SharedMemory(
+                name=store_mod._segment_name(oid), create=True, size=size)
+            store_mod.untrack(shm)
+            store_mod.track_for_exit(shm)
+        except FileExistsError:
+            if msg.get("local_partial") and coop:
+                # A same-host striped pull owns the canonical segment:
+                # wait for its seal instead of pulling the bytes twice
+                # (_pull_once's local_partial path).
+                return False, None
+            # The name is taken by a puller we cannot wait on (another
+            # host's worker on a shared-/dev/shm test box, or a stale
+            # leak): stripe into an anonymous buffer — multi-source
+            # scheduling and partial serving still apply, only the
+            # zero-copy local seal is lost.
+            membuf = bytearray(size)
+        except Exception:
+            return False, None  # shm unavailable: plain path falls back
+        chunkb = int(msg.get("chunk") or CONFIG.transfer_chunk_bytes) \
+            or transfer_mod.CHUNK
+        nchunks = max(1, (size + chunkb - 1) // chunkb)
+        src_list = [(tuple(a), set(c) if c is not None else None)
+                    for a, c in (msg.get("sources") or [])] \
+            or [(a, None) for a in addrs]
+        peer = own_addr = None
+        if coop:
+            try:
+                peer = self._peer_server()
+                own_addr = tuple(peer.address)
+                src_list = [s for s in src_list if s[0] != own_addr]
+            except Exception:
+                peer = None
+        key = self.worker_id.binary()
+
+        def progress(off, ln):
+            # A landed range becomes servable + advertised: concurrent
+            # pullers of this object stripe off us from here on.
+            if peer is None:
+                return
+            fresh = peer.mark_range(oid, off, ln)
+            if fresh:
+                try:
+                    self.transport.notify({
+                        "type": "object_partial", "oid": oid.binary(),
+                        "key": key, "addr": list(own_addr),
+                        "chunk": chunkb, "total": nchunks,
+                        "chunks": fresh, "size": size})
+                except Exception:
+                    pass
+
+        def refresh():
+            # Mid-pull source discovery: the directory may have gained
+            # partial holders (other receivers of the same broadcast)
+            # since our resolution was handed out.
+            try:
+                fresh = self.transport.request(
+                    "get_locations", {"oid": oid, "recheck": True})
+            except Exception:
+                return None
+            if not fresh or fresh.get("kind") != "pull":
+                return None
+            out = []
+            for a, c in (fresh.get("sources")
+                         or [[a, None] for a in (fresh.get("addrs")
+                                                 or [])]):
+                t = tuple(a)
+                if own_addr is None or t != own_addr:
+                    out.append((t, set(c) if c is not None else None))
+            return out
+
+        import time as _time
+
+        tc = None
+        try:
+            from ray_tpu.util.tracing import tracing_enabled
+
+            if tracing_enabled():
+                tc = _obs().get_context()
+        except Exception:
+            pass
+        if peer is not None:
+            peer.register_partial(
+                oid, shm.buf if shm is not None else membuf, size, chunkb)
+        view = shm.buf[:size] if shm is not None else memoryview(membuf)
+        t0 = _time.time()
+        pulled = False
+        try:
+            meta, stats = transfer_mod.pull_striped(
+                self._transfer_client(), oid, size, src_list, view,
+                meta_hint=msg.get("meta"), chunk=chunkb, tc=tc,
+                refresh=refresh if coop else None, progress=progress)
+            if meta is None:
+                raise OSError(f"striped pull of {oid}: no source knew "
+                              "the serialization meta")
+            pulled = True
+            if peer is not None:
+                # On success the partial advertisement stays: for a
+                # sealed segment it is redundant with the full-holder
+                # entry but keeps serving already-connected pullers; for
+                # the anonymous-buffer mode it IS this process's serve
+                # surface (dropped when the object is freed).
+                peer.complete_partial(oid, meta)
+            if shm is not None:
+                self.transport.notify({
+                    "type": "seal", "oid": oid.binary(),
+                    "node_id": self.node_id.binary(), "size": size,
+                    "meta": meta})
+            if tc is not None:
+                # Puller-side stripe span for the PR 19 timeline: how
+                # many sources fed this pull and how many bytes striped.
+                try:
+                    _obs().record(
+                        "transfer.pull", t0, _time.time(), ctx=tc,
+                        oid=oid.hex(), striped_bytes=size,
+                        sources=len(stats["bytes_from"]),
+                        partial_ranges=stats["partial_ranges"])
+                except Exception:
+                    pass
+            value, _ = ser.unpack(
+                meta, shm.buf[:size] if shm is not None
+                else memoryview(membuf))
+            self._cache_value(oid, value)
+            if shm is not None:
+                self._shm_registry[oid] = shm
+            return True, value
+        except BaseException:  # noqa: BLE001 — clean up, then decide
+            if peer is not None:
+                peer.drop_partial(oid)
+                try:
+                    self.transport.notify({
+                        "type": "object_partial_drop",
+                        "oid": oid.binary(), "key": key})
+                except Exception:
+                    pass
+            if shm is not None:
+                try:
+                    store_mod.retrack(shm)  # unlink() re-unregisters
+                    shm.unlink()
+                    shm.close()
+                except Exception:
+                    pass
+            if pulled:
+                raise  # bytes landed but seal/unpack failed: a real error
+            return False, None  # wire failure: single-stream failover
+        finally:
+            try:
+                view.release()
+            except BufferError:
+                pass  # a serve thread still drains a range slice
+
+    def _pull_once(self, oid: ObjectID, addr: tuple, size: int,
+                   local_partial: bool = False):
         """One pull attempt against one holder: stream the object into
         THIS node's store, seal the local replica (so the directory
         learns the new location and neighbors read locally), then
@@ -1530,9 +1806,21 @@ class CoreWorker:
             store_mod.untrack(shm)
             store_mod.track_for_exit(shm)
         except FileExistsError:
-            # Another local reader is already landing this object; fall
-            # through to a plain in-memory pull.
+            # Another local reader is already landing this object.  When
+            # the directory said a SAME-HOST striped pull is in progress
+            # ("local_partial"), briefly wait for its seal: attaching the
+            # one canonical segment beats a redundant in-memory wire pull
+            # of the same bytes.  Otherwise keep the old immediate
+            # in-memory fallback (the creator may be another process we
+            # know nothing about — or long dead, leaking the name).
             shm = None
+            if local_partial:
+                from ray_tpu._private.config import CONFIG
+
+                if CONFIG.transfer_coop_broadcast:
+                    got = self._await_local_seal(oid)
+                    if got is not None:
+                        return got
         except Exception:
             shm = None
         try:
@@ -1569,6 +1857,32 @@ class CoreWorker:
             # KeyError ("not in this store") propagates as-is: the caller
             # fails over to the next holder / a fresh head resolution.
             raise
+
+    def _await_local_seal(self, oid: ObjectID):
+        """Bounded wait for a same-host in-progress pull to seal, then
+        materialize from its resolution (usually a local segment attach).
+        Returns None when the leader vanishes or the wait times out —
+        the caller falls back to its own in-memory pull."""
+        import time as _time
+
+        from ray_tpu._private.config import CONFIG
+
+        deadline = _time.time() + min(15.0, float(CONFIG.transfer_timeout_s))
+        while _time.time() < deadline:
+            _time.sleep(0.05)
+            try:
+                fresh = self.transport.request(
+                    "get_locations", {"oid": oid, "recheck": True})
+            except Exception:
+                return None
+            if not fresh:
+                return None
+            if fresh.get("kind") not in ("pull", None):
+                return self._materialize(oid, fresh)
+            if fresh.get("kind") == "pull" \
+                    and not fresh.get("local_partial"):
+                return None  # leader failed/vanished: pull it ourselves
+        return None
 
     def _release_arena_lease(self, oid: ObjectID):
         try:

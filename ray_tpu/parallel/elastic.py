@@ -500,6 +500,10 @@ class ElasticMeshGroup:
     def _setup_gang(self):
         snap = self._snapshot
         self._version += 1
+        # One put per rebuild; the N gang ranks resolve these refs
+        # concurrently, which the transfer plane turns into a striped
+        # cooperative broadcast (receivers serve each other's landed
+        # ranges) — rebuild cost stays ~O(snapshot/BW) as the gang grows.
         params_ref = ray_tpu.put(snap["params"])
         opt_ref = ray_tpu.put(snap["opt"]) if snap["opt"] is not None \
             else None

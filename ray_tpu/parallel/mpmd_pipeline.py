@@ -1324,6 +1324,9 @@ class MPMDPipeline:
         self.restart_count += 1
         self._generation += 1
         self._teardown_stages()
+        # The fresh stage gangs resolve these snapshot refs concurrently
+        # during setup — a cooperative striped broadcast on the transfer
+        # plane, so restart time doesn't grow with gang width.
         restore = [list(refs) for refs in self._snap[1]] \
             if self._snap is not None else None
         self._pending_snap = None  # its refs died with the old gang

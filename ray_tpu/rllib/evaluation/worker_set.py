@@ -483,7 +483,13 @@ class WorkerSet:
         actor mailbox is FIFO, so each worker applies the new version at
         its next fragment boundary ("pull between fragments") — the
         driver never waits.  Failures surface through the sample path
-        (and replacements are re-seeded from ``_weights_ref``)."""
+        (and replacements are re-seeded from ``_weights_ref``).
+
+        The N concurrent resolutions of the one ref ride the transfer
+        plane's cooperative broadcast (transfer_coop_broadcast): each
+        receiver advertises its landed chunk ranges and serves them to
+        the others, so the owner uploads ~one copy instead of N and
+        aggregate bandwidth scales with the worker count."""
         self._weights_version += 1
         self._weights_ref = ray_tpu.put(params)
         for w in self.workers:

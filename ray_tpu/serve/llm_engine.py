@@ -1900,8 +1900,10 @@ class LLMServer:
     def swap_weights(self, params, version: int,
                      timeout: Optional[float] = 60.0) -> int:
         """Hot-swap this replica's engine weights (``params`` may be the
-        broadcast ObjectRef — one learner ``put`` serves every
-        replica)."""
+        broadcast ObjectRef — one learner ``put`` serves every replica;
+        replicas resolving the same version concurrently stripe the pull
+        across holders and serve each other's landed ranges, see
+        docs/PERFORMANCE.md "Multi-source transfers")."""
         return self.engine.swap_weights(params, version, timeout=timeout)
 
     def generate_rollouts(self, prompts, max_new_tokens: int = 16,

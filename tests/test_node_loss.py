@@ -461,6 +461,137 @@ def test_stalled_node_lease_expiry_recovers_pull(monkeypatch):
         CONFIG.reset()
 
 
+def test_striped_pull_survives_holder_sigkill(monkeypatch):
+    """ISSUE 20 chaos gate: SIGKILL a holder node while a striped
+    multi-source pull is mid-flight.  The dead source's claimed ranges
+    requeue to the surviving holder (per-range failover, not a
+    whole-pull restart), the object materializes byte-exact, and a
+    second reader blocked on the same object is released too (no hung
+    waiters)."""
+    import hashlib
+
+    from ray_tpu._private import transfer
+    from ray_tpu._private.config import CONFIG
+
+    monkeypatch.setenv("RAY_TPU_TRANSFER_STRIPE_MIN_BYTES", str(1 << 20))
+    monkeypatch.setenv("RAY_TPU_TRANSFER_CHUNK_BYTES", str(256 * 1024))
+    monkeypatch.setenv("RAY_TPU_TRANSFER_STRIPE_RANGES", "16")
+    monkeypatch.setenv("RAY_TPU_TRANSFER_RETRIES", "1")
+    # Stretch every range fetch ~15ms (seeded, deterministic) so the
+    # kill below lands while most ranges are still in flight.
+    monkeypatch.setenv(chaos.NET_SCHEDULE_ENV, "pull:delay:1.0:11::15")
+    CONFIG.reset()
+    reset_recovery_stats()
+    ray_tpu.init(num_cpus=1, object_store_memory=256 * MB)
+    head = ray_tpu._head
+    agents = []
+    try:
+        agents = [start_node_agent(head, num_cpus=1,
+                                   resources={f"h{i}": 1.0},
+                                   store_capacity=128 * MB)
+                  for i in range(2)]
+        wait_for_condition(lambda: len(head.raylets) >= 3, timeout=60)
+
+        @ray_tpu.remote(resources={"h0": 1.0})
+        def make():
+            import numpy as np
+
+            import ray_tpu
+
+            rng = np.random.default_rng(7)
+            return ray_tpu.put(rng.integers(0, 256, size=24 * MB,
+                                            dtype=np.uint8))
+
+        ref = ray_tpu.get(make.remote(), timeout=90)
+        want = hashlib.sha256(np.random.default_rng(7).integers(
+            0, 256, size=24 * MB, dtype=np.uint8).tobytes()).hexdigest()
+
+        @ray_tpu.remote(resources={"h1": 1.0})
+        def warm_hold(oid_hex, hold_s):
+            import time as _t
+
+            import numpy as np
+
+            import ray_tpu
+            from ray_tpu._private.ids import ObjectID
+            from ray_tpu.object_ref import ObjectRef
+
+            # Keep the REFERENCE (not just the value) alive across the
+            # driver's pull and the holder kill: releasing the last local
+            # ref drops this process's cooperative serve surface and its
+            # partial advertisement, by design.
+            r = ObjectRef(ObjectID(bytes.fromhex(oid_hex)))
+            v = ray_tpu.get(r)
+            _t.sleep(hold_s)
+            del r
+            return int(np.asarray(v)[0])
+
+        # A reader on the second node becomes the second holder (full
+        # location or complete cooperative-partial) the directory can
+        # hand to the driver.
+        hold = warm_hold.remote(ref.hex(), 45.0)
+
+        def second_source():
+            with head._lock:
+                e = head.gcs.object_lookup(ref.id)
+                if e is None:
+                    return False
+                if len(e.locations) >= 2:
+                    return True
+                return any(len(rec["chunks"]) >= rec["total"]
+                           for rec in (e.partials or {}).values())
+        wait_for_condition(second_source, timeout=60)
+
+        before = transfer.transfer_stats()
+        killed = []
+
+        def killer():
+            # Fire once the driver's striped pull has landed its first
+            # range — mid-stripe, with ~15 ranges still outstanding.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if (transfer.transfer_stats()["ranges_completed"]
+                        > before["ranges_completed"]):
+                    break
+                time.sleep(0.001)
+            killed.append(chaos.kill_node(agents[0]))
+
+        follower_digest = []
+
+        def follower():
+            v = ray_tpu.get(ref, timeout=120)
+            follower_digest.append(
+                hashlib.sha256(np.asarray(v).tobytes()).hexdigest())
+
+        kt = threading.Thread(target=killer, daemon=True)
+        ft = threading.Thread(target=follower, daemon=True)
+        kt.start()
+        ft.start()
+        got = ray_tpu.get(ref, timeout=120)
+        assert hashlib.sha256(
+            np.asarray(got).tobytes()).hexdigest() == want
+        kt.join(timeout=60)
+        ft.join(timeout=120)
+        assert not ft.is_alive(), "second reader hung across the kill"
+        assert follower_digest == [want]
+        assert killed == [True]
+        after = transfer.transfer_stats()
+        assert after["striped_pulls"] > before["striped_pulls"]
+        assert (after["range_reassignments"]
+                > before["range_reassignments"]), (
+            "holder SIGKILL mid-stripe did not exercise per-range "
+            f"failover: {after}")
+    finally:
+        for a in agents:
+            try:
+                a.kill()
+                a.wait(timeout=10)
+            except Exception:
+                pass
+        ray_tpu.shutdown()
+        CONFIG.reset()
+
+
 # ---------------------------------------------------------------------------
 # Nightly chaos matrix: seeded node-kill sweep at varying schedule points
 # ---------------------------------------------------------------------------

@@ -6,6 +6,7 @@ import ray_tpu  # noqa: F401 — conftest sets the virtual-device env first
 
 from tools.perf_smoke import (
     run_3d_smoke,
+    run_broadcast_smoke,
     run_checkpoint_smoke,
     run_elastic_smoke,
     run_flow_smoke,
@@ -294,4 +295,25 @@ def test_replay_smoke(shutdown_only):
         f"insert path copied or leaked segments: {out}"
     assert out["gather_ok"], f"sampling issued extra gathers: {out}"
     assert out["overlap_ok"], f"no gather ran during an SGD window: {out}"
+    assert out["ok"], out
+
+
+def test_broadcast_smoke(shutdown_only):
+    """One put broadcast to 3 real node agents must stripe every pull,
+    serve at least one chunk range from a NON-owner peer (the receivers
+    formed a dissemination tree instead of all draining the owner),
+    land byte-identical copies, and create zero new segments on the
+    owner's store — the tier-1 guard for ISSUE 20's multi-source
+    cooperative-broadcast transfer plane."""
+    out = run_broadcast_smoke()
+    assert out["byte_identity"], out
+    assert out["striped_pulls"] >= out["receivers"], \
+        f"a pull fell back to single-stream: {out}"
+    assert out["ranges_from_partial"] >= 1, \
+        f"no range pulled from a partial holder: {out}"
+    assert out["peer_served_ranges"] >= 1, \
+        f"no peer served a range: {out}"
+    assert out["owner_new_segments"] == 0, \
+        f"broadcast created segments on the owner: {out}"
+    assert out["no_hang"], out
     assert out["ok"], out

@@ -1676,6 +1676,129 @@ def run_tracing_smoke(batch: int = 300, batches: int = 5) -> dict:
     return out
 
 
+def run_broadcast_smoke(receivers: int = 3, mb: int = 24) -> dict:
+    """Cooperative-broadcast invariant (tier-1 guard for ISSUE 20):
+
+    One driver put, ``receivers`` real node-agent subprocesses (distinct
+    host keys → every read is a wire pull) demand-pull the same object
+    at a synchronized instant.  The pulls must stripe (multi-range
+    scheduling engaged), at least one chunk range must be served by a
+    NON-OWNER peer (the dissemination tree formed — receivers fed each
+    other instead of all draining the owner), every copy must be
+    byte-identical, and the owner's store must create zero new segments
+    (serving is zero-copy out of the existing one).
+    """
+    import hashlib
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.config import CONFIG
+    from ray_tpu.util.testing import start_node_agent, wait_for_condition
+
+    saved = {k: os.environ.get(k) for k in
+             ("RAY_TPU_TRANSFER_STRIPE_MIN_BYTES",
+              "RAY_TPU_TRANSFER_CHUNK_BYTES",
+              "RAY_TPU_TRANSFER_STRIPE_RANGES")}
+    # Small chunks + many ranges: plenty of stealable scheduling units
+    # even on a loopback wire fast enough to finish a pull in ~100ms.
+    os.environ["RAY_TPU_TRANSFER_STRIPE_MIN_BYTES"] = str(1 << 20)
+    os.environ["RAY_TPU_TRANSFER_CHUNK_BYTES"] = str(256 * 1024)
+    os.environ["RAY_TPU_TRANSFER_STRIPE_RANGES"] = "12"
+    CONFIG.reset()
+    t0 = _time.monotonic()
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024**2,
+                 ignore_reinit_error=True)
+    agents = []
+    try:
+        head = ray_tpu._head
+        baseline = len(head.raylets)
+        agents = [start_node_agent(head, num_cpus=1,
+                                   resources={f"bc{i}": 1},
+                                   store_capacity=128 * 1024**2)
+                  for i in range(receivers)]
+        wait_for_condition(
+            lambda: len(head.raylets) >= baseline + receivers, timeout=60)
+
+        payload = np.random.default_rng(0).integers(
+            0, 256, size=mb * 1024 * 1024, dtype=np.uint8)
+        want = hashlib.sha256(payload.tobytes()).hexdigest()
+        ref = ray_tpu.put(payload)
+
+        import ray_tpu._private.worker as worker_mod
+
+        gw = worker_mod.global_worker
+        owner_store = gw.transport.head.raylets[gw.node_id].store
+        seg_before = owner_store.stats()["segments_created_total"]
+
+        @ray_tpu.remote
+        def pull(oid_hex, start_at):
+            import hashlib as _h
+            import time as _t
+
+            from ray_tpu._private import transfer
+            from ray_tpu._private.ids import ObjectID
+            from ray_tpu.object_ref import ObjectRef
+
+            r = ObjectRef(ObjectID(bytes.fromhex(oid_hex)))
+            while _t.time() < start_at:
+                _t.sleep(0.005)
+            v = ray_tpu.get(r)
+            digest = _h.sha256(np.asarray(v).tobytes()).hexdigest()
+            return digest, transfer.transfer_stats()
+
+        # The id rides as a STRING so the scheduler cannot prefetch the
+        # bytes ahead of the synchronized demand pulls — the smoke needs
+        # the pulls to RACE to form the dissemination tree.
+        start_at = _time.time() + 2.0
+        futs = [pull.options(resources={f"bc{i}": 1}).remote(
+            ref.hex(), start_at) for i in range(receivers)]
+        res = ray_tpu.get(futs, timeout=120)
+        seg_after = owner_store.stats()["segments_created_total"]
+        elapsed = _time.monotonic() - t0
+
+        out = {
+            "receivers": receivers,
+            "payload_mb": mb,
+            "byte_identity": all(d == want for d, _ in res),
+            "striped_pulls": sum(
+                int(s.get("striped_pulls", 0)) for _, s in res),
+            "ranges_from_partial": sum(
+                int(s.get("ranges_from_partial", 0)) for _, s in res),
+            "peer_served_ranges": sum(
+                int(s.get("served_partial_ranges", 0)) for _, s in res),
+            "owner_new_segments": seg_after - seg_before,
+            "elapsed_s": round(elapsed, 3),
+            "no_hang": elapsed < 90.0,
+        }
+        out["ok"] = bool(out["byte_identity"]
+                         and out["striped_pulls"] >= receivers
+                         and out["ranges_from_partial"] >= 1
+                         and out["peer_served_ranges"] >= 1
+                         and out["owner_new_segments"] == 0
+                         and out["no_hang"])
+        return out
+    finally:
+        for a in agents:
+            try:
+                a.kill()
+            except Exception:
+                pass
+        for a in agents:
+            try:
+                a.wait(timeout=10)
+            except Exception:
+                pass
+        ray_tpu.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        CONFIG.reset()
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     out = run_smoke()
@@ -1709,10 +1832,13 @@ def main() -> int:
     out["replay"] = rp
     tr = run_tracing_smoke()
     out["tracing"] = tr
+    bc = run_broadcast_smoke()
+    out["broadcast"] = bc
     out["ok"] = bool(out["ok"] and obj["ok"] and ckpt["ok"] and roll["ok"]
                      and rpc["ok"] and nl["ok"] and el["ok"] and sv["ok"]
                      and zr["ok"] and mpmd["ok"] and fl["ok"] and td["ok"]
-                     and rl["ok"] and loc["ok"] and rp["ok"] and tr["ok"])
+                     and rl["ok"] and loc["ok"] and rp["ok"] and tr["ok"]
+                     and bc["ok"])
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
